@@ -1,0 +1,27 @@
+"""Test session setup.
+
+JAX must be steered to a virtual 8-device CPU platform *before* it is first
+imported anywhere in the test process: the validator workload and the graft
+multichip dry-run exercise real Mesh/collective code paths against these
+virtual devices (the driver separately dry-runs the multi-chip path the same
+way).
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+from tpu_operator.client import FakeClient  # noqa: E402
+
+
+@pytest.fixture
+def fake_client():
+    return FakeClient()

@@ -1,0 +1,126 @@
+import pytest
+
+from tpu_operator.client import ConflictError, FakeClient, NotFoundError
+from tpu_operator.client.errors import AlreadyExistsError
+
+
+def mk_pod(name, ns="default", labels=None, node=None):
+    pod = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": ns, "labels": labels or {}},
+        "spec": {},
+    }
+    if node:
+        pod["spec"]["nodeName"] = node
+    return pod
+
+
+def test_create_get_roundtrip(fake_client):
+    created = fake_client.create(mk_pod("p1"))
+    assert created["metadata"]["uid"]
+    assert created["metadata"]["resourceVersion"]
+    got = fake_client.get("v1", "Pod", "p1", "default")
+    assert got["metadata"]["uid"] == created["metadata"]["uid"]
+
+
+def test_create_duplicate_fails(fake_client):
+    fake_client.create(mk_pod("p1"))
+    with pytest.raises(AlreadyExistsError):
+        fake_client.create(mk_pod("p1"))
+
+
+def test_get_missing_raises(fake_client):
+    with pytest.raises(NotFoundError):
+        fake_client.get("v1", "Pod", "nope")
+
+
+def test_list_with_selectors(fake_client):
+    fake_client.create(mk_pod("a", labels={"app": "x"}, node="n1"))
+    fake_client.create(mk_pod("b", labels={"app": "y"}, node="n1"))
+    fake_client.create(mk_pod("c", labels={"app": "x"}, node="n2"))
+    assert len(fake_client.list("v1", "Pod")) == 3
+    assert [p["metadata"]["name"] for p in fake_client.list("v1", "Pod", label_selector={"app": "x"})] == ["a", "c"]
+    assert [p["metadata"]["name"] for p in fake_client.list(
+        "v1", "Pod", label_selector={"app": "x"}, field_selector={"spec.nodeName": "n1"})] == ["a"]
+    # exists-style selector
+    assert len(fake_client.list("v1", "Pod", label_selector={"app": None})) == 3
+
+
+def test_update_conflict_on_stale_rv(fake_client):
+    created = fake_client.create(mk_pod("p1"))
+    first = dict(created)
+    fake_client.update(created)
+    with pytest.raises(ConflictError):
+        fake_client.update(first)
+
+
+def test_update_bumps_generation_only_on_spec_change(fake_client):
+    created = fake_client.create(mk_pod("p1"))
+    assert created["metadata"]["generation"] == 1
+    created["spec"]["nodeName"] = "n9"
+    updated = fake_client.update(created)
+    assert updated["metadata"]["generation"] == 2
+    updated["metadata"]["labels"] = {"z": "1"}
+    again = fake_client.update(updated)
+    assert again["metadata"]["generation"] == 2
+
+
+def test_patch_merge_and_null_delete(fake_client):
+    fake_client.create(mk_pod("p1", labels={"keep": "1", "drop": "2"}))
+    fake_client.patch("v1", "Pod", "p1", {"metadata": {"labels": {"drop": None, "new": "3"}}}, "default")
+    got = fake_client.get("v1", "Pod", "p1")
+    assert got["metadata"]["labels"] == {"keep": "1", "new": "3"}
+
+
+def test_patch_preserves_unrelated_nulls(fake_client):
+    # RFC 7386: only nulls present in the patch document delete keys.
+    pod = mk_pod("p1")
+    pod["spec"]["tolerations"] = None
+    fake_client.create(pod)
+    fake_client.patch("v1", "Pod", "p1", {"metadata": {"labels": {"a": "1"}}}, "default")
+    got = fake_client.get("v1", "Pod", "p1")
+    assert "tolerations" in got["spec"] and got["spec"]["tolerations"] is None
+
+
+def test_status_subresource_does_not_touch_spec_or_generation(fake_client):
+    created = fake_client.create(mk_pod("p1"))
+    created["status"] = {"phase": "Running"}
+    created["spec"] = {"mutated": True}  # must be ignored by update_status
+    updated = fake_client.update_status(created)
+    assert updated["status"] == {"phase": "Running"}
+    live = fake_client.get("v1", "Pod", "p1")
+    assert live["metadata"]["generation"] == 1
+
+
+def test_owner_reference_cascade_delete(fake_client):
+    owner = fake_client.create({
+        "apiVersion": "apps/v1", "kind": "DaemonSet",
+        "metadata": {"name": "ds", "namespace": "default"}, "spec": {},
+    })
+    child = mk_pod("child")
+    child["metadata"]["ownerReferences"] = [{
+        "apiVersion": "apps/v1", "kind": "DaemonSet",
+        "name": "ds", "uid": owner["metadata"]["uid"],
+    }]
+    fake_client.create(child)
+    fake_client.delete("apps/v1", "DaemonSet", "ds", "default")
+    with pytest.raises(NotFoundError):
+        fake_client.get("v1", "Pod", "child")
+
+
+def test_watch_delivers_events(fake_client):
+    seen = []
+    handle = fake_client.watch("v1", "Pod", handler=seen.append)
+    fake_client.create(mk_pod("p1"))
+    fake_client.delete("v1", "Pod", "p1", "default")
+    assert [e.type for e in seen] == ["ADDED", "DELETED"]
+    handle.stop()
+    fake_client.create(mk_pod("p2"))
+    assert len(seen) == 2
+
+
+def test_cluster_scoped_objects(fake_client):
+    fake_client.create({"apiVersion": "v1", "kind": "Node", "metadata": {"name": "n1"}})
+    got = fake_client.get("v1", "Node", "n1")
+    assert "namespace" not in got["metadata"] or not got["metadata"].get("namespace")

@@ -1,0 +1,32 @@
+from tpu_operator.client.rest import RestClient
+
+
+def client():
+    return RestClient(base_url="https://apiserver:6443", token="t")
+
+
+def test_core_namespaced_url():
+    c = client()
+    assert (c.resource_url("v1", "Pod", "ns1", "p1")
+            == "https://apiserver:6443/api/v1/namespaces/ns1/pods/p1")
+
+
+def test_core_cluster_scoped_url():
+    c = client()
+    assert c.resource_url("v1", "Node", None, "n1") == "https://apiserver:6443/api/v1/nodes/n1"
+
+
+def test_group_url_and_status_subresource():
+    c = client()
+    assert (c.resource_url("apps/v1", "DaemonSet", "tpu-operator", "libtpu", "status")
+            == "https://apiserver:6443/apis/apps/v1/namespaces/tpu-operator/daemonsets/libtpu/status")
+
+
+def test_crd_urls():
+    c = client()
+    assert (c.resource_url("tpu.ai/v1", "ClusterPolicy", None, "cluster-policy")
+            == "https://apiserver:6443/apis/tpu.ai/v1/clusterpolicies/cluster-policy")
+
+
+def test_selector_param():
+    assert RestClient._selector_param({"a": "1", "b": None}) == "a=1,b"

@@ -1,0 +1,37 @@
+from tpu_operator.utils import deep_get, deep_merge, fnv32a, object_hash, parse_quantity
+
+
+def test_fnv32a_known_vectors():
+    # Published FNV-1a 32-bit test vectors.
+    assert fnv32a(b"") == 0x811C9DC5
+    assert fnv32a(b"a") == 0xE40C292C
+    assert fnv32a(b"foobar") == 0xBF9CF968
+
+
+def test_object_hash_is_key_order_insensitive():
+    assert object_hash({"a": 1, "b": [1, 2]}) == object_hash({"b": [1, 2], "a": 1})
+
+
+def test_object_hash_detects_changes():
+    base = {"spec": {"image": "libtpu:1"}}
+    changed = {"spec": {"image": "libtpu:2"}}
+    assert object_hash(base) != object_hash(changed)
+
+
+def test_deep_get():
+    obj = {"metadata": {"labels": {"x": "y"}}}
+    assert deep_get(obj, "metadata", "labels", "x") == "y"
+    assert deep_get(obj, "metadata", "missing", "x") is None
+    assert deep_get(obj, "metadata", "missing", default=3) == 3
+
+
+def test_deep_merge_replaces_lists_merges_maps():
+    base = {"a": {"b": 1, "c": 2}, "l": [1, 2]}
+    deep_merge(base, {"a": {"c": 3}, "l": [9]})
+    assert base == {"a": {"b": 1, "c": 3}, "l": [9]}
+
+
+def test_parse_quantity():
+    assert parse_quantity("500m") == 0.5
+    assert parse_quantity("1Gi") == 2**30
+    assert parse_quantity(4) == 4.0
