@@ -1,0 +1,109 @@
+import pytest
+
+from tpu_operator.api import ClusterPolicy, ClusterPolicySpec, TPUDriver
+from tpu_operator.api.clusterpolicy import new_cluster_policy
+from tpu_operator.api.common import SpecValidationError
+from tpu_operator.api.tpudriver import TPU_PRESENT_LABEL, new_tpu_driver
+
+
+def test_empty_spec_gets_full_defaults():
+    spec = ClusterPolicySpec.from_dict({})
+    assert spec.driver.is_enabled() is True
+    assert spec.driver.install_dir == "/home/kubernetes/bin/libtpu"
+    assert spec.device_plugin.resource_name == "google.com/tpu"
+    assert spec.slice_partitioner.is_enabled() is False  # opt-in like MIG
+    assert spec.operator.default_runtime == "containerd"
+    assert spec.daemonsets.priority_class_name == "system-node-critical"
+    assert spec.validate() == []
+
+
+def test_round_trip_preserves_unknown_fields():
+    data = {
+        "driver": {"enabled": False, "futureField": {"x": 1}},
+        "topLevelUnknown": True,
+    }
+    spec = ClusterPolicySpec.from_dict(data)
+    out = spec.to_dict()
+    assert out["driver"]["futureField"] == {"x": 1}
+    assert out["topLevelUnknown"] is True
+    assert out["driver"]["enabled"] is False
+
+
+def test_camel_case_mapping():
+    spec = ClusterPolicySpec.from_dict({
+        "devicePlugin": {"resourceName": "google.com/tpu-v5e", "imagePullPolicy": "Always"},
+        "featureDiscovery": {"sleepInterval": "30s"},
+    })
+    assert spec.device_plugin.resource_name == "google.com/tpu-v5e"
+    assert spec.device_plugin.image_pull_policy == "Always"
+    assert spec.feature_discovery.sleep_interval == "30s"
+
+
+def test_image_path_resolution_cr_fields():
+    spec = ClusterPolicySpec.from_dict({
+        "driver": {"repository": "gcr.io/tpu", "image": "libtpu-installer", "version": "1.2.3"},
+    })
+    assert spec.driver.image_path() == "gcr.io/tpu/libtpu-installer:1.2.3"
+
+
+def test_image_path_digest_uses_at_separator():
+    spec = ClusterPolicySpec.from_dict({
+        "driver": {"image": "libtpu-installer", "version": "sha256:" + "a" * 64},
+    })
+    assert "@sha256:" in spec.driver.image_path()
+
+
+def test_image_path_env_fallback(monkeypatch):
+    monkeypatch.setenv("DEVICE_PLUGIN_IMAGE", "gcr.io/tpu/device-plugin:9")
+    spec = ClusterPolicySpec.from_dict({})
+    assert spec.device_plugin.image_path() == "gcr.io/tpu/device-plugin:9"
+
+
+def test_image_path_error_when_unresolvable(monkeypatch):
+    monkeypatch.delenv("DRIVER_IMAGE", raising=False)
+    spec = ClusterPolicySpec.from_dict({})
+    with pytest.raises(SpecValidationError):
+        spec.driver.image_path()
+
+
+def test_validation_catches_bad_values():
+    spec = ClusterPolicySpec.from_dict({
+        "operator": {"defaultRuntime": "rkt"},
+        "daemonsets": {"updateStrategy": "BlueGreen"},
+        "driver": {"imagePullPolicy": "Sometimes", "upgradePolicy": {"maxParallelUpgrades": -1}},
+    })
+    errors = spec.validate()
+    assert any("defaultRuntime" in e for e in errors)
+    assert any("updateStrategy" in e for e in errors)
+    assert any("imagePullPolicy" in e for e in errors)
+    assert any("maxParallelUpgrades" in e for e in errors)
+
+
+def test_cluster_policy_wrapper():
+    obj = new_cluster_policy(spec={"driver": {"enabled": True}})
+    cp = ClusterPolicy.from_obj(obj)
+    assert cp.name == "cluster-policy"
+    cp.set_state("ready", "tpu-operator")
+    assert obj["status"] == {"state": "ready", "namespace": "tpu-operator"}
+    with pytest.raises(SpecValidationError):
+        ClusterPolicy.from_obj({"kind": "Pod"})
+
+
+def test_tpudriver_defaults_and_selector():
+    drv = TPUDriver.from_obj(new_tpu_driver("pool-a"))
+    assert drv.spec.get_node_selector() == {TPU_PRESENT_LABEL: "true"}
+    drv2 = TPUDriver.from_obj(new_tpu_driver("pool-b", {"nodeSelector": {"pool": "b"}}))
+    assert drv2.spec.get_node_selector() == {"pool": "b"}
+    assert drv.spec.validate() == []
+
+
+def test_tpudriver_validation():
+    drv = TPUDriver.from_obj(new_tpu_driver("x", {"driverType": "vgpu"}))
+    assert any("driverType" in e for e in drv.spec.validate())
+
+
+def test_env_list_parsing():
+    spec = ClusterPolicySpec.from_dict({
+        "driver": {"env": [{"name": "A", "value": "1"}, {"name": "B"}]},
+    })
+    assert spec.driver.env_map() == {"A": "1", "B": ""}

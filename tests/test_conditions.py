@@ -1,0 +1,41 @@
+from tpu_operator.api.clusterpolicy import new_cluster_policy
+from tpu_operator.conditions import (
+    ERROR,
+    READY,
+    REASON_OPERAND_NOT_READY,
+    Updater,
+    get_condition,
+)
+
+
+def test_ready_then_error_transition(fake_client):
+    obj = fake_client.create(new_cluster_policy())
+    updater = Updater(fake_client)
+
+    updater.set_ready(obj)
+    live = fake_client.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy")
+    assert get_condition(live, READY)["status"] == "True"
+    assert get_condition(live, ERROR)["status"] == "False"
+
+    updater.set_error(live, REASON_OPERAND_NOT_READY, "driver DS not ready")
+    live = fake_client.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy")
+    ready = get_condition(live, READY)
+    assert ready["status"] == "False"
+    assert ready["reason"] == REASON_OPERAND_NOT_READY
+    assert get_condition(live, ERROR)["message"] == "driver DS not ready"
+    # exactly one condition per type
+    assert len(live["status"]["conditions"]) == 2
+
+
+def test_last_transition_time_kept_when_status_unchanged(fake_client):
+    obj = fake_client.create(new_cluster_policy())
+    updater = Updater(fake_client)
+    updater.set_ready(obj)
+    first = get_condition(
+        fake_client.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy"), READY
+    )["lastTransitionTime"]
+    updater.set_ready(fake_client.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy"))
+    second = get_condition(
+        fake_client.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy"), READY
+    )["lastTransitionTime"]
+    assert first == second

@@ -1,0 +1,201 @@
+"""ClusterPolicy CRD (tpu.ai/v1): the singleton cluster configuration.
+
+TPU-native analog of the reference's ClusterPolicy
+(api/nvidia/v1/clusterpolicy_types.go:41-97): one sub-spec per operand. The
+operand set is re-based on what a TPU fleet actually needs (SURVEY.md section
+2.7/7): driver=libtpu installer (no kernel-module build), devicePlugin
+advertises ``google.com/tpu`` (no container-toolkit runtime rewriting),
+featureDiscovery emits chip/ICI-topology labels (GFD analog), telemetry
+scrapes libtpu runtime metrics (DCGM analog), slicePartitioner is the MIG
+analog, validator runs a JAX allreduce over ICI instead of CUDA vectorAdd.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+from .common import (
+    ComponentSpec,
+    DaemonsetsSpec,
+    EnvVar,
+    SpecValidationError,
+    UpgradePolicySpec,
+)
+from .specbase import SpecBase, spec_field
+
+CLUSTER_POLICY_API_VERSION = "tpu.ai/v1"
+CLUSTER_POLICY_KIND = "ClusterPolicy"
+
+
+class State:
+    """CR status.state values (reference clusterpolicy_types.go:1658)."""
+
+    IGNORED = "ignored"
+    READY = "ready"
+    NOT_READY = "notReady"
+
+
+@dataclasses.dataclass
+class OperatorSpec(SpecBase):
+    default_runtime: str = "containerd"
+    runtime_class: str = "tpu"
+    init_container: Optional[Dict[str, Any]] = None
+    labels: Dict[str, str] = spec_field(dict)
+    annotations: Dict[str, str] = spec_field(dict)
+    extra: Dict[str, Any] = spec_field(dict)
+
+    def validate(self, path: str = "spec.operator") -> List[str]:
+        if self.default_runtime not in ("containerd", "docker", "crio"):
+            return [f"{path}.defaultRuntime: invalid {self.default_runtime!r}"]
+        return []
+
+
+@dataclasses.dataclass
+class DriverSpec(ComponentSpec):
+    """libtpu installer (reference state-driver, minus the kernel build)."""
+
+    DEFAULT_IMAGE_ENV: str = dataclasses.field(default="DRIVER_IMAGE", repr=False)
+
+    libtpu_version: Optional[str] = None
+    install_dir: str = "/home/kubernetes/bin/libtpu"
+    upgrade_policy: UpgradePolicySpec = spec_field(UpgradePolicySpec)
+
+    def validate(self, path: str = "spec.driver") -> List[str]:
+        return super().validate(path) + self.upgrade_policy.validate(f"{path}.upgradePolicy")
+
+
+@dataclasses.dataclass
+class DevicePluginSpec(ComponentSpec):
+    DEFAULT_IMAGE_ENV: str = dataclasses.field(default="DEVICE_PLUGIN_IMAGE", repr=False)
+
+    #: extended resource advertised to the scheduler
+    resource_name: str = "google.com/tpu"
+    config: Optional[Dict[str, Any]] = None  # {"name": <ConfigMap>, "default": <key>}
+
+
+@dataclasses.dataclass
+class FeatureDiscoverySpec(ComponentSpec):
+    """TPU feature discovery: chip type, chip count, ICI topology labels."""
+
+    DEFAULT_IMAGE_ENV: str = dataclasses.field(default="FEATURE_DISCOVERY_IMAGE", repr=False)
+
+    sleep_interval: str = "60s"
+
+
+@dataclasses.dataclass
+class TelemetrySpec(ComponentSpec):
+    """libtpu runtime-metrics exporter (DCGM + dcgm-exporter analog)."""
+
+    DEFAULT_IMAGE_ENV: str = dataclasses.field(default="TELEMETRY_EXPORTER_IMAGE", repr=False)
+
+    service_monitor: Optional[Dict[str, Any]] = None
+    metrics_port: int = 9400
+
+
+@dataclasses.dataclass
+class NodeStatusExporterSpec(ComponentSpec):
+    DEFAULT_IMAGE_ENV: str = dataclasses.field(default="VALIDATOR_IMAGE", repr=False)
+
+    metrics_port: int = 8000
+
+
+@dataclasses.dataclass
+class ValidatorComponentEnv(SpecBase):
+    env: List[EnvVar] = spec_field(list)
+    extra: Dict[str, Any] = spec_field(dict)
+
+
+@dataclasses.dataclass
+class ValidatorSpec(ComponentSpec):
+    """On-node validator: status-file barriers + JAX ICI allreduce workload."""
+
+    DEFAULT_IMAGE_ENV: str = dataclasses.field(default="VALIDATOR_IMAGE", repr=False)
+
+    driver: ValidatorComponentEnv = spec_field(ValidatorComponentEnv)
+    plugin: ValidatorComponentEnv = spec_field(ValidatorComponentEnv)
+    workload: ValidatorComponentEnv = spec_field(ValidatorComponentEnv)
+
+
+@dataclasses.dataclass
+class SlicePartitionerSpec(ComponentSpec):
+    """TPU slice partition manager (MIG-manager analog): applies the
+    partition named by the node label ``tpu.ai/slice.config``."""
+
+    DEFAULT_IMAGE_ENV: str = dataclasses.field(default="SLICE_PARTITIONER_IMAGE", repr=False)
+
+    config: Optional[Dict[str, Any]] = None  # {"name": <ConfigMap>, "default": <key>}
+
+    def is_enabled(self, default: bool = False) -> bool:
+        # opt-in, like MIG in the reference
+        return default if self.enabled is None else bool(self.enabled)
+
+
+@dataclasses.dataclass
+class CDISpec(SpecBase):
+    enabled: bool = False
+    default: bool = False
+    extra: Dict[str, Any] = spec_field(dict)
+
+
+@dataclasses.dataclass
+class ClusterPolicySpec(SpecBase):
+    operator: OperatorSpec = spec_field(OperatorSpec)
+    daemonsets: DaemonsetsSpec = spec_field(DaemonsetsSpec)
+    driver: DriverSpec = spec_field(DriverSpec)
+    device_plugin: DevicePluginSpec = spec_field(DevicePluginSpec)
+    feature_discovery: FeatureDiscoverySpec = spec_field(FeatureDiscoverySpec)
+    telemetry: TelemetrySpec = spec_field(TelemetrySpec)
+    node_status_exporter: NodeStatusExporterSpec = spec_field(NodeStatusExporterSpec)
+    validator: ValidatorSpec = spec_field(ValidatorSpec)
+    slice_partitioner: SlicePartitionerSpec = spec_field(SlicePartitionerSpec)
+    cdi: CDISpec = spec_field(CDISpec)
+    extra: Dict[str, Any] = spec_field(dict)
+
+    def validate(self) -> List[str]:
+        errors: List[str] = []
+        errors += self.operator.validate()
+        errors += self.daemonsets.validate()
+        errors += self.driver.validate()
+        for name in ("device_plugin", "feature_discovery", "telemetry",
+                     "node_status_exporter", "validator", "slice_partitioner"):
+            sub: ComponentSpec = getattr(self, name)
+            errors += sub.validate(f"spec.{name}")
+        return errors
+
+
+@dataclasses.dataclass
+class ClusterPolicy:
+    """Typed wrapper around the unstructured CR object."""
+
+    name: str
+    spec: ClusterPolicySpec
+    obj: Dict[str, Any]
+
+    @classmethod
+    def from_obj(cls, obj: Dict[str, Any]) -> "ClusterPolicy":
+        if obj.get("kind") != CLUSTER_POLICY_KIND:
+            raise SpecValidationError(f"not a ClusterPolicy: kind={obj.get('kind')!r}")
+        return cls(
+            name=obj.get("metadata", {}).get("name", ""),
+            spec=ClusterPolicySpec.from_dict(obj.get("spec", {})),
+            obj=obj,
+        )
+
+    @property
+    def status(self) -> Dict[str, Any]:
+        return self.obj.setdefault("status", {})
+
+    def set_state(self, state: str, namespace: str = "") -> None:
+        self.status["state"] = state
+        if namespace:
+            self.status["namespace"] = namespace
+
+
+def new_cluster_policy(name: str = "cluster-policy", spec: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    return {
+        "apiVersion": CLUSTER_POLICY_API_VERSION,
+        "kind": CLUSTER_POLICY_KIND,
+        "metadata": {"name": name},
+        "spec": spec or {},
+    }

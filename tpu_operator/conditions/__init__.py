@@ -1,0 +1,90 @@
+"""CR status condition updaters (reference: internal/conditions/).
+
+Both CRDs share the Ready/Error condition pair; reasons follow the
+reference's vocabulary (internal/conditions/consts.go) with TPU-specific
+additions.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from ..client.errors import ConflictError, NotFoundError
+from ..client.interface import Client
+
+READY = "Ready"
+ERROR = "Error"
+
+# Reasons (reference internal/conditions/consts.go)
+REASON_READY = "Ready"
+REASON_RECONCILE_FAILED = "ReconcileFailed"
+REASON_OPERAND_NOT_READY = "OperandNotReady"
+REASON_NO_TPU_NODES = "NoTPUNodes"
+REASON_DISCOVERY_LABELS_MISSING = "DiscoveryLabelsMissing"
+REASON_CONFLICTING_NODE_SELECTOR = "ConflictingNodeSelector"
+REASON_DRIVER_NOT_READY = "DriverNotReady"
+
+
+def _now() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+def make_condition(type_: str, status: str, reason: str, message: str = "") -> dict:
+    return {
+        "type": type_,
+        "status": status,
+        "reason": reason,
+        "message": message,
+        "lastTransitionTime": _now(),
+    }
+
+
+def set_condition(conditions: List[dict], new: dict) -> List[dict]:
+    """Upsert by type; keep lastTransitionTime when status is unchanged."""
+    for i, existing in enumerate(conditions):
+        if existing.get("type") == new["type"]:
+            if existing.get("status") == new["status"]:
+                new["lastTransitionTime"] = existing.get("lastTransitionTime", new["lastTransitionTime"])
+            conditions[i] = new
+            return conditions
+    conditions.append(new)
+    return conditions
+
+
+class Updater:
+    """Writes Ready/Error condition pairs to a CR's status subresource."""
+
+    def __init__(self, client: Client):
+        self._client = client
+
+    def set_ready(self, obj: dict, message: str = "All operands are ready") -> None:
+        self._apply(obj, [
+            make_condition(READY, "True", REASON_READY, message),
+            make_condition(ERROR, "False", REASON_READY, ""),
+        ])
+
+    def set_error(self, obj: dict, reason: str, message: str) -> None:
+        self._apply(obj, [
+            make_condition(READY, "False", reason, ""),
+            make_condition(ERROR, "True", reason, message),
+        ])
+
+    def _apply(self, obj: dict, new_conditions: List[dict]) -> None:
+        status = obj.setdefault("status", {})
+        conditions = status.setdefault("conditions", [])
+        for c in new_conditions:
+            set_condition(conditions, c)
+        try:
+            self._client.update_status(obj)
+        except (ConflictError, NotFoundError):
+            # Level-driven reconcilers re-run on the next event; a lost status
+            # write self-heals (reference relies on the same requeue property).
+            pass
+
+
+def get_condition(obj: dict, type_: str) -> Optional[dict]:
+    for c in obj.get("status", {}).get("conditions", []):
+        if c.get("type") == type_:
+            return c
+    return None
