@@ -1,0 +1,102 @@
+import os
+
+import pytest
+import yaml
+
+from tpu_operator.api.clusterpolicy import ClusterPolicy, new_cluster_policy
+from tpu_operator.render import RenderError, Renderer
+from tpu_operator.state.driver import DriverRenderOverrides, StateDriver
+
+GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "golden")
+
+
+def render_driver(spec=None, overrides=None):
+    driver = StateDriver(client=None)
+    policy = ClusterPolicy.from_obj(new_cluster_policy(spec=spec or {
+        "driver": {"repository": "gcr.io/tpu", "image": "tpu-validator", "version": "0.1.0"},
+    }))
+    return driver.render_objects(policy, "tpu-operator", overrides)
+
+
+def test_driver_renders_expected_kinds():
+    objs = render_driver()
+    kinds = [o["kind"] for o in objs]
+    assert kinds == ["ServiceAccount", "ClusterRole", "ClusterRoleBinding", "DaemonSet"]
+
+
+def test_driver_daemonset_contents():
+    ds = [o for o in render_driver() if o["kind"] == "DaemonSet"][0]
+    pod = ds["spec"]["template"]["spec"]
+    assert pod["nodeSelector"] == {"tpu.ai/tpu.deploy.driver": "true"}
+    ctr = pod["containers"][0]
+    assert ctr["image"] == "gcr.io/tpu/tpu-validator:0.1.0"
+    assert ctr["securityContext"]["privileged"] is True
+    assert any(v["hostPath"]["path"] == "/dev" for v in pod["volumes"])
+    # startup probe replaces the reference's 20-min nvidia-smi budget with 2 min
+    probe = ctr["startupProbe"]
+    assert probe["periodSeconds"] * probe["failureThreshold"] == 120
+
+
+def test_driver_overrides_for_pool_fanout():
+    objs = render_driver(overrides=DriverRenderOverrides(
+        app_name="libtpu-driver-v5e-2x4",
+        node_selector={"cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+                       "cloud.google.com/gke-tpu-topology": "2x4"},
+        libtpu_version="2025.1.0",
+    ))
+    ds = [o for o in objs if o["kind"] == "DaemonSet"][0]
+    assert ds["metadata"]["name"] == "libtpu-driver-v5e-2x4"
+    sel = ds["spec"]["template"]["spec"]["nodeSelector"]
+    assert sel["cloud.google.com/gke-tpu-topology"] == "2x4"
+    args = ds["spec"]["template"]["spec"]["containers"][0]["args"]
+    assert "--libtpu-version=2025.1.0" in args
+
+
+def test_renderer_strict_on_missing_vars(tmp_path):
+    (tmp_path / "bad.yaml").write_text("apiVersion: v1\nkind: ConfigMap\nmetadata:\n  name: {{ nope }}\n")
+    with pytest.raises(RenderError, match="missing template variable"):
+        Renderer(str(tmp_path)).render_objects({})
+
+
+def test_renderer_rejects_non_object_docs(tmp_path):
+    (tmp_path / "bad.yaml").write_text("- just\n- a\n- list\n")
+    with pytest.raises(RenderError, match="not a k8s object"):
+        Renderer(str(tmp_path)).render_objects({})
+
+
+def test_renderer_missing_dir():
+    with pytest.raises(RenderError):
+        Renderer("/nonexistent/path")
+
+
+@pytest.mark.parametrize("scenario,spec,overrides", [
+    ("minimal", {"driver": {"repository": "gcr.io/tpu", "image": "tpu-validator", "version": "0.1.0"}}, None),
+    ("full", {
+        "driver": {
+            "repository": "gcr.io/tpu", "image": "tpu-validator", "version": "0.1.0",
+            "libtpuVersion": "2025.1.0",
+            "env": [{"name": "TPU_LOG", "value": "1"}],
+            "imagePullSecrets": ["regcred"],
+            "resources": {"limits": {"memory": "256Mi"}},
+        },
+        "daemonsets": {
+            "tolerations": [{"key": "dedicated", "operator": "Equal", "value": "tpu", "effect": "NoSchedule"}],
+            "annotations": {"team": "infra"},
+            "rollingUpdate": {"maxUnavailable": 2},
+        },
+    }, None),
+    ("pool", {"driver": {"repository": "gcr.io/tpu", "image": "tpu-validator", "version": "0.1.0"}},
+     DriverRenderOverrides(app_name="libtpu-driver-v5e-2x4",
+                           node_selector={"cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice"})),
+])
+def test_golden_render(scenario, spec, overrides):
+    """Byte-exact golden comparison (reference internal/state/driver_test.go:43)."""
+    objs = render_driver(spec, overrides)
+    text = yaml.safe_dump_all(objs, sort_keys=True)
+    golden_path = os.path.join(GOLDEN_DIR, f"driver_{scenario}.yaml")
+    if os.environ.get("UPDATE_GOLDEN"):
+        os.makedirs(GOLDEN_DIR, exist_ok=True)
+        with open(golden_path, "w") as f:
+            f.write(text)
+    with open(golden_path) as f:
+        assert text == f.read(), f"golden mismatch for {scenario}; UPDATE_GOLDEN=1 to regenerate"

@@ -1,0 +1,104 @@
+from tpu_operator import consts
+from tpu_operator.client import FakeClient, NotFoundError
+from tpu_operator.state import StateSkel, SyncState
+from tpu_operator.state.skel import is_daemonset_ready
+
+
+def mk_ds(name="ds1", image="img:1"):
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "DaemonSet",
+        "metadata": {"name": name, "namespace": "tpu-operator"},
+        "spec": {"template": {"spec": {"containers": [{"name": "c", "image": image}]}}},
+    }
+
+
+def mk_owner(fake_client):
+    return fake_client.create({
+        "apiVersion": "tpu.ai/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": "cluster-policy"}, "spec": {},
+    })
+
+
+def test_apply_sets_owner_state_label_and_hash(fake_client):
+    skel = StateSkel("state-driver", fake_client)
+    owner = mk_owner(fake_client)
+    applied = skel.create_or_update_objs([mk_ds()], owner=owner)
+    live = fake_client.get("apps/v1", "DaemonSet", "ds1", "tpu-operator")
+    assert live["metadata"]["labels"][consts.STATE_LABEL] == "state-driver"
+    assert live["metadata"]["ownerReferences"][0]["uid"] == owner["metadata"]["uid"]
+    assert consts.SPEC_HASH_ANNOTATION in live["metadata"]["annotations"]
+    assert applied[0]["metadata"]["resourceVersion"]
+
+
+def test_unchanged_daemonset_skips_write(fake_client):
+    skel = StateSkel("s", fake_client)
+    skel.create_or_update_objs([mk_ds()])
+    rv1 = fake_client.get("apps/v1", "DaemonSet", "ds1", "tpu-operator")["metadata"]["resourceVersion"]
+    skel.create_or_update_objs([mk_ds()])
+    rv2 = fake_client.get("apps/v1", "DaemonSet", "ds1", "tpu-operator")["metadata"]["resourceVersion"]
+    assert rv1 == rv2  # hash-skip: no API write
+
+
+def test_changed_daemonset_updates(fake_client):
+    skel = StateSkel("s", fake_client)
+    skel.create_or_update_objs([mk_ds(image="img:1")])
+    skel.create_or_update_objs([mk_ds(image="img:2")])
+    live = fake_client.get("apps/v1", "DaemonSet", "ds1", "tpu-operator")
+    assert live["spec"]["template"]["spec"]["containers"][0]["image"] == "img:2"
+
+
+def test_update_preserves_service_cluster_ip(fake_client):
+    skel = StateSkel("s", fake_client)
+    svc = {"apiVersion": "v1", "kind": "Service",
+           "metadata": {"name": "svc", "namespace": "tpu-operator"},
+           "spec": {"ports": [{"port": 9400}]}}
+    skel.create_or_update_objs([svc])
+    # apiserver allocates a clusterIP
+    live = fake_client.get("v1", "Service", "svc", "tpu-operator")
+    live["spec"]["clusterIP"] = "10.0.0.42"
+    fake_client.update(live)
+    svc2 = {"apiVersion": "v1", "kind": "Service",
+            "metadata": {"name": "svc", "namespace": "tpu-operator"},
+            "spec": {"ports": [{"port": 9401}]}}
+    skel.create_or_update_objs([svc2])
+    live = fake_client.get("v1", "Service", "svc", "tpu-operator")
+    assert live["spec"]["clusterIP"] == "10.0.0.42"
+    assert live["spec"]["ports"][0]["port"] == 9401
+
+
+def test_daemonset_readiness_math():
+    assert is_daemonset_ready({"status": {"desiredNumberScheduled": 0}})
+    assert is_daemonset_ready({"status": {
+        "desiredNumberScheduled": 4, "numberAvailable": 4, "updatedNumberScheduled": 4}})
+    assert not is_daemonset_ready({"status": {
+        "desiredNumberScheduled": 4, "numberAvailable": 3, "updatedNumberScheduled": 4}})
+    assert not is_daemonset_ready({"status": {
+        "desiredNumberScheduled": 4, "numberAvailable": 4, "updatedNumberScheduled": 2}})
+
+
+def test_get_sync_state_walks_applied_objects(fake_client):
+    skel = StateSkel("s", fake_client)
+    applied = skel.create_or_update_objs([mk_ds()])
+    assert skel.get_sync_state(applied) == SyncState.READY  # desired=0 vacuous
+    live = fake_client.get("apps/v1", "DaemonSet", "ds1", "tpu-operator")
+    live["status"] = {"desiredNumberScheduled": 2, "numberAvailable": 1, "updatedNumberScheduled": 2}
+    fake_client.update_status(live)
+    assert skel.get_sync_state(applied) == SyncState.NOT_READY
+    live["status"] = {"desiredNumberScheduled": 2, "numberAvailable": 2, "updatedNumberScheduled": 2}
+    fake_client.update_status(live)
+    assert skel.get_sync_state(applied) == SyncState.READY
+
+
+def test_delete_objs_and_list_owned(fake_client):
+    skel = StateSkel("s", fake_client)
+    skel.create_or_update_objs([mk_ds()])
+    owned = skel.list_owned("apps/v1", "DaemonSet", "tpu-operator")
+    assert len(owned) == 1
+    skel.delete_objs(owned)
+    try:
+        fake_client.get("apps/v1", "DaemonSet", "ds1", "tpu-operator")
+        assert False, "should be deleted"
+    except NotFoundError:
+        pass
+    skel.delete_objs(owned)  # idempotent

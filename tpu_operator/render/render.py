@@ -1,0 +1,78 @@
+"""Manifest renderer: template files -> unstructured objects.
+
+Analog of the reference's internal/render (render.go:49-151): Go templates +
+sprig with ``missingkey=error``. Here: jinja2 with StrictUndefined (the same
+fail-on-missing contract), a ``toyaml`` filter standing in for sprig's, and
+multi-document YAML splitting.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List
+
+import jinja2
+import yaml
+
+
+class RenderError(Exception):
+    pass
+
+
+def _to_yaml(value: Any, indent: int = 0) -> str:
+    text = yaml.safe_dump(value, default_flow_style=False, sort_keys=False).rstrip("\n")
+    if indent:
+        pad = " " * indent
+        text = "\n".join(pad + line if line else line for line in text.splitlines())
+    return text
+
+
+class Renderer:
+    """Renders every ``*.yaml``/``*.yaml.j2`` template in a directory, in
+    lexical order (the reference relies on the same NNNN_name.yaml ordering)."""
+
+    TEMPLATE_SUFFIXES = (".yaml", ".yml", ".yaml.j2", ".yml.j2")
+
+    def __init__(self, templates_dir: str):
+        if not os.path.isdir(templates_dir):
+            raise RenderError(f"templates dir does not exist: {templates_dir}")
+        self.templates_dir = templates_dir
+        self._env = jinja2.Environment(
+            loader=jinja2.FileSystemLoader(templates_dir),
+            undefined=jinja2.StrictUndefined,
+            trim_blocks=True,
+            lstrip_blocks=True,
+            keep_trailing_newline=True,
+        )
+        self._env.filters["toyaml"] = _to_yaml
+
+    def template_files(self) -> List[str]:
+        return sorted(
+            f for f in os.listdir(self.templates_dir)
+            if f.endswith(self.TEMPLATE_SUFFIXES) and not f.startswith(".")
+        )
+
+    def render_file(self, name: str, data: Dict[str, Any]) -> List[dict]:
+        try:
+            text = self._env.get_template(name).render(**data)
+        except jinja2.UndefinedError as e:
+            raise RenderError(f"{name}: missing template variable: {e}") from e
+        except jinja2.TemplateError as e:
+            raise RenderError(f"{name}: {e}") from e
+        objs: List[dict] = []
+        try:
+            for doc in yaml.safe_load_all(text):
+                if not doc:
+                    continue
+                if not isinstance(doc, dict) or "kind" not in doc:
+                    raise RenderError(f"{name}: rendered doc is not a k8s object")
+                objs.append(doc)
+        except yaml.YAMLError as e:
+            raise RenderError(f"{name}: rendered invalid YAML: {e}") from e
+        return objs
+
+    def render_objects(self, data: Dict[str, Any]) -> List[dict]:
+        objs: List[dict] = []
+        for name in self.template_files():
+            objs.extend(self.render_file(name, data))
+        return objs

@@ -1,0 +1,169 @@
+"""Common state implementation: apply rendered objects, walk readiness.
+
+Analog of the reference's stateSkel (internal/state/state_skel.go): every
+state renders manifests to unstructured objects, then create-or-updates them
+with owner references, a state label, and DaemonSet hash-skip; sync state is
+derived by walking the readiness of what was applied
+(state_skel.go:223-285,383-444).
+"""
+
+from __future__ import annotations
+
+import copy
+import enum
+import logging
+from typing import Dict, List, Optional
+
+from .. import consts
+from ..client.errors import ConflictError, NotFoundError
+from ..client.interface import Client
+from ..utils import deep_get, object_hash
+
+log = logging.getLogger(__name__)
+
+
+class SyncState(str, enum.Enum):
+    READY = "ready"
+    NOT_READY = "notReady"
+    IGNORE = "ignore"
+    ERROR = "error"
+
+
+def owner_reference(owner: dict, controller: bool = True) -> dict:
+    return {
+        "apiVersion": owner["apiVersion"],
+        "kind": owner["kind"],
+        "name": owner["metadata"]["name"],
+        "uid": owner["metadata"].get("uid", ""),
+        "controller": controller,
+        "blockOwnerDeletion": True,
+    }
+
+
+# -- readiness predicates (state_skel.go:414-444, object_controls.go:3525) ----
+
+def is_daemonset_ready(ds: dict) -> bool:
+    status = ds.get("status", {})
+    desired = status.get("desiredNumberScheduled", 0)
+    if desired == 0:
+        # no eligible nodes -> vacuously ready (reference treats 0-node DS as
+        # ready at the DaemonSet layer; node-gating happens in the controller)
+        return True
+    return (
+        status.get("numberAvailable", 0) == desired
+        and status.get("updatedNumberScheduled", 0) == desired
+    )
+
+
+def is_deployment_ready(dep: dict) -> bool:
+    want = deep_get(dep, "spec", "replicas", default=1)
+    return dep.get("status", {}).get("readyReplicas", 0) >= want
+
+
+def is_pod_ready(pod: dict) -> bool:
+    phase = deep_get(pod, "status", "phase")
+    if phase == "Succeeded":
+        return True
+    if phase != "Running":
+        return False
+    for cond in deep_get(pod, "status", "conditions", default=[]) or []:
+        if cond.get("type") == "Ready":
+            return cond.get("status") == "True"
+    return False
+
+
+_READINESS = {
+    "DaemonSet": is_daemonset_ready,
+    "Deployment": is_deployment_ready,
+    "Pod": is_pod_ready,
+}
+
+#: fields the API server (or other controllers) own; preserved on update
+#: (mergeObjects analog, state_skel.go:344)
+_PRESERVE_ON_UPDATE = {
+    "Service": [("spec", "clusterIP"), ("spec", "clusterIPs")],
+    "ServiceAccount": [("secrets",), ("imagePullSecrets",)],
+}
+
+
+class StateSkel:
+    """Create-or-update a batch of unstructured objects and report readiness."""
+
+    def __init__(self, name: str, client: Client):
+        self.name = name
+        self.client = client
+
+    # -- apply ----------------------------------------------------------------
+    def create_or_update_objs(self, objs: List[dict], owner: Optional[dict] = None) -> List[dict]:
+        applied = []
+        for obj in objs:
+            applied.append(self._apply_one(copy.deepcopy(obj), owner))
+        return applied
+
+    def _apply_one(self, desired: dict, owner: Optional[dict]) -> dict:
+        meta = desired.setdefault("metadata", {})
+        meta.setdefault("labels", {})[consts.STATE_LABEL] = self.name
+        if owner is not None:
+            meta["ownerReferences"] = [owner_reference(owner)]
+        if desired.get("kind") == "DaemonSet":
+            meta.setdefault("annotations", {})[consts.SPEC_HASH_ANNOTATION] = object_hash(desired.get("spec", {}))
+
+        api_version, kind = desired["apiVersion"], desired["kind"]
+        name, namespace = meta["name"], meta.get("namespace")
+        try:
+            current = self.client.get(api_version, kind, name, namespace)
+        except NotFoundError:
+            log.info("state %s: creating %s/%s", self.name, kind, name)
+            return self.client.create(desired)
+
+        if kind == "DaemonSet":
+            current_hash = deep_get(current, "metadata", "annotations", consts.SPEC_HASH_ANNOTATION)
+            if current_hash == meta["annotations"][consts.SPEC_HASH_ANNOTATION]:
+                return current  # unchanged: skip write (object_controls.go:4316)
+
+        for path in _PRESERVE_ON_UPDATE.get(kind, []):
+            value = deep_get(current, *path)
+            if value is not None:
+                node = desired
+                for step in path[:-1]:
+                    node = node.setdefault(step, {})
+                node.setdefault(path[-1], value)
+
+        desired["metadata"]["resourceVersion"] = current["metadata"].get("resourceVersion")
+        if "status" in current:
+            desired.setdefault("status", current["status"])
+        log.info("state %s: updating %s/%s", self.name, kind, name)
+        try:
+            return self.client.update(desired)
+        except ConflictError:
+            # lost a write race; the next reconcile sweep re-applies
+            return current
+
+    # -- readiness ------------------------------------------------------------
+    def get_sync_state(self, objs: List[dict]) -> SyncState:
+        for obj in objs:
+            check = _READINESS.get(obj.get("kind"))
+            if check is None:
+                continue
+            meta = obj.get("metadata", {})
+            try:
+                live = self.client.get(obj["apiVersion"], obj["kind"], meta["name"], meta.get("namespace"))
+            except NotFoundError:
+                return SyncState.NOT_READY
+            if not check(live):
+                log.info("state %s: %s/%s not ready", self.name, obj.get("kind"), meta.get("name"))
+                return SyncState.NOT_READY
+        return SyncState.READY
+
+    # -- deletion (state disabled) -------------------------------------------
+    def delete_objs(self, objs: List[dict]) -> None:
+        for obj in objs:
+            meta = obj.get("metadata", {})
+            try:
+                self.client.delete(obj["apiVersion"], obj["kind"], meta["name"], meta.get("namespace"))
+            except NotFoundError:
+                pass
+
+    def list_owned(self, api_version: str, kind: str, namespace: Optional[str] = None) -> List[dict]:
+        return self.client.list(api_version, kind, namespace,
+                                label_selector={consts.STATE_LABEL: self.name})
