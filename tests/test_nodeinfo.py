@@ -1,0 +1,124 @@
+from tpu_operator import consts
+from tpu_operator.api.clusterpolicy import ClusterPolicy, new_cluster_policy
+from tpu_operator.clusterinfo import ClusterInfo
+from tpu_operator.nodeinfo import (
+    NodeAttributes,
+    NodeFilter,
+    is_tpu_node,
+    label_tpu_nodes,
+    tpu_capacity,
+)
+
+
+def mk_node(name, labels=None, capacity=None, runtime="containerd://1.7.13"):
+    return {
+        "apiVersion": "v1", "kind": "Node",
+        "metadata": {"name": name, "labels": labels or {}},
+        "status": {
+            "capacity": capacity or {},
+            "nodeInfo": {"containerRuntimeVersion": runtime, "kubeletVersion": "v1.31.0"},
+        },
+    }
+
+
+GKE_TPU_LABELS = {
+    consts.GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice",
+    consts.GKE_TPU_TOPOLOGY_LABEL: "2x4",
+    "kubernetes.io/arch": "amd64",
+    "kubernetes.io/os": "linux",
+    "kubernetes.io/hostname": "tpu-node-1",
+}
+
+
+def test_is_tpu_node_signals():
+    assert is_tpu_node(mk_node("a", GKE_TPU_LABELS))
+    assert is_tpu_node(mk_node("b", {consts.TPU_PRESENT_LABEL: "true"}))
+    assert is_tpu_node(mk_node("c", {}, {"google.com/tpu": "4"}))
+    assert not is_tpu_node(mk_node("d", {"kubernetes.io/os": "linux"}))
+
+
+def test_node_attributes():
+    attrs = NodeAttributes.from_node(mk_node("a", GKE_TPU_LABELS, {"google.com/tpu": "4"}))
+    assert attrs.accelerator == "tpu-v5-lite-podslice"
+    assert attrs.topology == "2x4"
+    assert attrs.chip_count == 4
+    assert attrs.hostname == "tpu-node-1"
+    assert tpu_capacity(mk_node("x")) == 0
+
+
+def test_node_filter():
+    nodes = [mk_node("a", GKE_TPU_LABELS), mk_node("b", {"x": "1"})]
+    assert len(NodeFilter().with_label(consts.GKE_TPU_ACCELERATOR_LABEL).apply(nodes)) == 1
+    assert NodeFilter().with_label("x", "2").apply(nodes) == []
+
+
+def policy(spec=None):
+    return ClusterPolicy.from_obj(new_cluster_policy(spec=spec or {}))
+
+
+def test_label_tpu_nodes_applies_state_labels(fake_client):
+    fake_client.create(mk_node("tpu-1", GKE_TPU_LABELS))
+    fake_client.create(mk_node("cpu-1"))
+    result = label_tpu_nodes(fake_client, policy())
+    assert result.tpu_nodes == 1 and result.labeled == 1
+    labels = fake_client.get("v1", "Node", "tpu-1")["metadata"]["labels"]
+    assert labels[consts.TPU_PRESENT_LABEL] == "true"
+    assert labels[consts.deploy_label("driver")] == "true"
+    assert labels[consts.deploy_label("device-plugin")] == "true"
+    # slice partitioner is opt-in -> no label by default
+    assert consts.deploy_label("slice-partitioner") not in labels
+    cpu_labels = fake_client.get("v1", "Node", "cpu-1")["metadata"]["labels"] or {}
+    assert consts.TPU_PRESENT_LABEL not in cpu_labels
+
+
+def test_label_tpu_nodes_honors_kill_switch(fake_client):
+    labels = dict(GKE_TPU_LABELS)
+    labels[consts.deploy_label("telemetry")] = "false"
+    fake_client.create(mk_node("tpu-1", labels))
+    label_tpu_nodes(fake_client, policy())
+    live = fake_client.get("v1", "Node", "tpu-1")["metadata"]["labels"]
+    assert live[consts.deploy_label("telemetry")] == "false"
+
+
+def test_label_tpu_nodes_removes_labels_for_disabled_operand(fake_client):
+    fake_client.create(mk_node("tpu-1", GKE_TPU_LABELS))
+    label_tpu_nodes(fake_client, policy())
+    label_tpu_nodes(fake_client, policy({"telemetry": {"enabled": False}}))
+    live = fake_client.get("v1", "Node", "tpu-1")["metadata"]["labels"]
+    assert consts.deploy_label("telemetry") not in live
+
+
+def test_label_cleanup_when_node_loses_tpu(fake_client):
+    fake_client.create(mk_node("tpu-1", GKE_TPU_LABELS))
+    label_tpu_nodes(fake_client, policy())
+    # node relabeled: no longer a TPU node
+    node = fake_client.get("v1", "Node", "tpu-1")
+    del node["metadata"]["labels"][consts.GKE_TPU_ACCELERATOR_LABEL]
+    node["metadata"]["labels"][consts.TPU_PRESENT_LABEL] = "stale"
+    fake_client.update(node)
+    result = label_tpu_nodes(fake_client, policy())
+    assert result.cleaned == 1
+    live = fake_client.get("v1", "Node", "tpu-1")["metadata"]["labels"]
+    assert consts.TPU_PRESENT_LABEL not in live
+    assert not any(k.startswith(consts.DEPLOY_LABEL_PREFIX) for k in live)
+
+
+def test_label_idempotent(fake_client):
+    fake_client.create(mk_node("tpu-1", GKE_TPU_LABELS))
+    label_tpu_nodes(fake_client, policy())
+    result = label_tpu_nodes(fake_client, policy())
+    assert result.labeled == 0  # no second write
+
+
+def test_cluster_info(fake_client):
+    fake_client.create(mk_node("a", runtime="containerd://1.7.13"))
+    fake_client.create(mk_node("b", runtime="containerd://1.7.13"))
+    fake_client.create(mk_node("c", runtime="docker://24.0"))
+    info = ClusterInfo(fake_client)
+    assert info.kubernetes_version() == "v1.31.0-fake"
+    assert info.container_runtime() == "containerd"
+
+
+def test_cluster_info_empty_cluster(fake_client):
+    info = ClusterInfo(fake_client)
+    assert info.container_runtime() == "containerd"

@@ -218,6 +218,9 @@ class FakeClient(Client):
             updated["metadata"]["generation"] = saved_gen
             return updated
 
+    def server_version(self) -> str:
+        return "v1.31.0-fake"
+
     # -- watches -------------------------------------------------------------
     def watch(self, api_version, kind, namespace=None, handler=None) -> WatchHandle:
         with self._lock:

@@ -150,6 +150,11 @@ class RestClient(Client):
         self._raise_for(resp)
         return resp.json()
 
+    def server_version(self) -> str:
+        resp = self._session.get(f"{self.base_url}/version")
+        self._raise_for(resp)
+        return resp.json().get("gitVersion", "unknown")
+
     # -- watch ---------------------------------------------------------------
     def watch(self, api_version, kind, namespace=None, handler=None) -> WatchHandle:
         return _RestWatch(self, api_version, kind, namespace, handler)
