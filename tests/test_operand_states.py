@@ -1,0 +1,135 @@
+import pytest
+
+from tpu_operator import consts
+from tpu_operator.api.clusterpolicy import ClusterPolicy, new_cluster_policy
+from tpu_operator.state.manager import (
+    INFO_CLUSTER_POLICY,
+    INFO_NAMESPACE,
+    InfoCatalog,
+    Manager,
+)
+from tpu_operator.state.operands import cluster_policy_states
+from tpu_operator.state.skel import SyncState
+from tpu_operator.utils import deep_get
+
+
+@pytest.fixture(autouse=True)
+def default_images(monkeypatch):
+    monkeypatch.setenv("DRIVER_IMAGE", "gcr.io/tpu/tpu-validator:0.1.0")
+    monkeypatch.setenv("VALIDATOR_IMAGE", "gcr.io/tpu/tpu-validator:0.1.0")
+    monkeypatch.setenv("DEVICE_PLUGIN_IMAGE", "gcr.io/tpu/device-plugin:0.1.0")
+    monkeypatch.setenv("FEATURE_DISCOVERY_IMAGE", "gcr.io/tpu/tpu-validator:0.1.0")
+    monkeypatch.setenv("TELEMETRY_EXPORTER_IMAGE", "gcr.io/tpu/tpu-validator:0.1.0")
+    monkeypatch.setenv("SLICE_PARTITIONER_IMAGE", "gcr.io/tpu/tpu-validator:0.1.0")
+
+
+def policy(spec=None):
+    return ClusterPolicy.from_obj(new_cluster_policy(spec=spec or {}))
+
+
+def catalog(p):
+    c = InfoCatalog()
+    c[INFO_CLUSTER_POLICY] = p
+    c[INFO_NAMESPACE] = "tpu-operator"
+    return c
+
+
+def render_all(fake_client, spec=None):
+    p = policy(spec)
+    out = {}
+    for state in cluster_policy_states(fake_client):
+        if hasattr(state, "render_objects"):
+            try:
+                out[state.name] = state.render_objects(p, "tpu-operator")
+            except TypeError:
+                out[state.name] = state.renderer.render_objects({"namespace": "tpu-operator"})
+        else:
+            out[state.name] = state.renderer.render_objects({"namespace": "tpu-operator"})
+    return out
+
+
+def test_all_states_render(fake_client):
+    spec = {"slicePartitioner": {"enabled": True}}
+    rendered = render_all(fake_client, spec)
+    assert set(rendered) == {
+        "pre-requisites", "state-operator-metrics", "state-driver",
+        "state-operator-validation", "state-device-plugin",
+        "state-feature-discovery", "state-telemetry",
+        "state-node-status-exporter", "state-slice-partitioner",
+    }
+    for name, objs in rendered.items():
+        assert objs, f"{name} rendered nothing"
+        for obj in objs:
+            assert obj.get("kind"), f"{name}: object missing kind"
+            assert deep_get(obj, "metadata", "name"), f"{name}: object missing name"
+
+
+def test_daemonsets_are_gated_and_tolerant(fake_client):
+    rendered = render_all(fake_client, {"slicePartitioner": {"enabled": True}})
+    for name, objs in rendered.items():
+        for obj in objs:
+            if obj["kind"] != "DaemonSet":
+                continue
+            pod = obj["spec"]["template"]["spec"]
+            sel = pod["nodeSelector"]
+            assert any(k.startswith(consts.DEPLOY_LABEL_PREFIX) for k in sel), \
+                f"{name}: DS not gated on a deploy label"
+            assert any(t.get("key") == consts.TPU_RESOURCE_NAME for t in pod["tolerations"]), \
+                f"{name}: DS missing TPU taint toleration"
+
+
+def test_operands_wait_on_driver_barrier(fake_client):
+    rendered = render_all(fake_client, {"slicePartitioner": {"enabled": True}})
+    for name in ("state-device-plugin", "state-telemetry", "state-slice-partitioner"):
+        ds = [o for o in rendered[name] if o["kind"] == "DaemonSet"][0]
+        inits = ds["spec"]["template"]["spec"]["initContainers"]
+        assert any("wait" in c["name"] for c in inits), f"{name}: missing driver wait init"
+
+
+def test_validator_ds_has_validation_chain(fake_client):
+    rendered = render_all(fake_client)
+    ds = [o for o in rendered["state-operator-validation"] if o["kind"] == "DaemonSet"][0]
+    inits = [c["name"] for c in ds["spec"]["template"]["spec"]["initContainers"]]
+    assert inits == ["driver-validation", "plugin-validation", "workload-validation"]
+
+
+def test_manager_full_sweep_with_disabled_states(fake_client):
+    p = policy({"telemetry": {"enabled": False}})
+    manager = Manager(cluster_policy_states(fake_client))
+    results = manager.sync_state(catalog(p))
+    by_name = {r.state_name: r for r in results.results}
+    assert by_name["state-telemetry"].status == SyncState.IGNORE
+    assert by_name["state-slice-partitioner"].status == SyncState.IGNORE  # opt-in
+    # everything else applied; readiness vacuous (no nodes -> desired 0)
+    assert results.ready
+    # applied objects exist
+    assert fake_client.get("apps/v1", "DaemonSet", "libtpu-driver", "tpu-operator")
+    assert fake_client.get("apps/v1", "DaemonSet", "tpu-device-plugin", "tpu-operator")
+
+
+def test_disabling_state_deletes_objects(fake_client):
+    manager = Manager(cluster_policy_states(fake_client))
+    manager.sync_state(catalog(policy()))
+    assert fake_client.get("apps/v1", "DaemonSet", "tpu-telemetry-exporter", "tpu-operator")
+    manager.sync_state(catalog(policy({"telemetry": {"enabled": False}})))
+    from tpu_operator.client import NotFoundError
+    with pytest.raises(NotFoundError):
+        fake_client.get("apps/v1", "DaemonSet", "tpu-telemetry-exporter", "tpu-operator")
+
+
+def test_state_error_is_contained(fake_client):
+    p = policy()
+    states = cluster_policy_states(fake_client)
+
+    class Boom:
+        name = "state-boom"
+
+        def sync(self, catalog):
+            raise RuntimeError("kaboom")
+
+    manager = Manager(states[:1] + [Boom()] + states[1:])
+    results = manager.sync_state(catalog(p))
+    by_name = {r.state_name: r for r in results.results}
+    assert by_name["state-boom"].status == SyncState.ERROR
+    assert not results.ready
+    assert len(results.results) == len(states) + 1

@@ -33,12 +33,18 @@ class Renderer:
 
     TEMPLATE_SUFFIXES = (".yaml", ".yml", ".yaml.j2", ".yml.j2")
 
-    def __init__(self, templates_dir: str):
+    def __init__(self, templates_dir: str, includes_dir: str | None = None):
         if not os.path.isdir(templates_dir):
             raise RenderError(f"templates dir does not exist: {templates_dir}")
         self.templates_dir = templates_dir
+        loaders = [jinja2.FileSystemLoader(templates_dir)]
+        if includes_dir is None:
+            candidate = os.path.join(os.path.dirname(templates_dir), "_includes")
+            includes_dir = candidate if os.path.isdir(candidate) else None
+        if includes_dir:
+            loaders.append(jinja2.FileSystemLoader(includes_dir))
         self._env = jinja2.Environment(
-            loader=jinja2.FileSystemLoader(templates_dir),
+            loader=jinja2.ChoiceLoader(loaders),
             undefined=jinja2.StrictUndefined,
             trim_blocks=True,
             lstrip_blocks=True,

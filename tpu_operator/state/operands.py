@@ -1,0 +1,188 @@
+"""Operand states for the ClusterPolicy DAG.
+
+One generic :class:`OperandState` covers what the reference spreads over 4.9k
+lines of per-operand transform code (controllers/object_controls.go): each
+operand is "render this state's manifest dir with this sub-spec, apply, walk
+readiness, delete when disabled". Per-operand differences live in the
+templates plus a small ``extras`` hook here.
+
+State order mirrors the reference's registration order
+(controllers/state_manager.go:791-810) reduced to the TPU operand set.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, List, Optional
+
+from .. import consts
+from ..api.clusterpolicy import ClusterPolicy
+from ..api.common import ComponentSpec
+from ..client.interface import Client
+from ..render import Renderer
+from .driver import MANIFEST_DIR, StateDriver
+from .manager import INFO_CLUSTER_POLICY, INFO_NAMESPACE, InfoCatalog, StateResult
+from .skel import StateSkel, SyncState
+
+
+def component_data(component: ComponentSpec) -> dict:
+    return {
+        "image": component.image_path(),
+        "image_pull_policy": component.image_pull_policy,
+        "image_pull_secrets": component.image_pull_secrets,
+        "env": [{"name": e.name, "value": e.value} for e in component.env],
+        "args": list(component.args),
+        "resources": component.resources,
+    }
+
+
+class OperandState:
+    """A state that renders one manifest dir from one ClusterPolicy sub-spec."""
+
+    def __init__(
+        self,
+        name: str,
+        operand: str,
+        client: Client,
+        spec_getter: Callable[[ClusterPolicy], ComponentSpec],
+        default_enabled: bool = True,
+        extras: Optional[Callable[[ClusterPolicy], dict]] = None,
+        app_name: Optional[str] = None,
+    ):
+        self.name = name
+        self.operand = operand
+        self.client = client
+        self.spec_getter = spec_getter
+        self.default_enabled = default_enabled
+        self.extras = extras
+        self.app_name = app_name or name.replace("state-", "tpu-")
+        self.renderer = Renderer(os.path.join(MANIFEST_DIR, name))
+        self.skel = StateSkel(name, client)
+
+    def render_data(self, policy: ClusterPolicy, namespace: str) -> dict:
+        component = self.spec_getter(policy)
+        data = {
+            "app_name": self.app_name,
+            "namespace": namespace,
+            "deploy_label": consts.deploy_label(self.operand),
+            "tpu_resource": consts.TPU_RESOURCE_NAME,
+            "validation_status_dir": consts.VALIDATION_STATUS_DIR,
+            "validator_image": policy.spec.validator.image_path(),
+            "daemonsets": {
+                "update_strategy": policy.spec.daemonsets.update_strategy,
+                "rolling_update": policy.spec.daemonsets.rolling_update,
+                "priority_class_name": policy.spec.daemonsets.priority_class_name,
+                "tolerations": policy.spec.daemonsets.tolerations,
+                "annotations": policy.spec.daemonsets.annotations,
+            },
+            "component": component_data(component),
+        }
+        if self.extras:
+            data.update(self.extras(policy))
+        return data
+
+    def render_objects(self, policy: ClusterPolicy, namespace: str) -> List[dict]:
+        return self.renderer.render_objects(self.render_data(policy, namespace))
+
+    def sync(self, catalog: InfoCatalog) -> StateResult:
+        policy: ClusterPolicy = catalog.require(INFO_CLUSTER_POLICY)
+        namespace: str = catalog.require(INFO_NAMESPACE)
+        if not self.spec_getter(policy).is_enabled(self.default_enabled):
+            for kind_av in (("apps/v1", "DaemonSet"), ("v1", "Service")):
+                self.skel.delete_objs(self.skel.list_owned(*kind_av, namespace))
+            return StateResult(self.name, SyncState.IGNORE, f"{self.operand} disabled")
+        objs = self.render_objects(policy, namespace)
+        applied = self.skel.create_or_update_objs(objs, owner=policy.obj)
+        return StateResult(self.name, self.skel.get_sync_state(applied))
+
+
+class PrerequisitesState(OperandState):
+    """Cluster-scoped prerequisites (reference assets/pre-requisites/).
+
+    The GPU stack needs three RuntimeClasses here; TPUs need none (device
+    plugin mounts device nodes directly), so this reduces to a dedicated
+    PriorityClass for operand DaemonSets.
+    """
+
+    def __init__(self, client: Client):
+        super().__init__(
+            name="pre-requisites",
+            operand="driver",  # unused; state is unconditional
+            client=client,
+            spec_getter=lambda p: p.spec.driver,
+        )
+
+    def sync(self, catalog: InfoCatalog) -> StateResult:
+        policy: ClusterPolicy = catalog.require(INFO_CLUSTER_POLICY)
+        namespace: str = catalog.require(INFO_NAMESPACE)
+        objs = self.renderer.render_objects({"namespace": namespace})
+        self.skel.create_or_update_objs(objs, owner=policy.obj)
+        return StateResult(self.name, SyncState.READY)
+
+
+def telemetry_extras(policy: ClusterPolicy) -> dict:
+    t = policy.spec.telemetry
+    return {"metrics_port": t.metrics_port,
+            "service_monitor": t.service_monitor or {}}
+
+
+def node_status_exporter_extras(policy: ClusterPolicy) -> dict:
+    return {"metrics_port": policy.spec.node_status_exporter.metrics_port}
+
+
+def device_plugin_extras(policy: ClusterPolicy) -> dict:
+    dp = policy.spec.device_plugin
+    return {"resource_name": dp.resource_name, "plugin_config": dp.config or {}}
+
+
+def slice_partitioner_extras(policy: ClusterPolicy) -> dict:
+    sp = policy.spec.slice_partitioner
+    return {"partitioner_config": sp.config or {},
+            "slice_config_label": consts.TPU_SLICE_CONFIG_LABEL,
+            "slice_state_label": consts.TPU_SLICE_STATE_LABEL}
+
+
+def validator_extras(policy: ClusterPolicy) -> dict:
+    v = policy.spec.validator
+    return {
+        "driver_env": [{"name": e.name, "value": e.value} for e in v.driver.env],
+        "plugin_env": [{"name": e.name, "value": e.value} for e in v.plugin.env],
+        "workload_env": [{"name": e.name, "value": e.value} for e in v.workload.env],
+        "resource_name": policy.spec.device_plugin.resource_name,
+        "install_dir": policy.spec.driver.install_dir,
+    }
+
+
+def operator_metrics_extras(policy: ClusterPolicy) -> dict:
+    return {"operator_app": consts.OPERATOR_NAME}
+
+
+def cluster_policy_states(client: Client) -> List:
+    """The ordered state DAG for ClusterPolicy reconciles."""
+    return [
+        PrerequisitesState(client),
+        OperandState("state-operator-metrics", "driver", client,
+                     lambda p: p.spec.driver, extras=operator_metrics_extras,
+                     app_name="tpu-operator"),
+        StateDriver(client),
+        OperandState("state-operator-validation", "operator-validator", client,
+                     lambda p: p.spec.validator, extras=validator_extras,
+                     app_name="tpu-operator-validator"),
+        OperandState("state-device-plugin", "device-plugin", client,
+                     lambda p: p.spec.device_plugin, extras=device_plugin_extras,
+                     app_name="tpu-device-plugin"),
+        OperandState("state-feature-discovery", "feature-discovery", client,
+                     lambda p: p.spec.feature_discovery,
+                     app_name="tpu-feature-discovery"),
+        OperandState("state-telemetry", "telemetry", client,
+                     lambda p: p.spec.telemetry, extras=telemetry_extras,
+                     app_name="tpu-telemetry-exporter"),
+        OperandState("state-node-status-exporter", "node-status-exporter", client,
+                     lambda p: p.spec.node_status_exporter,
+                     extras=node_status_exporter_extras,
+                     app_name="tpu-node-status-exporter"),
+        OperandState("state-slice-partitioner", "slice-partitioner", client,
+                     lambda p: p.spec.slice_partitioner, default_enabled=False,
+                     extras=slice_partitioner_extras,
+                     app_name="tpu-slice-partitioner"),
+    ]
