@@ -1,0 +1,134 @@
+import time
+
+import pytest
+
+from tpu_operator import consts
+from tpu_operator.api.clusterpolicy import new_cluster_policy
+from tpu_operator.conditions import ERROR, READY, get_condition
+from tpu_operator.controllers.clusterpolicy_controller import (
+    ClusterPolicyReconciler,
+    setup_clusterpolicy_controller,
+)
+from tpu_operator.controllers.runtime import Request
+from tpu_operator.testing.kubelet import KubeletSimulator
+from tpu_operator.utils import deep_get
+
+GKE_TPU_LABELS = {
+    consts.GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice",
+    consts.GKE_TPU_TOPOLOGY_LABEL: "2x4",
+}
+
+
+@pytest.fixture(autouse=True)
+def default_images(monkeypatch):
+    for env in ("DRIVER_IMAGE", "VALIDATOR_IMAGE", "FEATURE_DISCOVERY_IMAGE",
+                "TELEMETRY_EXPORTER_IMAGE", "SLICE_PARTITIONER_IMAGE"):
+        monkeypatch.setenv(env, "gcr.io/tpu/tpu-validator:0.1.0")
+    monkeypatch.setenv("DEVICE_PLUGIN_IMAGE", "gcr.io/tpu/device-plugin:0.1.0")
+
+
+def mk_node(name, labels=None):
+    return {"apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": name, "labels": labels or {}}, "status": {}}
+
+
+def get_policy(client):
+    return client.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy")
+
+
+def test_reconcile_no_tpu_nodes_goes_ready(fake_client):
+    """BASELINE config #1: reconcile with no accelerator nodes -> ready."""
+    fake_client.create(new_cluster_policy())
+    fake_client.create(mk_node("cpu-1"))
+    r = ClusterPolicyReconciler(fake_client)
+    result = r.reconcile(Request("cluster-policy"))
+    live = get_policy(fake_client)
+    # DaemonSets exist but cover zero nodes -> vacuous ready
+    assert live["status"]["state"] == "ready"
+    assert get_condition(live, READY)["status"] == "True"
+    assert result.requeue_after is None
+
+
+def test_reconcile_tpu_nodes_until_ready(fake_client):
+    fake_client.create(new_cluster_policy())
+    fake_client.create(mk_node("tpu-1", dict(GKE_TPU_LABELS)))
+    r = ClusterPolicyReconciler(fake_client)
+    kubelet = KubeletSimulator(fake_client)
+
+    result = r.reconcile(Request("cluster-policy"))
+    live = get_policy(fake_client)
+    assert live["status"]["state"] == "notReady"  # DSes exist, pods not up yet
+    assert result.requeue_after == 5.0
+    assert get_condition(live, ERROR)["message"].startswith("state state-driver")
+
+    kubelet.tick()  # kubelet schedules DS pods; device plugin registers TPUs
+    result = r.reconcile(Request("cluster-policy"))
+    live = get_policy(fake_client)
+    assert live["status"]["state"] == "ready"
+    node = fake_client.get("v1", "Node", "tpu-1")
+    assert deep_get(node, "status", "capacity", consts.TPU_RESOURCE_NAME) == "4"
+    assert node["metadata"]["labels"][consts.deploy_label("driver")] == "true"
+
+
+def test_singleton_guard_marks_extras_ignored(fake_client):
+    fake_client.create(new_cluster_policy("cluster-policy"))
+    time.sleep(0.01)
+    fake_client.create(new_cluster_policy("impostor"))
+    r = ClusterPolicyReconciler(fake_client)
+    r.reconcile(Request("impostor"))
+    assert fake_client.get("tpu.ai/v1", "ClusterPolicy", "impostor")["status"]["state"] == "ignored"
+    # primary untouched by the impostor reconcile
+    assert "state" not in fake_client.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy").get("status", {})
+
+
+def test_reconcile_missing_policy_is_noop(fake_client):
+    r = ClusterPolicyReconciler(fake_client)
+    assert r.reconcile(Request("ghost")).requeue_after is None
+
+
+def test_metrics_updated(fake_client):
+    fake_client.create(new_cluster_policy())
+    fake_client.create(mk_node("tpu-1", dict(GKE_TPU_LABELS)))
+    r = ClusterPolicyReconciler(fake_client)
+    r.reconcile(Request("cluster-policy"))
+    scraped = r.metrics.scrape().decode()
+    assert "tpu_operator_tpu_nodes_total 1.0" in scraped
+    assert "tpu_operator_reconciliation_total 1.0" in scraped
+    assert "tpu_operator_reconciliation_status 0.0" in scraped
+    KubeletSimulator(fake_client).tick()
+    r.reconcile(Request("cluster-policy"))
+    assert "tpu_operator_reconciliation_status 1.0" in r.metrics.scrape().decode()
+
+
+def test_controller_loop_end_to_end(fake_client):
+    """Watch -> queue -> worker loop converges a CR to ready."""
+    r = ClusterPolicyReconciler(fake_client, requeue_after=0.05)
+    controller = setup_clusterpolicy_controller(fake_client, r)
+    kubelet = KubeletSimulator(fake_client, interval=0.02).start()
+    controller.start(fake_client)
+    try:
+        fake_client.create(mk_node("tpu-1", dict(GKE_TPU_LABELS)))
+        fake_client.create(new_cluster_policy())
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            try:
+                if get_policy(fake_client).get("status", {}).get("state") == "ready":
+                    break
+            except Exception:
+                pass
+            time.sleep(0.05)
+        live = get_policy(fake_client)
+        assert live["status"]["state"] == "ready"
+        # adding a new TPU node flips it back until the kubelet catches up
+        fake_client.create(mk_node("tpu-2", dict(GKE_TPU_LABELS)))
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            node = fake_client.get("v1", "Node", "tpu-2")
+            if deep_get(node, "status", "capacity", consts.TPU_RESOURCE_NAME) == "4":
+                break
+            time.sleep(0.05)
+        assert deep_get(fake_client.get("v1", "Node", "tpu-2"),
+                        "status", "capacity", consts.TPU_RESOURCE_NAME) == "4"
+    finally:
+        controller.stop()
+        kubelet.stop()

@@ -49,10 +49,25 @@ def test_list_with_selectors(fake_client):
 
 def test_update_conflict_on_stale_rv(fake_client):
     created = fake_client.create(mk_pod("p1"))
-    first = dict(created)
+    import copy
+    stale = copy.deepcopy(created)
+    created["spec"]["nodeName"] = "n1"
     fake_client.update(created)
+    stale["spec"]["nodeName"] = "n2"
     with pytest.raises(ConflictError):
-        fake_client.update(first)
+        fake_client.update(stale)
+
+
+def test_noop_update_does_not_bump_rv_or_notify(fake_client):
+    # mirrors the real apiserver: identical PUT is a no-op (no watch event),
+    # which is what keeps status-writing controllers from self-triggering
+    created = fake_client.create(mk_pod("p1"))
+    seen = []
+    fake_client.watch("v1", "Pod", handler=seen.append)
+    updated = fake_client.update(created)
+    assert updated["metadata"]["resourceVersion"] == created["metadata"]["resourceVersion"]
+    fake_client.update_status(updated)  # empty -> empty status: also a no-op
+    assert seen == []
 
 
 def test_update_bumps_generation_only_on_spec_change(fake_client):

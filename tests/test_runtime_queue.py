@@ -1,0 +1,77 @@
+import time
+
+from tpu_operator.controllers.runtime import RateLimitingQueue, Request
+
+
+def test_dedup_pending():
+    q = RateLimitingQueue()
+    q.add(Request("a"))
+    q.add(Request("a"))
+    q.add(Request("b"))
+    assert len(q) == 2
+
+
+def test_delay_delivery_order():
+    q = RateLimitingQueue()
+    q.add(Request("slow"), delay=0.15)
+    q.add(Request("fast"))
+    assert q.get(timeout=1).name == "fast"
+    start = time.monotonic()
+    assert q.get(timeout=1).name == "slow"
+    assert time.monotonic() - start >= 0.05
+
+
+def test_rate_limited_backoff_grows():
+    q = RateLimitingQueue()
+    r = Request("x")
+    q.add_rate_limited(r)
+    assert q.get(timeout=1) == r
+    start = time.monotonic()
+    q.add_rate_limited(r)
+    assert q.get(timeout=2) == r
+    second_delay = time.monotonic() - start
+    assert second_delay >= 0.15  # 0.1 * 2^1
+    q.forget(r)
+    q.add_rate_limited(r)
+    start = time.monotonic()
+    assert q.get(timeout=1) == r
+    assert time.monotonic() - start < 0.15  # reset to base
+
+
+def test_immediate_add_overrides_pending_delay():
+    # a watch event must not wait out a pending 5s requeue (decrease-key)
+    q = RateLimitingQueue()
+    q.add(Request("x"), delay=5.0)
+    q.add(Request("x"))
+    start = time.monotonic()
+    assert q.get(timeout=1).name == "x"
+    assert time.monotonic() - start < 0.5
+    assert len(q) == 0  # the stale 5s entry is gone from accounting
+
+
+def test_later_add_does_not_extend_earlier_delay():
+    q = RateLimitingQueue()
+    q.add(Request("x"), delay=0.05)
+    q.add(Request("x"), delay=5.0)
+    start = time.monotonic()
+    assert q.get(timeout=1).name == "x"
+    assert time.monotonic() - start < 0.5
+
+
+def test_get_timeout_returns_none():
+    q = RateLimitingQueue()
+    assert q.get(timeout=0.05) is None
+
+
+def test_shutdown_unblocks():
+    q = RateLimitingQueue()
+    import threading
+    got = []
+    t = threading.Thread(target=lambda: got.append(q.get()))
+    t.start()
+    time.sleep(0.05)
+    q.shutdown()
+    t.join(timeout=1)
+    assert got == [None]
+    q.add(Request("after"))  # no-op after shutdown
+    assert len(q) == 0

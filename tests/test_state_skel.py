@@ -80,7 +80,11 @@ def test_daemonset_readiness_math():
 def test_get_sync_state_walks_applied_objects(fake_client):
     skel = StateSkel("s", fake_client)
     applied = skel.create_or_update_objs([mk_ds()])
-    assert skel.get_sync_state(applied) == SyncState.READY  # desired=0 vacuous
+    assert skel.get_sync_state(applied) == SyncState.READY  # no nodes: vacuous
+    for n in ("n1", "n2"):
+        fake_client.create({"apiVersion": "v1", "kind": "Node", "metadata": {"name": n}})
+    # nodes exist but DS status still empty -> fresh-DS race must be notReady
+    assert skel.get_sync_state(applied) == SyncState.NOT_READY
     live = fake_client.get("apps/v1", "DaemonSet", "ds1", "tpu-operator")
     live["status"] = {"desiredNumberScheduled": 2, "numberAvailable": 1, "updatedNumberScheduled": 2}
     fake_client.update_status(live)

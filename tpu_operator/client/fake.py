@@ -164,6 +164,13 @@ class FakeClient(Client):
             sent_rv = meta.get("resourceVersion")
             if sent_rv is not None and sent_rv != current["metadata"]["resourceVersion"]:
                 raise ConflictError(f"resourceVersion conflict on {obj['kind']}/{meta['name']}")
+            # no-op writes don't bump resourceVersion or emit events, matching
+            # the real apiserver (prevents self-sustaining watch loops)
+            normalized = copy.deepcopy(obj)
+            normalized["metadata"] = {**current["metadata"],
+                                      **{k: v for k, v in meta.items() if k != "resourceVersion"}}
+            if normalized == current:
+                return copy.deepcopy(current)
             meta["uid"] = current["metadata"]["uid"]
             meta["creationTimestamp"] = current["metadata"]["creationTimestamp"]
             meta["resourceVersion"] = self._next_rv()
@@ -210,6 +217,8 @@ class FakeClient(Client):
         with self._lock:
             meta = obj.get("metadata", {})
             current = self.get(obj["apiVersion"], obj["kind"], meta["name"], meta.get("namespace"))
+            if current.get("status", {}) == obj.get("status", {}):
+                return current  # no-op status write
             current["status"] = copy.deepcopy(obj.get("status", {}))
             current["metadata"].pop("resourceVersion", None)
             # status updates must not bump generation

@@ -52,29 +52,48 @@ def set_condition(conditions: List[dict], new: dict) -> List[dict]:
     return conditions
 
 
+def mark_ready(obj: dict, message: str = "All operands are ready") -> None:
+    """Mutate obj.status.conditions to Ready; caller persists the status."""
+    _mark(obj, [
+        make_condition(READY, "True", REASON_READY, message),
+        make_condition(ERROR, "False", REASON_READY, ""),
+    ])
+
+
+def mark_error(obj: dict, reason: str, message: str) -> None:
+    _mark(obj, [
+        make_condition(READY, "False", reason, ""),
+        make_condition(ERROR, "True", reason, message),
+    ])
+
+
+def _mark(obj: dict, new_conditions: List[dict]) -> None:
+    conditions = obj.setdefault("status", {}).setdefault("conditions", [])
+    for c in new_conditions:
+        set_condition(conditions, c)
+
+
 class Updater:
-    """Writes Ready/Error condition pairs to a CR's status subresource."""
+    """Writes Ready/Error condition pairs to a CR's status subresource.
+
+    Prefer the pure :func:`mark_ready`/:func:`mark_error` + one explicit
+    ``update_status`` when the caller also changes other status fields —
+    status and conditions must land in a single write so readers never see a
+    ready state with stale conditions.
+    """
 
     def __init__(self, client: Client):
         self._client = client
 
     def set_ready(self, obj: dict, message: str = "All operands are ready") -> None:
-        self._apply(obj, [
-            make_condition(READY, "True", REASON_READY, message),
-            make_condition(ERROR, "False", REASON_READY, ""),
-        ])
+        mark_ready(obj, message)
+        self._write(obj)
 
     def set_error(self, obj: dict, reason: str, message: str) -> None:
-        self._apply(obj, [
-            make_condition(READY, "False", reason, ""),
-            make_condition(ERROR, "True", reason, message),
-        ])
+        mark_error(obj, reason, message)
+        self._write(obj)
 
-    def _apply(self, obj: dict, new_conditions: List[dict]) -> None:
-        status = obj.setdefault("status", {})
-        conditions = status.setdefault("conditions", [])
-        for c in new_conditions:
-            set_condition(conditions, c)
+    def _write(self, obj: dict) -> None:
         try:
             self._client.update_status(obj)
         except (ConflictError, NotFoundError):

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 from .. import consts
 from ..api.clusterpolicy import ClusterPolicy
@@ -28,6 +28,8 @@ class LabelResult:
     tpu_nodes: int = 0
     labeled: int = 0
     cleaned: int = 0
+    #: post-labeling node snapshot, reusable by the same reconcile sweep
+    nodes: List[dict] = dataclasses.field(default_factory=list)
 
 
 def operand_enabled(policy: ClusterPolicy, operand: str) -> bool:
@@ -51,14 +53,23 @@ def desired_state_labels(policy: ClusterPolicy) -> Dict[str, str]:
     return labels
 
 
+def _apply_label_patch(node: dict, patch: Dict[str, Optional[str]]) -> None:
+    labels = node.setdefault("metadata", {}).setdefault("labels", {})
+    for key, value in patch.items():
+        if value is None:
+            labels.pop(key, None)
+        else:
+            labels[key] = value
+
+
 def label_tpu_nodes(client: Client, policy: ClusterPolicy) -> LabelResult:
-    result = LabelResult()
-    for node in client.list("v1", "Node"):
+    result = LabelResult(nodes=client.list("v1", "Node"))
+    for node in result.nodes:
         name = node["metadata"]["name"]
         labels = deep_get(node, "metadata", "labels", default={}) or {}
         if is_tpu_node(node):
             result.tpu_nodes += 1
-            patch: Dict[str, str] = {}
+            patch: Dict[str, Optional[str]] = {}
             for key, value in desired_state_labels(policy).items():
                 if labels.get(key) == "false" and key != consts.TPU_PRESENT_LABEL:
                     continue  # per-node kill switch wins
@@ -72,6 +83,7 @@ def label_tpu_nodes(client: Client, policy: ClusterPolicy) -> LabelResult:
             if patch:
                 log.info("labeling TPU node %s: %s", name, patch)
                 client.patch("v1", "Node", name, {"metadata": {"labels": patch}})
+                _apply_label_patch(node, patch)  # keep the snapshot current
                 result.labeled += 1
         else:
             stale = [k for k in labels
@@ -79,6 +91,7 @@ def label_tpu_nodes(client: Client, policy: ClusterPolicy) -> LabelResult:
             if stale:
                 log.info("cleaning TPU labels from node %s", name)
                 client.patch("v1", "Node", name, {"metadata": {"labels": {k: None for k in stale}}})
+                _apply_label_patch(node, {k: None for k in stale})
                 result.cleaned += 1
     return result
 

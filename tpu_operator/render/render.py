@@ -4,10 +4,17 @@ Analog of the reference's internal/render (render.go:49-151): Go templates +
 sprig with ``missingkey=error``. Here: jinja2 with StrictUndefined (the same
 fail-on-missing contract), a ``toyaml`` filter standing in for sprig's, and
 multi-document YAML splitting.
+
+Unlike the reference — which re-reads and re-renders every asset on every
+reconcile sweep (SURVEY.md 3.2 "each sweep re-reads and re-transforms every
+asset") — rendering is memoised on (template set, render data): level-driven
+sweeps re-render only when the CR spec or cluster facts actually changed.
 """
 
 from __future__ import annotations
 
+import copy
+import json
 import os
 from typing import Any, Dict, List
 
@@ -51,6 +58,7 @@ class Renderer:
             keep_trailing_newline=True,
         )
         self._env.filters["toyaml"] = _to_yaml
+        self._cache: Dict[str, List[dict]] = {}
 
     def template_files(self) -> List[str]:
         return sorted(
@@ -78,7 +86,15 @@ class Renderer:
         return objs
 
     def render_objects(self, data: Dict[str, Any]) -> List[dict]:
-        objs: List[dict] = []
-        for name in self.template_files():
-            objs.extend(self.render_file(name, data))
-        return objs
+        # the canonical JSON itself is the key: collision-free, unlike a 32-bit hash
+        key = json.dumps(data, sort_keys=True, separators=(",", ":"), default=str)
+        cached = self._cache.get(key)
+        if cached is None:
+            objs: List[dict] = []
+            for name in self.template_files():
+                objs.extend(self.render_file(name, data))
+            if len(self._cache) > 64:  # bound memory across many pools
+                self._cache.clear()
+            self._cache[key] = objs
+            cached = objs
+        return copy.deepcopy(cached)

@@ -18,7 +18,13 @@ from .. import consts
 from ..api.clusterpolicy import ClusterPolicy
 from ..client.interface import Client
 from ..render import Renderer
-from .manager import INFO_CLUSTER_POLICY, INFO_NAMESPACE, InfoCatalog, StateResult
+from .manager import (
+    INFO_CLUSTER_POLICY,
+    INFO_NAMESPACE,
+    INFO_NODES,
+    InfoCatalog,
+    StateResult,
+)
 from .skel import StateSkel, SyncState
 
 MANIFEST_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "manifests")
@@ -91,5 +97,5 @@ class StateDriver:
             return StateResult(self.name, SyncState.IGNORE, "driver disabled")
         objs = self.render_objects(policy, namespace)
         applied = self.skel.create_or_update_objs(objs, owner=policy.obj)
-        status = self.skel.get_sync_state(applied)
+        status = self.skel.get_sync_state(applied, nodes=catalog.get(INFO_NODES))
         return StateResult(self.name, status)

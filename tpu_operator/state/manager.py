@@ -20,6 +20,8 @@ INFO_CLUSTER_POLICY = "cluster-policy"
 INFO_TPU_DRIVER = "tpu-driver"
 INFO_CLUSTER_INFO = "cluster-info"
 INFO_NAMESPACE = "namespace"
+#: per-sweep Node snapshot, shared so states don't each re-LIST the cluster
+INFO_NODES = "nodes"
 
 
 class InfoCatalog(dict):

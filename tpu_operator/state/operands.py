@@ -21,7 +21,13 @@ from ..api.common import ComponentSpec
 from ..client.interface import Client
 from ..render import Renderer
 from .driver import MANIFEST_DIR, StateDriver
-from .manager import INFO_CLUSTER_POLICY, INFO_NAMESPACE, InfoCatalog, StateResult
+from .manager import (
+    INFO_CLUSTER_POLICY,
+    INFO_NAMESPACE,
+    INFO_NODES,
+    InfoCatalog,
+    StateResult,
+)
 from .skel import StateSkel, SyncState
 
 
@@ -93,7 +99,8 @@ class OperandState:
             return StateResult(self.name, SyncState.IGNORE, f"{self.operand} disabled")
         objs = self.render_objects(policy, namespace)
         applied = self.skel.create_or_update_objs(objs, owner=policy.obj)
-        return StateResult(self.name, self.skel.get_sync_state(applied))
+        status = self.skel.get_sync_state(applied, nodes=catalog.get(INFO_NODES))
+        return StateResult(self.name, status)
 
 
 class PrerequisitesState(OperandState):

@@ -42,17 +42,36 @@ def owner_reference(owner: dict, controller: bool = True) -> dict:
 
 # -- readiness predicates (state_skel.go:414-444, object_controls.go:3525) ----
 
-def is_daemonset_ready(ds: dict) -> bool:
+def is_daemonset_ready(ds: dict, expected_nodes: Optional[int] = None) -> bool:
+    """DS readiness (reference state_skel.go:414-444) hardened against the
+    fresh-DS race: a just-created DaemonSet reports desired=0 before the DS
+    controller sweeps, which must not read as "ready" when nodes should match.
+
+    Freshness signal: ``status.observedGeneration`` — the DS controller has
+    seen this spec. Only when that is absent (controller hasn't written status
+    at all yet) fall back to comparing desired against a nodeSelector label
+    count; the DS controller's own desired is authoritative otherwise (it also
+    accounts for taints/affinity, which a label count cannot)."""
     status = ds.get("status", {})
     desired = status.get("desiredNumberScheduled", 0)
+    observed = status.get("observedGeneration")
+    generation = deep_get(ds, "metadata", "generation", default=1)
+    if observed is not None:
+        if observed < generation:
+            return False  # stale status for an updated spec
+    elif expected_nodes is not None and desired != expected_nodes:
+        return False  # fresh DS: no status yet but nodes should match
     if desired == 0:
-        # no eligible nodes -> vacuously ready (reference treats 0-node DS as
-        # ready at the DaemonSet layer; node-gating happens in the controller)
-        return True
+        return True  # genuinely no eligible nodes
     return (
         status.get("numberAvailable", 0) == desired
         and status.get("updatedNumberScheduled", 0) == desired
     )
+
+
+def node_matches_selector(node: dict, selector: dict) -> bool:
+    labels = deep_get(node, "metadata", "labels", default={}) or {}
+    return all(labels.get(k) == v for k, v in (selector or {}).items())
 
 
 def is_deployment_ready(dep: dict) -> bool:
@@ -140,7 +159,9 @@ class StateSkel:
             return current
 
     # -- readiness ------------------------------------------------------------
-    def get_sync_state(self, objs: List[dict]) -> SyncState:
+    def get_sync_state(self, objs: List[dict], nodes: Optional[List[dict]] = None) -> SyncState:
+        """Walk readiness of applied objects. ``nodes`` lets the caller share
+        one per-sweep Node snapshot instead of one LIST per DS-bearing state."""
         for obj in objs:
             check = _READINESS.get(obj.get("kind"))
             if check is None:
@@ -150,7 +171,15 @@ class StateSkel:
                 live = self.client.get(obj["apiVersion"], obj["kind"], meta["name"], meta.get("namespace"))
             except NotFoundError:
                 return SyncState.NOT_READY
-            if not check(live):
+            if obj["kind"] == "DaemonSet":
+                if nodes is None:
+                    nodes = self.client.list("v1", "Node")
+                selector = deep_get(live, "spec", "template", "spec", "nodeSelector", default={})
+                expected = sum(1 for n in nodes if node_matches_selector(n, selector))
+                ok = is_daemonset_ready(live, expected_nodes=expected)
+            else:
+                ok = check(live)
+            if not ok:
                 log.info("state %s: %s/%s not ready", self.name, obj.get("kind"), meta.get("name"))
                 return SyncState.NOT_READY
         return SyncState.READY
