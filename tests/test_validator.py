@@ -1,0 +1,227 @@
+import json
+import os
+import threading
+
+import pytest
+
+from tpu_operator import consts
+from tpu_operator.validator import driver as driver_mod
+from tpu_operator.validator import feature_discovery, plugin
+from tpu_operator.validator.main import run as validator_run
+from tpu_operator.validator.metrics import NodeMetrics
+from tpu_operator.validator.status import StatusFiles
+from tpu_operator.validator.workload import ici_health_check, spawn_workload_pod
+
+
+@pytest.fixture
+def status(tmp_path):
+    return StatusFiles(str(tmp_path / "validations"))
+
+
+@pytest.fixture
+def fake_devs(tmp_path, monkeypatch):
+    devdir = tmp_path / "dev"
+    devdir.mkdir()
+    for i in range(4):
+        (devdir / f"accel{i}").touch()
+    monkeypatch.setenv("TPU_DEV_GLOBS", str(devdir / "accel*"))
+    return devdir
+
+
+# -- status files -------------------------------------------------------------
+
+def test_status_write_read_wait(status):
+    assert not status.is_ready("driver")
+    path = status.write("driver", {"libtpu": "/x/libtpu.so"})
+    assert os.path.exists(path)
+    assert status.is_ready("driver")
+    assert status.read("driver")["libtpu"] == "/x/libtpu.so"
+    assert status.ready_components() == ["driver"]
+    assert status.wait_for("driver", timeout=0.1)
+    status.clear("driver")
+    assert not status.wait_for("driver", timeout=0.15, poll=0.05)
+    status.write("a")
+    status.write("b")
+    status.clear_all()
+    assert status.ready_components() == []
+
+
+# -- driver -------------------------------------------------------------------
+
+def test_driver_validate_and_probe(tmp_path, status, fake_devs, monkeypatch):
+    install = tmp_path / "libtpu"
+    install.mkdir()
+    assert not driver_mod.validate(str(install), status)
+    assert not driver_mod.probe(str(install))
+    (install / "libtpu.so").write_bytes(b"\x7fELF fake")
+    assert driver_mod.validate(str(install), status)
+    assert driver_mod.probe(str(install))
+    assert status.read("driver")["devices"]
+    # no device nodes -> fails unless device check disabled
+    monkeypatch.setenv("TPU_DEV_GLOBS", str(tmp_path / "none*"))
+    assert not driver_mod.validate(str(install), status)
+    assert driver_mod.validate(str(install), status, require_devices=False)
+
+
+def test_driver_install_from_bundled(tmp_path, status, fake_devs, monkeypatch):
+    src = tmp_path / "src-libtpu.so"
+    src.write_bytes(b"\x7fELF bundled libtpu")
+    monkeypatch.setenv("LIBTPU_SRC", str(src))
+    install = tmp_path / "install"
+    assert driver_mod.install(str(install), "2025.1.0", status)
+    assert (install / "libtpu.so").read_bytes() == src.read_bytes()
+    assert status.read("driver")["libtpu_version"] == "2025.1.0"
+
+
+def test_driver_install_keeps_preinstalled(tmp_path, status, fake_devs, monkeypatch):
+    monkeypatch.delenv("LIBTPU_SRC", raising=False)
+    monkeypatch.setattr(driver_mod, "find_bundled_libtpu", lambda: None)
+    install = tmp_path / "install"
+    install.mkdir()
+    assert not driver_mod.install(str(install), status=status)  # nothing anywhere
+    (install / "libtpu.so").write_bytes(b"preinstalled")
+    assert driver_mod.install(str(install), status=status)
+
+
+# -- plugin -------------------------------------------------------------------
+
+def test_plugin_validate_waits_for_resource(fake_client, status, monkeypatch):
+    monkeypatch.setenv("NODE_NAME", "n1")
+    fake_client.create({"apiVersion": "v1", "kind": "Node", "metadata": {"name": "n1"},
+                        "status": {}})
+
+    def register():
+        node = fake_client.get("v1", "Node", "n1")
+        node["status"]["allocatable"] = {consts.TPU_RESOURCE_NAME: "4"}
+        fake_client.update_status(node)
+
+    t = threading.Timer(0.2, register)
+    t.start()
+    assert plugin.validate(fake_client, status=status, timeout=5.0, poll=0.05)
+    assert status.read("plugin")["count"] == 4
+
+
+def test_plugin_validate_times_out(fake_client, status, monkeypatch):
+    monkeypatch.setenv("NODE_NAME", "n1")
+    fake_client.create({"apiVersion": "v1", "kind": "Node", "metadata": {"name": "n1"},
+                        "status": {}})
+    assert not plugin.validate(fake_client, status=status, timeout=0.2, poll=0.05)
+    assert not status.is_ready("plugin")
+
+
+# -- workload -----------------------------------------------------------------
+
+def test_ici_health_check_cpu_mesh():
+    report = ici_health_check(matrix_dim=64)
+    assert report.passed
+    assert report.n_devices == 8
+    assert all(d["passed"] for d in report.details.values())
+
+
+def test_spawn_workload_pod_succeeds(fake_client, monkeypatch):
+    fake_client.create({"apiVersion": "v1", "kind": "Node", "metadata": {"name": "n1"},
+                        "status": {"allocatable": {consts.TPU_RESOURCE_NAME: "4"}}})
+
+    def succeed_pods():
+        for pod in fake_client.list("v1", "Pod", "tpu-operator"):
+            pod["status"] = {"phase": "Succeeded"}
+            fake_client.update_status(pod)
+
+    t = threading.Timer(0.2, succeed_pods)
+    t.start()
+    ok = spawn_workload_pod(fake_client, "tpu-operator", "n1", "img:1",
+                            timeout=5.0, poll=0.05)
+    assert ok
+    # pod cleaned up afterwards
+    assert fake_client.list("v1", "Pod", "tpu-operator") == []
+
+
+def test_spawn_workload_pod_requests_all_chips(fake_client):
+    fake_client.create({"apiVersion": "v1", "kind": "Node", "metadata": {"name": "n1"},
+                        "status": {"allocatable": {consts.TPU_RESOURCE_NAME: "8"}}})
+
+    captured = {}
+    original = fake_client.create
+
+    def spy(obj):
+        if obj["kind"] == "Pod":
+            captured["limits"] = obj["spec"]["containers"][0]["resources"]["limits"]
+            captured["node"] = obj["spec"]["nodeName"]
+        return original(obj)
+
+    fake_client.create = spy
+    spawn_workload_pod(fake_client, "tpu-operator", "n1", "img:1", timeout=0.1, poll=0.02)
+    assert captured["limits"] == {consts.TPU_RESOURCE_NAME: "8"}
+    assert captured["node"] == "n1"
+
+
+# -- feature discovery --------------------------------------------------------
+
+def test_feature_discovery_passthrough_and_count(fake_client, fake_devs, monkeypatch):
+    monkeypatch.setenv("TPU_FD_SKIP_JAX", "1")
+    fake_client.create({
+        "apiVersion": "v1", "kind": "Node",
+        "metadata": {"name": "n1", "labels": {
+            consts.GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice",
+            consts.GKE_TPU_TOPOLOGY_LABEL: "2x4"}},
+        "status": {}})
+    feature_discovery.sync_node_labels(fake_client, "n1")
+    labels = fake_client.get("v1", "Node", "n1")["metadata"]["labels"]
+    assert labels[consts.TPU_CHIP_TYPE_LABEL] == "tpu-v5-lite-podslice"
+    assert labels[consts.TPU_TOPOLOGY_LABEL] == "2x4"
+    assert labels[consts.TPU_CHIP_COUNT_LABEL] == "4"  # from fake device nodes
+    # second pass: no drift, no patch
+    rv = fake_client.get("v1", "Node", "n1")["metadata"]["resourceVersion"]
+    feature_discovery.sync_node_labels(fake_client, "n1")
+    assert fake_client.get("v1", "Node", "n1")["metadata"]["resourceVersion"] == rv
+
+
+def test_chip_type_mapping():
+    assert feature_discovery.chip_type_from_kind("TPU v5 lite") == "tpu-v5-lite-podslice"
+    assert feature_discovery.chip_type_from_kind("TPU v4") == "tpu-v4"
+    assert feature_discovery.chip_type_from_kind("Something Odd") == "something-odd"
+
+
+# -- node metrics -------------------------------------------------------------
+
+def test_node_metrics_reflect_status_files(status, fake_devs):
+    m = NodeMetrics(status=status)
+    m.refresh()
+    text = m.scrape().decode()
+    assert "tpu_operator_node_driver_ready 0.0" in text
+    assert "tpu_operator_node_tpu_device_nodes 4.0" in text
+    status.write("driver")
+    status.write("workload")
+    m.refresh()
+    text = m.scrape().decode()
+    assert "tpu_operator_node_driver_ready 1.0" in text
+    assert "tpu_operator_node_workload_ready 1.0" in text
+    assert "tpu_operator_node_plugin_ready 0.0" in text
+
+
+# -- CLI ----------------------------------------------------------------------
+
+def test_cli_driver_probe_exit_codes(tmp_path, fake_devs):
+    install = tmp_path / "libtpu"
+    install.mkdir()
+    assert validator_run(["-c", "driver-probe", f"--install-dir={install}"]) == 1
+    (install / "libtpu.so").write_bytes(b"x")
+    assert validator_run(["-c", "driver-probe", f"--install-dir={install}"]) == 0
+
+
+def test_cli_wait_barrier(tmp_path):
+    sd = str(tmp_path / "v")
+    assert validator_run(["-c", "wait", "--for=driver", "--timeout=0.1",
+                          f"--status-dir={sd}"]) == 1
+    StatusFiles(sd).write("driver")
+    assert validator_run(["-c", "wait", "--for=driver", "--timeout=0.1",
+                          f"--status-dir={sd}"]) == 0
+
+
+def test_cli_workload_local(tmp_path, capsys):
+    sd = str(tmp_path / "v")
+    rc = validator_run(["-c", "workload-local", "--matrix-dim=64", f"--status-dir={sd}"])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out.strip())
+    assert report["passed"] and report["n_devices"] == 8
+    assert StatusFiles(sd).is_ready("workload")
