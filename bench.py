@@ -1,0 +1,106 @@
+"""North-star benchmark: fresh TPU node-pool join -> schedulable + validated.
+
+Measures the two halves of BASELINE.md's target ("node join -> google.com/tpu
+schedulable in <120 s on a v5e-16 pool, allreduce validator passing on all
+chips"):
+
+1. control plane: a 4-node pool joins a cluster (in-process mini apiserver,
+   kubelet simulator standing in for node agents); time from node creation to
+   every node advertising google.com/tpu AND the ClusterPolicy reporting
+   ready.
+2. data plane: the validator's ICI health sweep (MXU matmul + psum + ppermute
+   ring + all_gather) on the real accelerator this host has, including XLA
+   compile — the per-node cost of the workload validation barrier.
+
+value = control_plane_s + validation_s; vs_baseline = value / 120 (the
+baseline budget; < 1.0 beats the target). Prints exactly one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def bench_control_plane(n_nodes: int = 4, timeout: float = 115.0) -> float:
+    for env, image in (
+        ("DRIVER_IMAGE", "gcr.io/tpu/tpu-validator:0.1.0"),
+        ("VALIDATOR_IMAGE", "gcr.io/tpu/tpu-validator:0.1.0"),
+        ("FEATURE_DISCOVERY_IMAGE", "gcr.io/tpu/tpu-validator:0.1.0"),
+        ("TELEMETRY_EXPORTER_IMAGE", "gcr.io/tpu/tpu-validator:0.1.0"),
+        ("SLICE_PARTITIONER_IMAGE", "gcr.io/tpu/tpu-validator:0.1.0"),
+        ("DEVICE_PLUGIN_IMAGE", "gcr.io/tpu/device-plugin:0.1.0"),
+    ):
+        os.environ.setdefault(env, image)
+
+    from tpu_operator import consts
+    from tpu_operator.api.clusterpolicy import new_cluster_policy
+    from tpu_operator.client.rest import RestClient
+    from tpu_operator.controllers.manager import OperatorApp
+    from tpu_operator.testing import MiniApiServer
+    from tpu_operator.testing.kubelet import KubeletSimulator
+    from tpu_operator.utils import deep_get
+
+    srv = MiniApiServer()
+    base = srv.start()
+    seed = RestClient(base_url=base)
+    seed.create(new_cluster_policy())
+    app = OperatorApp(RestClient(base_url=base))
+    kubelet = KubeletSimulator(seed, interval=0.05)
+    app.start()
+    kubelet.start()
+    try:
+        t0 = time.monotonic()
+        for i in range(n_nodes):
+            seed.create({"apiVersion": "v1", "kind": "Node",
+                         "metadata": {"name": f"tpu-{i}", "labels": {
+                             consts.GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice",
+                             consts.GKE_TPU_TOPOLOGY_LABEL: "4x4"}},
+                         "status": {}})
+        while time.monotonic() - t0 < timeout:
+            nodes = seed.list("v1", "Node")
+            schedulable = sum(
+                1 for n in nodes
+                if deep_get(n, "status", "capacity", consts.TPU_RESOURCE_NAME) is not None)
+            cp_ready = deep_get(seed.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy"),
+                                "status", "state") == "ready"
+            if schedulable == n_nodes and cp_ready:
+                return time.monotonic() - t0
+            time.sleep(0.05)
+        return float(timeout)
+    finally:
+        app.stop()
+        kubelet.stop()
+        srv.stop()
+
+
+def bench_validation() -> dict:
+    from tpu_operator.validator.workload import ici_health_check
+
+    report = ici_health_check(matrix_dim=512)
+    return report.to_dict()
+
+
+def main() -> int:
+    control_plane_s = bench_control_plane()
+    validation = bench_validation()
+    value = round(control_plane_s + validation["elapsed_s"], 3)
+    baseline = 120.0
+    print(json.dumps({
+        "metric": "node_join_to_schedulable_plus_validation_s",
+        "value": value,
+        "unit": "s",
+        "vs_baseline": round(value / baseline, 4),
+        "control_plane_s": round(control_plane_s, 3),
+        "validation_s": validation["elapsed_s"],
+        "validator_passed": validation["passed"],
+        "validator_devices": validation["n_devices"],
+        "platform": validation["platform"],
+    }))
+    return 0 if validation["passed"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
