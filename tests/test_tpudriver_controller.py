@@ -1,0 +1,185 @@
+import pytest
+
+from tpu_operator import consts
+from tpu_operator.api.clusterpolicy import new_cluster_policy
+from tpu_operator.api.tpudriver import TPUDriver, new_tpu_driver
+from tpu_operator.conditions import ERROR, get_condition
+from tpu_operator.controllers.runtime import Request
+from tpu_operator.controllers.tpudriver_controller import (
+    INSTANCE_LABEL,
+    TPUDriverReconciler,
+    find_selector_conflicts,
+)
+from tpu_operator.state.nodepool import get_node_pools
+from tpu_operator.testing.kubelet import KubeletSimulator
+from tpu_operator.utils import deep_get
+
+
+@pytest.fixture(autouse=True)
+def default_images(monkeypatch):
+    for env in ("DRIVER_IMAGE", "VALIDATOR_IMAGE", "FEATURE_DISCOVERY_IMAGE",
+                "TELEMETRY_EXPORTER_IMAGE", "SLICE_PARTITIONER_IMAGE"):
+        monkeypatch.setenv(env, "gcr.io/tpu/tpu-validator:0.1.0")
+    monkeypatch.setenv("DEVICE_PLUGIN_IMAGE", "gcr.io/tpu/device-plugin:0.1.0")
+
+
+def mk_node(name, accelerator="tpu-v5-lite-podslice", topology="2x4", extra=None):
+    labels = {
+        consts.GKE_TPU_ACCELERATOR_LABEL: accelerator,
+        consts.GKE_TPU_TOPOLOGY_LABEL: topology,
+        consts.deploy_label("driver"): "true",
+    }
+    labels.update(extra or {})
+    return {"apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": name, "labels": labels}, "status": {}}
+
+
+def test_node_pool_partitioning():
+    nodes = [mk_node("a"), mk_node("b"),
+             mk_node("c", topology="4x4"),
+             mk_node("d", accelerator="tpu-v6e-slice", topology="2x2")]
+    pools = get_node_pools(nodes)
+    assert [(p.name, p.size) for p in pools] == [
+        ("v5-lite-podslice-2x4", 2), ("v5-lite-podslice-4x4", 1), ("v6e-slice-2x2", 1)]
+    assert pools[0].node_selector == {
+        consts.GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice",
+        consts.GKE_TPU_TOPOLOGY_LABEL: "2x4"}
+
+
+def test_selector_conflicts():
+    nodes = [mk_node("a", extra={"pool": "x"}), mk_node("b")]
+    d1 = TPUDriver.from_obj(new_tpu_driver("one"))                         # all TPU nodes... but selector defaults to tpu.present
+    d2 = TPUDriver.from_obj(new_tpu_driver("two", {"nodeSelector": {"pool": "x"}}))
+    # give nodes the present label so d1's default selector matches
+    for n in nodes:
+        n["metadata"]["labels"][consts.TPU_PRESENT_LABEL] = "true"
+    conflicts = find_selector_conflicts([d1, d2], nodes)
+    assert conflicts == {"a": ["one", "two"]}
+
+
+def setup_cluster(fake_client, n_24=2, n_44=1):
+    fake_client.create(new_cluster_policy())
+    names = []
+    for i in range(n_24):
+        fake_client.create(mk_node(f"n24-{i}"))
+        names.append(f"n24-{i}")
+    for i in range(n_44):
+        fake_client.create(mk_node(f"n44-{i}", topology="4x4"))
+        names.append(f"n44-{i}")
+    return names
+
+
+def test_reconcile_fans_out_per_pool(fake_client):
+    setup_cluster(fake_client)
+    fake_client.create(new_tpu_driver("main", {
+        "repository": "gcr.io/tpu", "image": "tpu-validator", "version": "9.9",
+        "libtpuVersion": "2025.2.0",
+        "nodeSelector": {consts.GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice"}}))
+    r = TPUDriverReconciler(fake_client)
+    result = r.reconcile(Request("main"))
+    assert result.requeue_after == 5.0  # DSes fresh, not ready yet
+    ds_list = fake_client.list("apps/v1", "DaemonSet", "tpu-operator")
+    names = sorted(d["metadata"]["name"] for d in ds_list)
+    assert names == ["libtpu-driver-main-v5-lite-podslice-2x4",
+                     "libtpu-driver-main-v5-lite-podslice-4x4"]
+    ds = ds_list[0]
+    assert ds["metadata"]["labels"][INSTANCE_LABEL] == "main"
+    ctr = ds["spec"]["template"]["spec"]["containers"][0]
+    assert ctr["image"] == "gcr.io/tpu/tpu-validator:9.9"
+    assert "--libtpu-version=2025.2.0" in ctr["args"]
+    # pool nodeSelector present alongside deploy gate
+    sel = ds["spec"]["template"]["spec"]["nodeSelector"]
+    assert sel[consts.GKE_TPU_TOPOLOGY_LABEL] in ("2x4", "4x4")
+    assert sel[consts.deploy_label("driver")] == "true"
+
+    # kubelet brings DSes up -> ready
+    KubeletSimulator(fake_client).tick()
+    result = r.reconcile(Request("main"))
+    assert result.requeue_after is None
+    live = fake_client.get("tpu.ai/v1alpha1", "TPUDriver", "main")
+    assert live["status"]["state"] == "ready"
+    assert live["status"]["pools"] == {"v5-lite-podslice-2x4": 2, "v5-lite-podslice-4x4": 1}
+
+
+def test_stale_pool_cleanup(fake_client):
+    setup_cluster(fake_client, n_24=1, n_44=1)
+    fake_client.create(new_tpu_driver("main", {"image": "img", "nodeSelector": {
+        consts.GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice"}}))
+    r = TPUDriverReconciler(fake_client)
+    r.reconcile(Request("main"))
+    assert len(fake_client.list("apps/v1", "DaemonSet", "tpu-operator")) == 2
+    # the 4x4 node leaves the fleet
+    fake_client.delete("v1", "Node", "n44-0")
+    r.reconcile(Request("main"))
+    names = [d["metadata"]["name"] for d in fake_client.list("apps/v1", "DaemonSet", "tpu-operator")]
+    assert names == ["libtpu-driver-main-v5-lite-podslice-2x4"]
+
+
+def test_conflicting_instances_blocked(fake_client):
+    setup_cluster(fake_client, n_24=1, n_44=0)
+    node = fake_client.get("v1", "Node", "n24-0")
+    node["metadata"]["labels"][consts.TPU_PRESENT_LABEL] = "true"
+    fake_client.update(node)
+    fake_client.create(new_tpu_driver("one", {"image": "img"}))
+    fake_client.create(new_tpu_driver("two", {"image": "img"}))
+    r = TPUDriverReconciler(fake_client)
+    result = r.reconcile(Request("one"))
+    assert result.requeue_after == 5.0
+    live = fake_client.get("tpu.ai/v1alpha1", "TPUDriver", "one")
+    assert live["status"]["state"] == "notReady"
+    cond = get_condition(live, ERROR)
+    assert cond["reason"] == "ConflictingNodeSelector"
+    assert fake_client.list("apps/v1", "DaemonSet", "tpu-operator") == []
+
+
+def test_requires_cluster_policy(fake_client):
+    fake_client.create(new_tpu_driver("main", {"image": "img"}))
+    r = TPUDriverReconciler(fake_client)
+    result = r.reconcile(Request("main"))
+    assert result.requeue_after == 5.0
+    live = fake_client.get("tpu.ai/v1alpha1", "TPUDriver", "main")
+    assert "ClusterPolicy" in get_condition(live, ERROR)["message"]
+
+
+def test_invalid_spec_no_requeue(fake_client):
+    setup_cluster(fake_client, n_24=0, n_44=0)
+    fake_client.create(new_tpu_driver("bad", {"driverType": "gpu", "image": "img"}))
+    r = TPUDriverReconciler(fake_client)
+    result = r.reconcile(Request("bad"))
+    assert result.requeue_after is None
+    live = fake_client.get("tpu.ai/v1alpha1", "TPUDriver", "bad")
+    assert "driverType" in get_condition(live, ERROR)["message"]
+
+
+def test_clusterpolicy_driver_state_defers_to_tpudriver(fake_client):
+    """With TPUDriver CRs present, state-driver hands over and cleans up."""
+    from tpu_operator.api.clusterpolicy import ClusterPolicy
+    from tpu_operator.state.driver import StateDriver
+    from tpu_operator.state.manager import (
+        INFO_CLUSTER_POLICY, INFO_NAMESPACE, InfoCatalog)
+
+    cp_obj = fake_client.create(new_cluster_policy())
+    state = StateDriver(fake_client)
+    catalog = InfoCatalog()
+    catalog[INFO_CLUSTER_POLICY] = ClusterPolicy.from_obj(cp_obj)
+    catalog[INFO_NAMESPACE] = "tpu-operator"
+    state.sync(catalog)
+    assert fake_client.get("apps/v1", "DaemonSet", "libtpu-driver", "tpu-operator")
+    fake_client.create(new_tpu_driver("main", {"image": "img"}))
+    result = state.sync(catalog)
+    assert result.status.value == "ignore"
+    with pytest.raises(Exception):
+        fake_client.get("apps/v1", "DaemonSet", "libtpu-driver", "tpu-operator")
+
+
+def test_deleted_instance_cascades_daemonsets(fake_client):
+    setup_cluster(fake_client, n_24=1, n_44=0)
+    fake_client.create(new_tpu_driver("main", {"image": "img", "nodeSelector": {
+        consts.GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice"}}))
+    r = TPUDriverReconciler(fake_client)
+    r.reconcile(Request("main"))
+    assert len(fake_client.list("apps/v1", "DaemonSet", "tpu-operator")) == 1
+    fake_client.delete("tpu.ai/v1alpha1", "TPUDriver", "main")
+    # fake client implements server-side ownerRef GC
+    assert fake_client.list("apps/v1", "DaemonSet", "tpu-operator") == []
+    assert r.reconcile(Request("main")).requeue_after is None

@@ -75,6 +75,11 @@ class OperatorApp:
             client, namespace=namespace, metrics=self.metrics)
         self.clusterpolicy_controller = self.manager.add(
             setup_clusterpolicy_controller(client, self.clusterpolicy_reconciler))
+        from .tpudriver_controller import TPUDriverReconciler, setup_tpudriver_controller
+
+        self.tpudriver_reconciler = TPUDriverReconciler(client, namespace=namespace)
+        self.tpudriver_controller = self.manager.add(
+            setup_tpudriver_controller(client, self.tpudriver_reconciler))
         self._metrics_port = metrics_port
         self._health_port = health_port
         self._servers: list = []

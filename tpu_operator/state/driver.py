@@ -54,9 +54,12 @@ class StateDriver:
 
     # -- render data ----------------------------------------------------------
     def render_data(self, policy: ClusterPolicy, namespace: str,
-                    overrides: Optional[DriverRenderOverrides] = None) -> dict:
+                    overrides: Optional[DriverRenderOverrides] = None,
+                    driver_spec=None) -> dict:
+        """``driver_spec`` lets the TPUDriver controller substitute a per-
+        instance spec (TPUDriverSpec shares the field shape with DriverSpec)."""
         o = overrides or DriverRenderOverrides()
-        driver = policy.spec.driver
+        driver = driver_spec if driver_spec is not None else policy.spec.driver
         return {
             "app_name": o.app_name,
             "namespace": namespace,
@@ -85,13 +88,20 @@ class StateDriver:
         }
 
     def render_objects(self, policy: ClusterPolicy, namespace: str,
-                       overrides: Optional[DriverRenderOverrides] = None) -> List[dict]:
-        return self.renderer.render_objects(self.render_data(policy, namespace, overrides))
+                       overrides: Optional[DriverRenderOverrides] = None,
+                       driver_spec=None) -> List[dict]:
+        return self.renderer.render_objects(
+            self.render_data(policy, namespace, overrides, driver_spec))
 
     # -- ClusterPolicy-path sync (one DS for all TPU nodes) -------------------
     def sync(self, catalog: InfoCatalog) -> StateResult:
         policy: ClusterPolicy = catalog.require(INFO_CLUSTER_POLICY)
         namespace: str = catalog.require(INFO_NAMESPACE)
+        if self.client.list("tpu.ai/v1alpha1", "TPUDriver"):
+            # TPUDriver instances own driver DSes now; hand over and clean up
+            # the ClusterPolicy-owned one (reference state_manager.go:951-961)
+            self.skel.delete_objs(self.skel.list_owned("apps/v1", "DaemonSet", namespace))
+            return StateResult(self.name, SyncState.IGNORE, "TPUDriver CRs own the driver")
         if not policy.spec.driver.is_enabled():
             self.skel.delete_objs(self.skel.list_owned("apps/v1", "DaemonSet", namespace))
             return StateResult(self.name, SyncState.IGNORE, "driver disabled")
