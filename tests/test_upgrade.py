@@ -1,0 +1,201 @@
+from tpu_operator import consts
+from tpu_operator.api.clusterpolicy import new_cluster_policy
+from tpu_operator.api.common import UpgradePolicySpec
+from tpu_operator.client.errors import NotFoundError
+from tpu_operator.controllers.runtime import Request
+from tpu_operator.controllers.upgrade_controller import SINGLETON_REQUEST, UpgradeReconciler
+from tpu_operator.upgrade import UpgradeStateMachine, node_upgrade_state
+from tpu_operator.upgrade import machine as m
+from tpu_operator.utils import deep_get
+
+NS = "tpu-operator"
+
+
+def mk_node(name):
+    return {"apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": name, "labels": {
+                consts.GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice",
+                consts.deploy_label("driver"): "true"}},
+            "spec": {}, "status": {}}
+
+
+def mk_driver_ds(image="img:2"):
+    return {"apiVersion": "apps/v1", "kind": "DaemonSet",
+            "metadata": {"name": "libtpu-driver", "namespace": NS},
+            "spec": {"template": {
+                "metadata": {"labels": {"app.kubernetes.io/component": "tpu-driver"}},
+                "spec": {"nodeSelector": {consts.deploy_label("driver"): "true"},
+                         "containers": [{"name": "libtpu-installer", "image": image,
+                                         "args": ["-c", "driver-daemon"]}]}}}}
+
+
+def mk_pod(name, node, component=None, image="img:1", phase="Running",
+           ready=True, tpu_limit=None):
+    labels = {"app.kubernetes.io/component": component} if component else {}
+    ctr = {"name": "c", "image": image, "args": ["-c", "driver-daemon"] if component == "tpu-driver" else []}
+    if tpu_limit:
+        ctr["resources"] = {"limits": {consts.TPU_RESOURCE_NAME: str(tpu_limit)}}
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "namespace": NS, "labels": labels},
+            "spec": {"nodeName": node, "containers": [ctr]},
+            "status": {"phase": phase,
+                       "conditions": [{"type": "Ready", "status": "True" if ready else "False"}]}}
+
+
+def setup(fake_client, n_nodes=1, old_image="img:1", new_image="img:2"):
+    nodes = []
+    fake_client.create(mk_driver_ds(new_image))
+    for i in range(n_nodes):
+        node = fake_client.create(mk_node(f"tpu-{i}"))
+        fake_client.create(mk_pod(f"drv-{i}", f"tpu-{i}", "tpu-driver", old_image))
+        fake_client.create(mk_pod(f"val-{i}", f"tpu-{i}", "tpu-operator-validator", "v:1"))
+        nodes.append(node)
+    return nodes
+
+
+def machine(fake_client, **kw):
+    policy = UpgradePolicySpec.from_dict({"autoUpgrade": True, **kw})
+    return UpgradeStateMachine(fake_client, NS, policy)
+
+
+def fresh_nodes(fake_client):
+    return fake_client.list("v1", "Node")
+
+
+def test_full_upgrade_flow_single_node(fake_client):
+    setup(fake_client)
+    fake_client.create(mk_pod("workload", "tpu-0", None, "user:1", tpu_limit=4))
+    sm = machine(fake_client, drain={"enable": True})
+
+    counts = sm.process(fresh_nodes(fake_client))
+    assert counts.pending == 1
+    assert node_upgrade_state(fake_client.get("v1", "Node", "tpu-0")) == m.UPGRADE_REQUIRED
+
+    counts = sm.process(fresh_nodes(fake_client))
+    node = fake_client.get("v1", "Node", "tpu-0")
+    assert node_upgrade_state(node) == m.POD_RESTART_REQUIRED
+    assert node["spec"]["unschedulable"] is True
+    # TPU-consuming workload evicted; outdated driver pod deleted
+    names = [p["metadata"]["name"] for p in fake_client.list("v1", "Pod", NS)]
+    assert "workload" not in names and "drv-0" not in names
+    assert counts.in_progress == 1
+
+    # DS controller restarts the driver pod with the new template
+    fake_client.create(mk_pod("drv-0-new", "tpu-0", "tpu-driver", "img:2"))
+    counts = sm.process(fresh_nodes(fake_client))
+    node = fake_client.get("v1", "Node", "tpu-0")
+    assert node_upgrade_state(node) == m.DONE
+    assert not node["spec"].get("unschedulable")
+    assert counts.done == 1
+
+    counts = sm.process(fresh_nodes(fake_client))
+    assert node_upgrade_state(fake_client.get("v1", "Node", "tpu-0")) == m.UNKNOWN
+    assert counts.available == 1
+
+
+def test_max_parallel_throttle(fake_client):
+    setup(fake_client, n_nodes=3)
+    sm = machine(fake_client, maxParallelUpgrades=1)
+    sm.process(fresh_nodes(fake_client))  # all -> upgrade-required
+    counts = sm.process(fresh_nodes(fake_client))
+    assert counts.in_progress == 1
+    assert counts.pending == 2
+    states = sorted(node_upgrade_state(n) for n in fresh_nodes(fake_client))
+    assert states.count(m.UPGRADE_REQUIRED) == 2
+    assert states.count(m.POD_RESTART_REQUIRED) == 1
+
+
+def test_validation_gate_blocks_uncordon(fake_client):
+    setup(fake_client)
+    fake_client.delete("v1", "Pod", "val-0", NS)
+    sm = machine(fake_client)
+    sm.process(fresh_nodes(fake_client))
+    sm.process(fresh_nodes(fake_client))
+    fake_client.create(mk_pod("drv-0-new", "tpu-0", "tpu-driver", "img:2"))
+    sm.process(fresh_nodes(fake_client))
+    node = fake_client.get("v1", "Node", "tpu-0")
+    assert node_upgrade_state(node) == m.VALIDATION_REQUIRED
+    assert node["spec"]["unschedulable"] is True
+    # validator comes up green -> uncordon + done
+    fake_client.create(mk_pod("val-0", "tpu-0", "tpu-operator-validator", "v:1"))
+    sm.process(fresh_nodes(fake_client))
+    node = fake_client.get("v1", "Node", "tpu-0")
+    assert node_upgrade_state(node) == m.DONE
+    assert not node["spec"].get("unschedulable")
+
+
+def test_failed_driver_pod_marks_failed(fake_client):
+    setup(fake_client)
+    sm = machine(fake_client)
+    sm.process(fresh_nodes(fake_client))
+    sm.process(fresh_nodes(fake_client))
+    fake_client.create(mk_pod("drv-0-new", "tpu-0", "tpu-driver", "img:2", phase="Failed", ready=False))
+    counts = sm.process(fresh_nodes(fake_client))
+    assert counts.failed == 1
+    assert node_upgrade_state(fake_client.get("v1", "Node", "tpu-0")) == m.FAILED
+
+
+def test_skip_drain_label(fake_client):
+    setup(fake_client)
+    node = fake_client.get("v1", "Node", "tpu-0")
+    node["metadata"]["labels"][consts.UPGRADE_SKIP_DRAIN_LABEL] = "true"
+    fake_client.update(node)
+    fake_client.create(mk_pod("bystander", "tpu-0", None, "user:1"))  # no TPU limit
+    sm = machine(fake_client, drain={"enable": True})
+    sm.process(fresh_nodes(fake_client))
+    sm.process(fresh_nodes(fake_client))
+    # drain skipped: non-TPU bystander pod survives
+    assert fake_client.get("v1", "Pod", "bystander", NS)
+
+
+def test_wait_for_jobs_selector(fake_client):
+    setup(fake_client)
+    fake_client.create(mk_pod("job-pod", "tpu-0", None, "user:1"))
+    job = fake_client.get("v1", "Pod", "job-pod", NS)
+    job["metadata"]["labels"]["job"] = "training"
+    fake_client.update(job)
+    sm = machine(fake_client, waitForCompletion={"podSelector": "job=training"})
+    sm.process(fresh_nodes(fake_client))
+    sm.process(fresh_nodes(fake_client))
+    assert node_upgrade_state(fake_client.get("v1", "Node", "tpu-0")) == m.WAIT_FOR_JOBS_REQUIRED
+    # job finishes
+    job = fake_client.get("v1", "Pod", "job-pod", NS)
+    job["status"]["phase"] = "Succeeded"
+    fake_client.update_status(job)
+    sm.process(fresh_nodes(fake_client))
+    assert node_upgrade_state(fake_client.get("v1", "Node", "tpu-0")) == m.POD_RESTART_REQUIRED
+
+
+def test_no_upgrade_needed_stays_clear(fake_client):
+    setup(fake_client, old_image="img:2")  # pods already match template
+    sm = machine(fake_client)
+    counts = sm.process(fresh_nodes(fake_client))
+    assert counts.available == 1
+    assert node_upgrade_state(fake_client.get("v1", "Node", "tpu-0")) == m.UNKNOWN
+
+
+def test_upgrade_reconciler_disabled_clears_labels(fake_client):
+    setup(fake_client)
+    node = fake_client.get("v1", "Node", "tpu-0")
+    node["metadata"]["labels"][consts.UPGRADE_STATE_LABEL] = m.DRAIN_REQUIRED
+    node["spec"]["unschedulable"] = True
+    fake_client.update(node)
+    fake_client.create(new_cluster_policy())  # autoUpgrade defaults false
+    r = UpgradeReconciler(fake_client)
+    result = r.reconcile(SINGLETON_REQUEST)
+    assert result.requeue_after is None
+    node = fake_client.get("v1", "Node", "tpu-0")
+    assert consts.UPGRADE_STATE_LABEL not in node["metadata"]["labels"]
+    assert not node["spec"].get("unschedulable")
+
+
+def test_upgrade_reconciler_enabled_progresses_and_requeues(fake_client):
+    setup(fake_client)
+    fake_client.create(new_cluster_policy(spec={
+        "driver": {"upgradePolicy": {"autoUpgrade": True}}}))
+    r = UpgradeReconciler(fake_client, requeue_after=60.0)
+    result = r.reconcile(SINGLETON_REQUEST)
+    assert result.requeue_after == 60.0
+    assert node_upgrade_state(fake_client.get("v1", "Node", "tpu-0")) == m.UPGRADE_REQUIRED
+    scraped = r.metrics.scrape().decode()
+    assert "tpu_operator_nodes_upgrades_pending 1.0" in scraped

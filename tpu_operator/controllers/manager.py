@@ -80,6 +80,12 @@ class OperatorApp:
         self.tpudriver_reconciler = TPUDriverReconciler(client, namespace=namespace)
         self.tpudriver_controller = self.manager.add(
             setup_tpudriver_controller(client, self.tpudriver_reconciler))
+        from .upgrade_controller import UpgradeReconciler, setup_upgrade_controller
+
+        self.upgrade_reconciler = UpgradeReconciler(client, namespace=namespace,
+                                                    metrics=self.metrics)
+        self.upgrade_controller = self.manager.add(
+            setup_upgrade_controller(client, self.upgrade_reconciler))
         self._metrics_port = metrics_port
         self._health_port = health_port
         self._servers: list = []
