@@ -1,0 +1,118 @@
+import json
+
+import pytest
+
+from tpu_operator import consts
+from tpu_operator.partitioner import (
+    PartitionError,
+    compute_partition,
+    load_config,
+    sync_once,
+)
+from tpu_operator.partitioner.partitioner import read_handoff
+
+CONFIG = """
+version: v1
+partitions:
+  all-disabled: []
+  v5e-2x2-pair:
+    - {chips: 4, topology: 2x2}
+    - {chips: 4, topology: 2x2}
+  single-chip:
+    - {chips: 1, topology: 1x1, count: all}
+"""
+
+
+@pytest.fixture
+def config_path(tmp_path):
+    p = tmp_path / "config.yaml"
+    p.write_text(CONFIG)
+    return str(p)
+
+
+def mk_node(fake_client, config=None, state=None, chips=8):
+    labels = {consts.TPU_CHIP_COUNT_LABEL: str(chips)}
+    if config:
+        labels[consts.TPU_SLICE_CONFIG_LABEL] = config
+    if state:
+        labels[consts.TPU_SLICE_STATE_LABEL] = state
+    return fake_client.create({"apiVersion": "v1", "kind": "Node",
+                               "metadata": {"name": "n1", "labels": labels},
+                               "status": {}})
+
+
+def test_load_and_compute(config_path):
+    table = load_config(config_path)
+    assert set(table) == {"all-disabled", "v5e-2x2-pair", "single-chip"}
+    groups = compute_partition(table["v5e-2x2-pair"], total_chips=8)
+    assert [g["chips"] for g in groups] == [[0, 1, 2, 3], [4, 5, 6, 7]]
+    assert all(g["topology"] == "2x2" for g in groups)
+    singles = compute_partition(table["single-chip"], total_chips=4)
+    assert len(singles) == 4 and singles[3]["chips"] == [3]
+    assert compute_partition(table["all-disabled"], 8) == []
+
+
+def test_compute_overflow_raises():
+    with pytest.raises(PartitionError, match="more than 4 chips"):
+        compute_partition([{"chips": 4}, {"chips": 4}], total_chips=4)
+
+
+def test_sync_applies_partition(fake_client, config_path, tmp_path):
+    handoff = str(tmp_path / "handoff")
+    mk_node(fake_client, config="v5e-2x2-pair")
+    state = sync_once(fake_client, "n1", config_path, handoff)
+    assert state == "success"
+    labels = fake_client.get("v1", "Node", "n1")["metadata"]["labels"]
+    assert labels[consts.TPU_SLICE_STATE_LABEL] == "success"
+    data = read_handoff(handoff)
+    assert data["partition"] == "v5e-2x2-pair"
+    assert len(data["groups"]) == 2
+    # idempotent second pass: no rewrite needed
+    assert sync_once(fake_client, "n1", config_path, handoff) == "success"
+
+
+def test_sync_unknown_partition_fails(fake_client, config_path, tmp_path):
+    handoff = str(tmp_path / "handoff")
+    mk_node(fake_client, config="nope")
+    assert sync_once(fake_client, "n1", config_path, handoff) == "failed"
+    labels = fake_client.get("v1", "Node", "n1")["metadata"]["labels"]
+    assert labels[consts.TPU_SLICE_STATE_LABEL] == "failed"
+    assert read_handoff(handoff) is None
+
+
+def test_sync_config_change_reapplies(fake_client, config_path, tmp_path):
+    handoff = str(tmp_path / "handoff")
+    mk_node(fake_client, config="v5e-2x2-pair")
+    sync_once(fake_client, "n1", config_path, handoff)
+    fake_client.patch("v1", "Node", "n1", {"metadata": {"labels": {
+        consts.TPU_SLICE_CONFIG_LABEL: "single-chip"}}})
+    assert sync_once(fake_client, "n1", config_path, handoff) == "success"
+    assert read_handoff(handoff)["partition"] == "single-chip"
+    assert len(read_handoff(handoff)["groups"]) == 8
+
+
+def test_sync_clear_removes_state_and_handoff(fake_client, config_path, tmp_path):
+    handoff = str(tmp_path / "handoff")
+    mk_node(fake_client, config="v5e-2x2-pair")
+    sync_once(fake_client, "n1", config_path, handoff)
+    fake_client.patch("v1", "Node", "n1", {"metadata": {"labels": {
+        consts.TPU_SLICE_CONFIG_LABEL: None}}})
+    assert sync_once(fake_client, "n1", config_path, handoff) is None
+    labels = fake_client.get("v1", "Node", "n1")["metadata"]["labels"]
+    assert consts.TPU_SLICE_STATE_LABEL not in labels
+    assert read_handoff(handoff) is None
+
+
+def test_cli_component(fake_client, config_path, tmp_path, monkeypatch):
+    from tpu_operator.validator.main import run as validator_run
+
+    monkeypatch.setenv("NODE_NAME", "n1")
+    mk_node(fake_client, config="v5e-2x2-pair")
+    monkeypatch.setattr("tpu_operator.partitioner.partitioner.DEFAULT_HANDOFF_DIR",
+                        str(tmp_path / "handoff"))
+    # run one pass through the real CLI path
+    from tpu_operator.partitioner import run as part_run
+    rc = part_run(fake_client, config_path, handoff_dir=str(tmp_path / "handoff"),
+                  iterations=1)
+    assert rc == 0
+    assert read_handoff(str(tmp_path / "handoff"))["partition"] == "v5e-2x2-pair"
