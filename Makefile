@@ -1,0 +1,23 @@
+# Developer entry points (reference Makefile: unit-test, validate-* targets)
+
+PYTHON ?= python3
+
+.PHONY: test
+test:
+	$(PYTHON) -m pytest tests/ -q
+
+.PHONY: bench
+bench:
+	$(PYTHON) bench.py
+
+.PHONY: validate-samples
+validate-samples:
+	$(PYTHON) -m tpu_operator.cmd.cfg validate config/samples/*.yaml
+
+.PHONY: validate-manifests
+validate-manifests:
+	$(PYTHON) -m pytest tests/test_operand_states.py tests/test_render.py -q
+
+.PHONY: graft-check
+graft-check:
+	XLA_FLAGS="--xla_force_host_platform_device_count=8" $(PYTHON) __graft_entry__.py

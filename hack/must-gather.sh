@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# Debug-dump for support bundles (reference hack/must-gather.sh, shipped as
+# /usr/bin/gather in the operator image). Collects operator + operand +
+# node state into a tarball.
+set -uo pipefail
+
+ARTIFACT_DIR="${ARTIFACT_DIR:-/tmp/tpu-operator-must-gather-$(date +%s)}"
+NS="${OPERATOR_NAMESPACE:-tpu-operator}"
+K="${KUBECTL:-kubectl}"
+
+mkdir -p "$ARTIFACT_DIR"/{cluster,operator,operands,nodes}
+
+echo "gathering into $ARTIFACT_DIR"
+
+$K version -o yaml                          > "$ARTIFACT_DIR/cluster/version.yaml" 2>&1
+$K get nodes -o yaml                        > "$ARTIFACT_DIR/cluster/nodes.yaml" 2>&1
+$K get nodes -L tpu.ai/tpu.present,tpu.ai/tpu.chip-type,tpu.ai/tpu.topology,tpu.ai/tpu-driver-upgrade-state \
+                                            > "$ARTIFACT_DIR/cluster/node-labels.txt" 2>&1
+$K get clusterpolicies.tpu.ai -o yaml       > "$ARTIFACT_DIR/operator/clusterpolicies.yaml" 2>&1
+$K get tpudrivers.tpu.ai -o yaml            > "$ARTIFACT_DIR/operator/tpudrivers.yaml" 2>&1
+$K -n "$NS" get all -o wide                 > "$ARTIFACT_DIR/operator/all.txt" 2>&1
+$K -n "$NS" get ds,deploy,svc,cm -o yaml    > "$ARTIFACT_DIR/operands/objects.yaml" 2>&1
+$K -n "$NS" get events --sort-by=.lastTimestamp > "$ARTIFACT_DIR/operator/events.txt" 2>&1
+
+for pod in $($K -n "$NS" get pods -o name 2>/dev/null); do
+  name="${pod#pod/}"
+  $K -n "$NS" logs "$pod" --all-containers --tail=2000 \
+                                            > "$ARTIFACT_DIR/operands/$name.log" 2>&1
+  $K -n "$NS" describe "$pod"               > "$ARTIFACT_DIR/operands/$name.describe.txt" 2>&1
+done
+
+for node in $($K get nodes -l tpu.ai/tpu.present=true -o name 2>/dev/null); do
+  n="${node#node/}"
+  $K describe "$node"                       > "$ARTIFACT_DIR/nodes/$n.describe.txt" 2>&1
+done
+
+tar -C "$(dirname "$ARTIFACT_DIR")" -czf "$ARTIFACT_DIR.tar.gz" "$(basename "$ARTIFACT_DIR")"
+echo "wrote $ARTIFACT_DIR.tar.gz"

@@ -1,0 +1,71 @@
+import os
+
+import yaml
+
+from tpu_operator.cfgtool.main import run
+
+SAMPLES = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                       "config", "samples")
+
+
+def test_samples_validate(capsys):
+    files = [os.path.join(SAMPLES, f) for f in sorted(os.listdir(SAMPLES))]
+    assert files, "no sample CRs found"
+    assert run(["validate"] + files) == 0
+    out = capsys.readouterr().out
+    assert "OK" in out
+
+
+def test_validate_catches_bad_spec(tmp_path, capsys):
+    bad = tmp_path / "bad.yaml"
+    bad.write_text(yaml.safe_dump({
+        "apiVersion": "tpu.ai/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": "x"},
+        "spec": {"operator": {"defaultRuntime": "rkt"}}}))
+    assert run(["validate", str(bad)]) == 1
+    assert "defaultRuntime" in capsys.readouterr().out
+
+
+def test_validate_unsupported_kind(tmp_path, capsys):
+    doc = tmp_path / "pod.yaml"
+    doc.write_text(yaml.safe_dump({"apiVersion": "v1", "kind": "Pod",
+                                   "metadata": {"name": "p"}}))
+    assert run(["validate", str(doc)]) == 1
+
+
+def test_sample_output_round_trips(capsys):
+    assert run(["sample", "clusterpolicy"]) == 0
+    doc = yaml.safe_load(capsys.readouterr().out)
+    from tpu_operator.api.clusterpolicy import ClusterPolicy
+    assert ClusterPolicy.from_obj(doc).spec.validate() == []
+    assert run(["sample", "tpudriver"]) == 0
+    doc = yaml.safe_load(capsys.readouterr().out)
+    from tpu_operator.api.tpudriver import TPUDriver
+    assert TPUDriver.from_obj(doc).spec.validate() == []
+
+
+def test_static_deploy_manifest_parses():
+    path = os.path.join(os.path.dirname(SAMPLES), "..", "deploy", "operator.yaml")
+    with open(path) as f:
+        docs = [d for d in yaml.safe_load_all(f) if d]
+    kinds = [d["kind"] for d in docs]
+    assert kinds == ["Namespace", "ServiceAccount", "ClusterRole",
+                     "ClusterRoleBinding", "Deployment"]
+    deployment = docs[-1]
+    envs = {e["name"] for e in deployment["spec"]["template"]["spec"]["containers"][0]["env"]}
+    # every operand default-image env the operator consults must be wired
+    assert {"OPERATOR_NAMESPACE", "DRIVER_IMAGE", "VALIDATOR_IMAGE",
+            "DEVICE_PLUGIN_IMAGE", "FEATURE_DISCOVERY_IMAGE",
+            "TELEMETRY_EXPORTER_IMAGE", "SLICE_PARTITIONER_IMAGE"} <= envs
+
+
+def test_crd_manifests_parse():
+    crd_dir = os.path.join(os.path.dirname(SAMPLES), "..", "tpu_operator", "api", "crds")
+    names = []
+    for f in sorted(os.listdir(crd_dir)):
+        with open(os.path.join(crd_dir, f)) as fh:
+            doc = yaml.safe_load(fh)
+        assert doc["kind"] == "CustomResourceDefinition"
+        assert doc["spec"]["versions"][0]["subresources"] == {"status": {}}
+        names.append(doc["metadata"]["name"])
+    assert names == ["clusterpolicies.tpu.ai", "tpudrivers.tpu.ai"]

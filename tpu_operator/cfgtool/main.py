@@ -1,0 +1,113 @@
+"""``tpuop-cfg``: configuration validation CLI (reference cmd/gpuop-cfg:
+validates ClusterPolicy samples + CSV image digests in CI).
+
+Subcommands:
+  validate <file.yaml>...   parse + spec-validate ClusterPolicy/TPUDriver docs
+  sample [clusterpolicy|tpudriver]   print a complete sample CR
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import yaml
+
+from ..api.clusterpolicy import CLUSTER_POLICY_KIND, ClusterPolicy
+from ..api.common import SpecValidationError
+from ..api.tpudriver import TPU_DRIVER_KIND, TPUDriver
+
+SAMPLE_CLUSTER_POLICY = {
+    "apiVersion": "tpu.ai/v1",
+    "kind": "ClusterPolicy",
+    "metadata": {"name": "cluster-policy"},
+    "spec": {
+        "operator": {"defaultRuntime": "containerd"},
+        "daemonsets": {"updateStrategy": "RollingUpdate",
+                       "priorityClassName": "system-node-critical"},
+        "driver": {"enabled": True, "repository": "gcr.io/my-project",
+                   "image": "tpu-validator", "version": "0.1.0",
+                   "libtpuVersion": "2025.1.0",
+                   "upgradePolicy": {"autoUpgrade": False, "maxParallelUpgrades": 1}},
+        "devicePlugin": {"enabled": True, "repository": "gcr.io/my-project",
+                         "image": "tpu-device-plugin", "version": "0.1.0",
+                         "resourceName": "google.com/tpu"},
+        "featureDiscovery": {"enabled": True, "repository": "gcr.io/my-project",
+                             "image": "tpu-validator", "version": "0.1.0"},
+        "telemetry": {"enabled": True, "repository": "gcr.io/my-project",
+                      "image": "tpu-validator", "version": "0.1.0",
+                      "metricsPort": 9400},
+        "nodeStatusExporter": {"enabled": True, "repository": "gcr.io/my-project",
+                               "image": "tpu-validator", "version": "0.1.0"},
+        "validator": {"enabled": True, "repository": "gcr.io/my-project",
+                      "image": "tpu-validator", "version": "0.1.0"},
+        "slicePartitioner": {"enabled": False},
+        "cdi": {"enabled": False},
+    },
+}
+
+SAMPLE_TPU_DRIVER = {
+    "apiVersion": "tpu.ai/v1alpha1",
+    "kind": "TPUDriver",
+    "metadata": {"name": "v5e-pool"},
+    "spec": {
+        "driverType": "standard",
+        "repository": "gcr.io/my-project",
+        "image": "tpu-validator",
+        "version": "0.1.0",
+        "libtpuVersion": "2025.1.0",
+        "nodeSelector": {"cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice"},
+    },
+}
+
+
+def validate_doc(doc: dict) -> list:
+    kind = doc.get("kind")
+    if kind == CLUSTER_POLICY_KIND:
+        return ClusterPolicy.from_obj(doc).spec.validate()
+    if kind == TPU_DRIVER_KIND:
+        return TPUDriver.from_obj(doc).spec.validate()
+    return [f"unsupported kind {kind!r} (expected ClusterPolicy or TPUDriver)"]
+
+
+def run(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="tpuop-cfg")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    v = sub.add_parser("validate")
+    v.add_argument("files", nargs="+")
+    s = sub.add_parser("sample")
+    s.add_argument("kind", nargs="?", default="clusterpolicy",
+                   choices=["clusterpolicy", "tpudriver"])
+    args = p.parse_args(argv)
+
+    if args.cmd == "sample":
+        sample = SAMPLE_CLUSTER_POLICY if args.kind == "clusterpolicy" else SAMPLE_TPU_DRIVER
+        print(yaml.safe_dump(sample, sort_keys=False))
+        return 0
+
+    failed = False
+    for path in args.files:
+        try:
+            with open(path) as f:
+                docs = [d for d in yaml.safe_load_all(f) if d]
+        except (OSError, yaml.YAMLError) as e:
+            print(f"{path}: unreadable: {e}")
+            failed = True
+            continue
+        for doc in docs:
+            name = doc.get("metadata", {}).get("name", "?")
+            try:
+                errors = validate_doc(doc)
+            except SpecValidationError as e:
+                errors = [str(e)]
+            if errors:
+                failed = True
+                for err in errors:
+                    print(f"{path}: {doc.get('kind')}/{name}: {err}")
+            else:
+                print(f"{path}: {doc.get('kind')}/{name}: OK")
+    return 1 if failed else 0
+
+
+def main(argv=None) -> int:
+    return run(argv)
