@@ -1,0 +1,148 @@
+"""Device plugin driven exactly the way a kubelet drives it: gRPC over unix
+sockets (ListAndWatch stream, Allocate, Registration round-trip)."""
+
+import os
+import threading
+import time
+from concurrent import futures
+
+import grpc
+import pytest
+
+from tpu_operator.deviceplugin import TPUDevicePlugin
+from tpu_operator.deviceplugin import grpc_api
+from tpu_operator.deviceplugin.proto import deviceplugin_pb2 as pb
+from tpu_operator.partitioner.partitioner import write_handoff
+
+
+@pytest.fixture
+def fake_devs(tmp_path, monkeypatch):
+    devdir = tmp_path / "dev"
+    devdir.mkdir()
+    for i in range(4):
+        (devdir / f"accel{i}").touch()
+    monkeypatch.setenv("TPU_DEV_GLOBS", str(devdir / "accel*"))
+    return devdir
+
+
+@pytest.fixture
+def plugin(tmp_path, fake_devs):
+    p = TPUDevicePlugin(plugin_dir=str(tmp_path / "kubelet"),
+                        libtpu_dir=str(tmp_path / "libtpu"),
+                        handoff_dir=str(tmp_path / "handoff"),
+                        health_interval=0.2)
+    socket_path = p.start()
+    channel = grpc.insecure_channel(f"unix://{socket_path}")
+    stub = grpc_api.DevicePluginStub(channel)
+    yield p, stub, tmp_path
+    channel.close()
+    p.stop()
+
+
+def test_list_and_watch_advertises_chips(plugin):
+    p, stub, _ = plugin
+    stream = stub.ListAndWatch(pb.Empty())
+    first = next(stream)
+    assert sorted(d.ID for d in first.devices) == ["tpu-0", "tpu-1", "tpu-2", "tpu-3"]
+    assert all(d.health == "Healthy" for d in first.devices)
+
+
+def test_list_and_watch_pushes_partition_change(plugin):
+    p, stub, tmp_path = plugin
+    stream = stub.ListAndWatch(pb.Empty())
+    assert len(next(stream).devices) == 4
+    # partitioner applies a 2x2 pair -> 2 schedulable units
+    write_handoff([{"topology": "2x2", "chips": [0, 1, 2, 3]},
+                   {"topology": "2x2", "chips": [4, 5, 6, 7]}],
+                  "v5e-2x2-pair", str(tmp_path / "handoff"))
+    p.refresh_units()
+    update = next(stream)
+    assert sorted(d.ID for d in update.devices) == ["tpu-part-0", "tpu-part-1"]
+
+
+def test_allocate_returns_devices_mounts_envs(plugin, tmp_path):
+    p, stub, base = plugin
+    os.makedirs(base / "libtpu", exist_ok=True)
+    resp = stub.Allocate(pb.AllocateRequest(container_requests=[
+        pb.ContainerAllocateRequest(devicesIDs=["tpu-1", "tpu-2"])]))
+    c = resp.container_responses[0]
+    assert c.envs["TPU_VISIBLE_CHIPS"] == "1,2"
+    assert c.envs["TPU_CHIPS_PER_HOST_BOUNDS"] == "2"
+    assert len(c.devices) == 4  # all device nodes exposed
+    assert all(d.permissions == "rw" for d in c.devices)
+    assert c.mounts[0].read_only and c.mounts[0].host_path.endswith("libtpu")
+
+
+def test_allocate_partitioned_unit_sets_topology(plugin):
+    p, stub, tmp_path = plugin
+    write_handoff([{"topology": "2x2", "chips": [0, 1, 2, 3]}],
+                  "pair", str(tmp_path / "handoff"))
+    p.refresh_units()
+    resp = stub.Allocate(pb.AllocateRequest(container_requests=[
+        pb.ContainerAllocateRequest(devicesIDs=["tpu-part-0"])]))
+    c = resp.container_responses[0]
+    assert c.envs["TPU_TOPOLOGY"] == "2x2"
+    assert c.envs["TPU_VISIBLE_CHIPS"] == "0,1,2,3"
+
+
+def test_allocate_unknown_device_rejected(plugin):
+    _, stub, _ = plugin
+    with pytest.raises(grpc.RpcError) as err:
+        stub.Allocate(pb.AllocateRequest(container_requests=[
+            pb.ContainerAllocateRequest(devicesIDs=["ghost"])]))
+    assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+
+def test_preferred_allocation_contiguous(plugin):
+    _, stub, _ = plugin
+    resp = stub.GetPreferredAllocation(pb.PreferredAllocationRequest(
+        container_requests=[pb.ContainerPreferredAllocationRequest(
+            available_deviceIDs=["tpu-3", "tpu-0", "tpu-2"],
+            must_include_deviceIDs=["tpu-2"],
+            allocation_size=2)]))
+    assert list(resp.container_responses[0].deviceIDs) == ["tpu-2", "tpu-0"]
+
+
+def test_registration_round_trip(plugin, tmp_path):
+    """Fake kubelet: accept Register, then call the plugin back like kubelet."""
+    p, _, base = plugin
+    registered = {}
+
+    class FakeKubelet:
+        def Register(self, request, context):
+            registered["resource"] = request.resource_name
+            registered["endpoint"] = request.endpoint
+            registered["version"] = request.version
+            return pb.Empty()
+
+    kubelet_socket = str(base / "kubelet" / "kubelet.sock")
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+    grpc_api.add_registration_servicer(server, FakeKubelet())
+    server.add_insecure_port(f"unix://{kubelet_socket}")
+    server.start()
+    try:
+        p.register(kubelet_socket)
+        assert registered == {"resource": "google.com/tpu",
+                              "endpoint": "tpu.sock",
+                              "version": "v1beta1"}
+        # kubelet now dials the advertised endpoint
+        endpoint = os.path.join(os.path.dirname(kubelet_socket), registered["endpoint"])
+        with grpc.insecure_channel(f"unix://{endpoint}") as ch:
+            opts = grpc_api.DevicePluginStub(ch).GetDevicePluginOptions(pb.Empty())
+        assert opts.get_preferred_allocation_available is True
+    finally:
+        server.stop(grace=1)
+
+
+def test_health_loop_detects_chip_loss(plugin, fake_devs):
+    p, stub, _ = plugin
+    stream = stub.ListAndWatch(pb.Empty())
+    assert len(next(stream).devices) == 4
+    (fake_devs / "accel3").unlink()  # a chip disappears
+    deadline = time.monotonic() + 5
+    update = None
+    while time.monotonic() < deadline:
+        update = next(stream)
+        if len(update.devices) == 3:
+            break
+    assert update is not None and len(update.devices) == 3

@@ -40,7 +40,8 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["driver", "driver-daemon", "driver-probe", "plugin",
                             "workload", "workload-local", "workload-multihost",
                             "wait", "sleep", "metrics", "telemetry",
-                            "feature-discovery", "slice-partitioner"])
+                            "feature-discovery", "slice-partitioner",
+                            "device-plugin"])
     p.add_argument("--install-dir", default=consts.DEFAULT_LIBTPU_DIR)
     p.add_argument("--libtpu-version", default=None)
     p.add_argument("--status-dir", default=os.environ.get("STATUS_DIR", consts.VALIDATION_STATUS_DIR))
@@ -165,6 +166,13 @@ def run(argv=None, client=None) -> int:
 
         client = client or make_client()
         return feature_discovery.run(client, sleep_interval=args.sleep_interval)
+
+    if component == "device-plugin":
+        from ..deviceplugin import TPUDevicePlugin
+
+        plugin = TPUDevicePlugin(resource_name=args.resource,
+                                 libtpu_dir=args.install_dir)
+        return plugin.run_forever()
 
     if component == "slice-partitioner":
         from ..partitioner import run as partitioner_run
