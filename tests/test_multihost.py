@@ -1,0 +1,41 @@
+"""Multi-host slice validation: real jax.distributed rendezvous between two
+processes (4 virtual chips each), ICI sweep over all 8 global chips — the
+v5e-16 north-star path at test scale."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+@pytest.mark.slow
+def test_two_process_multihost_validation(tmp_path):
+    procs = []
+    port = 19900 + os.getpid() % 50
+    for pid in range(2):
+        env = {
+            "PATH": os.environ.get("PATH", "/usr/bin:/bin"),
+            "HOME": os.environ.get("HOME", "/root"),
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+            "PYTHONPATH": os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        }
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "tpu_operator.cmd.validator",
+             "-c", "workload-multihost",
+             f"--coordinator=127.0.0.1:{port}",
+             "--num-processes=2", f"--process-id={pid}",
+             "--matrix-dim=64", f"--status-dir={tmp_path}/v{pid}"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    reports = []
+    for i, p in enumerate(procs):
+        out, err = p.communicate(timeout=220)
+        assert p.returncode == 0, f"proc {i} failed:\n{err[-2000:]}"
+        reports.append(json.loads([l for l in out.splitlines() if l.startswith("{")][-1]))
+    for report in reports:
+        assert report["passed"] and report["n_devices"] == 8
+    # both processes wrote their workload barrier
+    for pid in range(2):
+        assert os.path.exists(f"{tmp_path}/v{pid}/workload-ready")

@@ -43,10 +43,17 @@ class IciCheckReport:
 
 
 def ici_health_check(matrix_dim: int = 512, devices=None) -> IciCheckReport:
-    """Run the 4-way ICI/MXU health sweep over all (or given) local devices."""
+    """Run the 4-way ICI/MXU health sweep over all (or given) local devices.
+
+    Multi-process safe: the input is a global sharded array (each process
+    contributes only its addressable shards) and the output is fully
+    replicated via an in-program all_gather, so every process can fetch the
+    complete per-chip result matrix.
+    """
     import jax
     import jax.numpy as jnp
-    from jax.sharding import Mesh, PartitionSpec as P
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     shard_map = jax.shard_map
 
@@ -75,13 +82,20 @@ def ici_health_check(matrix_dim: int = 512, devices=None) -> IciCheckReport:
         # 4. all_gather: every chip sees every ordinal
         gathered = jax.lax.all_gather(me, axis_name="chips")
         gather_ok = jnp.all(gathered == jnp.arange(n))
-        return jnp.stack([compute_ok, psum_ok, ring_ok, gather_ok]).astype(jnp.int32)[None, :]
+        flags = jnp.stack([compute_ok, psum_ok, ring_ok, gather_ok]).astype(jnp.int32)
+        # Scatter my row into an (n, 4) one-hot matrix and psum it: the result
+        # is the full per-chip matrix, replicated by construction on every
+        # chip (psum output is mesh-invariant), so any process can fetch it.
+        mine = jnp.zeros((n, 4), jnp.int32).at[me].set(flags)
+        return jax.lax.psum(mine, axis_name="chips")
 
     check = jax.jit(shard_map(per_chip, mesh=mesh,
-                              in_specs=P("chips"), out_specs=P("chips")))
-    ids = jnp.arange(n, dtype=jnp.int32)
+                              in_specs=P("chips"), out_specs=P()))
+    ids_host = np.arange(n, dtype=np.int32)
+    ids = jax.make_array_from_callback(
+        (n,), NamedSharding(mesh, P("chips")), lambda idx: ids_host[idx])
     compiled_at = time.monotonic()
-    per_chip_results = jax.device_get(check(ids))  # (n, 4) 0/1 flags
+    per_chip_results = np.asarray(jax.device_get(check(ids)))  # (n, 4) 0/1 flags
     elapsed = time.monotonic() - start
 
     names = ["compute", "psum", "ring", "all_gather"]
