@@ -1,0 +1,176 @@
+"""End-to-end scenarios over the real wire path, porting the reference's
+shell e2e flow (tests/cases/*.sh -> tests/scripts/end-to-end.sh: install ->
+verify operands -> update ClusterPolicy -> operator restart -> disable/
+enable operands -> uninstall) onto the in-process harness: real RestClient +
+MiniApiServer over HTTP, KubeletSimulator standing in for node agents."""
+
+import time
+
+import pytest
+
+from tpu_operator import consts
+from tpu_operator.api.clusterpolicy import new_cluster_policy
+from tpu_operator.client.errors import NotFoundError
+from tpu_operator.client.rest import RestClient
+from tpu_operator.controllers.manager import OperatorApp
+from tpu_operator.testing import MiniApiServer
+from tpu_operator.testing.kubelet import KubeletSimulator
+from tpu_operator.utils import deep_get
+
+TPU_LABELS = {consts.GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice",
+              consts.GKE_TPU_TOPOLOGY_LABEL: "2x4"}
+
+
+@pytest.fixture(autouse=True)
+def default_images(monkeypatch):
+    for env in ("DRIVER_IMAGE", "VALIDATOR_IMAGE", "FEATURE_DISCOVERY_IMAGE",
+                "TELEMETRY_EXPORTER_IMAGE", "SLICE_PARTITIONER_IMAGE"):
+        monkeypatch.setenv(env, "gcr.io/tpu/tpu-validator:0.1.0")
+    monkeypatch.setenv("DEVICE_PLUGIN_IMAGE", "gcr.io/tpu/device-plugin:0.1.0")
+
+
+@pytest.fixture
+def cluster():
+    srv = MiniApiServer()
+    base = srv.start()
+    client = RestClient(base_url=base)
+    kubelet = KubeletSimulator(client, interval=0.03).start()
+    app = OperatorApp(RestClient(base_url=base))
+    state = {"srv": srv, "base": base, "client": client, "kubelet": kubelet, "app": app}
+    yield state
+    state["app"].stop()
+    kubelet.stop()
+    srv.stop()
+
+
+def wait_for(predicate, timeout=20.0, interval=0.05, message="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {message}")
+
+
+def policy_state(client):
+    try:
+        return deep_get(client.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy"),
+                        "status", "state")
+    except NotFoundError:
+        return None
+
+
+def test_install_verify_update_restart_uninstall(cluster):
+    client, app = cluster["client"], cluster["app"]
+
+    # -- install: nodes + CR, operator comes up -------------------------------
+    for i in range(2):
+        client.create({"apiVersion": "v1", "kind": "Node",
+                       "metadata": {"name": f"tpu-{i}", "labels": dict(TPU_LABELS)},
+                       "status": {}})
+    client.create(new_cluster_policy())
+    app.start()
+    wait_for(lambda: policy_state(client) == "ready", message="install ready")
+
+    # verify-operator.sh analog: every operand object present
+    for name in ("libtpu-driver", "tpu-device-plugin", "tpu-feature-discovery",
+                 "tpu-telemetry-exporter", "tpu-node-status-exporter",
+                 "tpu-operator-validator"):
+        ds = client.get("apps/v1", "DaemonSet", name, "tpu-operator")
+        assert ds["status"]["numberAvailable"] == 2, name
+
+    # -- update-clusterpolicy.sh analog: bump driver version ------------------
+    cp = client.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy")
+    cp["spec"]["driver"] = {"repository": "gcr.io/tpu", "image": "tpu-validator",
+                            "version": "0.2.0"}
+    client.update(cp)
+
+    def driver_updated():
+        ds = client.get("apps/v1", "DaemonSet", "libtpu-driver", "tpu-operator")
+        image = ds["spec"]["template"]["spec"]["containers"][0]["image"]
+        return image == "gcr.io/tpu/tpu-validator:0.2.0"
+    wait_for(driver_updated, message="driver image rollout")
+    wait_for(lambda: policy_state(client) == "ready", message="ready after update")
+
+    # -- operator-restart test: stateless resume from cluster state -----------
+    app.stop()
+    # mutate the world while the operator is down
+    client.create({"apiVersion": "v1", "kind": "Node",
+                   "metadata": {"name": "tpu-late", "labels": dict(TPU_LABELS)},
+                   "status": {}})
+    cluster["app"] = app2 = OperatorApp(RestClient(base_url=cluster["base"]))
+    app2.start()
+    wait_for(lambda: deep_get(client.get("v1", "Node", "tpu-late"), "status",
+                              "capacity", consts.TPU_RESOURCE_NAME) == "4",
+             message="late node schedulable after restart")
+    wait_for(lambda: policy_state(client) == "ready", message="ready after restart")
+
+    # -- disable/enable operand ----------------------------------------------
+    cp = client.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy")
+    cp["spec"]["telemetry"] = {"enabled": False}
+    client.update(cp)
+
+    def telemetry_gone():
+        try:
+            client.get("apps/v1", "DaemonSet", "tpu-telemetry-exporter", "tpu-operator")
+            return False
+        except NotFoundError:
+            return True
+    wait_for(telemetry_gone, message="telemetry DS deleted")
+    # node deploy label removed too
+    wait_for(lambda: consts.deploy_label("telemetry") not in
+             (client.get("v1", "Node", "tpu-0")["metadata"].get("labels") or {}),
+             message="telemetry deploy label removed")
+
+    cp = client.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy")
+    cp["spec"]["telemetry"] = {"enabled": True}
+    client.update(cp)
+    wait_for(lambda: not telemetry_gone(), message="telemetry DS recreated")
+
+    # -- uninstall: delete CR -> ownerRef GC removes all operands -------------
+    client.delete("tpu.ai/v1", "ClusterPolicy", "cluster-policy")
+    wait_for(lambda: client.list("apps/v1", "DaemonSet", "tpu-operator") == [],
+             message="operand GC on uninstall")
+
+
+def test_tpudriver_e2e_over_wire(cluster):
+    """tests/cases/nvidia-driver.sh analog: drive the TPUDriver CRD path."""
+    client, app = cluster["client"], cluster["app"]
+    for i, topo in enumerate(["2x4", "2x4", "4x4"]):
+        labels = dict(TPU_LABELS)
+        labels[consts.GKE_TPU_TOPOLOGY_LABEL] = topo
+        client.create({"apiVersion": "v1", "kind": "Node",
+                       "metadata": {"name": f"tpu-{i}", "labels": labels},
+                       "status": {}})
+    client.create(new_cluster_policy())
+    app.start()
+    wait_for(lambda: policy_state(client) == "ready", message="base install")
+
+    client.create({"apiVersion": "tpu.ai/v1alpha1", "kind": "TPUDriver",
+                   "metadata": {"name": "main"},
+                   "spec": {"repository": "gcr.io/tpu", "image": "tpu-validator",
+                            "version": "1.0",
+                            "nodeSelector": {consts.GKE_TPU_ACCELERATOR_LABEL:
+                                             "tpu-v5-lite-podslice"}}})
+
+    def tpudriver_ready():
+        try:
+            live = client.get("tpu.ai/v1alpha1", "TPUDriver", "main")
+        except NotFoundError:
+            return False
+        return deep_get(live, "status", "state") == "ready"
+    wait_for(tpudriver_ready, message="TPUDriver ready")
+    live = client.get("tpu.ai/v1alpha1", "TPUDriver", "main")
+    assert live["status"]["pools"] == {"v5-lite-podslice-2x4": 2, "v5-lite-podslice-4x4": 1}
+    # ClusterPolicy's own driver DS has been handed over + cleaned up
+    with pytest.raises(NotFoundError):
+        client.get("apps/v1", "DaemonSet", "libtpu-driver", "tpu-operator")
+    # update rolls the per-pool DSes
+    live["spec"]["version"] = "2.0"
+    client.update(live)
+
+    def rolled():
+        ds = client.get("apps/v1", "DaemonSet",
+                        "libtpu-driver-main-v5-lite-podslice-2x4", "tpu-operator")
+        return ds["spec"]["template"]["spec"]["containers"][0]["image"].endswith(":2.0")
+    wait_for(rolled, message="per-pool DS image roll")

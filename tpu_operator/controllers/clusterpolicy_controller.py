@@ -161,7 +161,13 @@ def setup_clusterpolicy_controller(client: Client,
             return _all_policy_requests(client)
         return []
 
+    def map_tpudriver(event: WatchEvent) -> List[Request]:
+        # TPUDriver instances appearing/disappearing flips ownership of the
+        # driver state (hand-over/hand-back), so the policy must re-reconcile
+        return _all_policy_requests(client)
+
     controller.watches("tpu.ai/v1", "ClusterPolicy", map_policy)
     controller.watches("v1", "Node", map_node)
     controller.watches("apps/v1", "DaemonSet", map_owned)
+    controller.watches("tpu.ai/v1alpha1", "TPUDriver", map_tpudriver)
     return controller
