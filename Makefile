@@ -18,6 +18,10 @@ validate-samples:
 validate-manifests:
 	$(PYTHON) -m pytest tests/test_operand_states.py tests/test_render.py -q
 
+.PHONY: native
+native:
+	$(MAKE) -C native/tpu-probe
+
 .PHONY: graft-check
 graft-check:
 	XLA_FLAGS="--xla_force_host_platform_device_count=8" $(PYTHON) __graft_entry__.py

@@ -1,0 +1,131 @@
+// tpu-probe: fast on-node TPU health probe.
+//
+// The TPU equivalent of the reference's nvidia-smi-based startupProbe
+// (reference assets/state-driver/0500_daemonset.yaml:126-134): answers
+// "is libtpu installed and are TPU device nodes visible" in ~1 ms so
+// kubelet exec probes on every TPU node cost nothing. The Python validator
+// (tpu_operator/validator/driver.py) uses this binary when present and
+// falls back to its own file checks otherwise.
+//
+// Usage:
+//   tpu-probe [--install-dir DIR] [--no-require-devices] [--json]
+//   tpu-probe devices            # list device nodes, one per line
+//
+// Exit codes: 0 healthy, 1 unhealthy, 2 usage error.
+
+#include <elf.h>
+#include <glob.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr const char* kDefaultInstallDir = "/home/kubernetes/bin/libtpu";
+constexpr const char* kDevGlobs[] = {"/dev/accel*", "/dev/vfio/*"};
+
+std::vector<std::string> DiscoverDevices(const char* extra_globs_env) {
+  std::vector<std::string> found;
+  std::vector<std::string> patterns;
+  if (extra_globs_env != nullptr && extra_globs_env[0] != '\0') {
+    // comma-separated override, mirroring the Python validator's TPU_DEV_GLOBS
+    std::string raw(extra_globs_env);
+    size_t start = 0;
+    while (start <= raw.size()) {
+      size_t comma = raw.find(',', start);
+      if (comma == std::string::npos) comma = raw.size();
+      if (comma > start) patterns.emplace_back(raw.substr(start, comma - start));
+      start = comma + 1;
+    }
+  } else {
+    for (const char* pattern : kDevGlobs) patterns.emplace_back(pattern);
+  }
+  for (const auto& pattern : patterns) {
+    glob_t results;
+    if (glob(pattern.c_str(), 0, nullptr, &results) == 0) {
+      for (size_t i = 0; i < results.gl_pathc; ++i) {
+        found.emplace_back(results.gl_pathv[i]);
+      }
+    }
+    globfree(&results);
+  }
+  return found;
+}
+
+// libtpu present = regular file with a valid ELF shared-object header.
+bool CheckLibtpu(const std::string& install_dir, std::string* path_out) {
+  const std::string path = install_dir + "/libtpu.so";
+  *path_out = path;
+  FILE* f = std::fopen(path.c_str(), "rb");
+  if (f == nullptr) return false;
+  unsigned char header[EI_NIDENT] = {0};
+  const size_t read = std::fread(header, 1, sizeof(header), f);
+  std::fclose(f);
+  return read == sizeof(header) && std::memcmp(header, ELFMAG, SELFMAG) == 0;
+}
+
+void PrintJson(bool ok, bool libtpu_ok, const std::string& libtpu_path,
+               const std::vector<std::string>& devices) {
+  std::printf("{\"ok\":%s,\"libtpu\":{\"path\":\"%s\",\"ok\":%s},\"devices\":[",
+              ok ? "true" : "false", libtpu_path.c_str(),
+              libtpu_ok ? "true" : "false");
+  for (size_t i = 0; i < devices.size(); ++i) {
+    std::printf("%s\"%s\"", i == 0 ? "" : ",", devices[i].c_str());
+  }
+  std::printf("]}\n");
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string install_dir = kDefaultInstallDir;
+  bool require_devices = true;
+  bool json = false;
+  bool list_devices = false;
+
+  for (int i = 1; i < argc; ++i) {
+    const std::string arg = argv[i];
+    if (arg == "--install-dir" && i + 1 < argc) {
+      install_dir = argv[++i];
+    } else if (arg.rfind("--install-dir=", 0) == 0) {
+      install_dir = arg.substr(strlen("--install-dir="));
+    } else if (arg == "--no-require-devices") {
+      require_devices = false;
+    } else if (arg == "--json") {
+      json = true;
+    } else if (arg == "devices") {
+      list_devices = true;
+    } else if (arg == "--help" || arg == "-h") {
+      std::fprintf(stderr,
+                   "usage: tpu-probe [--install-dir DIR] [--no-require-devices] "
+                   "[--json] | tpu-probe devices\n");
+      return 2;
+    } else {
+      std::fprintf(stderr, "tpu-probe: unknown argument %s\n", arg.c_str());
+      return 2;
+    }
+  }
+
+  const std::vector<std::string> devices = DiscoverDevices(getenv("TPU_DEV_GLOBS"));
+
+  if (list_devices) {
+    for (const auto& d : devices) std::printf("%s\n", d.c_str());
+    return devices.empty() ? 1 : 0;
+  }
+
+  std::string libtpu_path;
+  const bool libtpu_ok = CheckLibtpu(install_dir, &libtpu_path);
+  const bool devices_ok = !require_devices || !devices.empty();
+  const bool ok = libtpu_ok && devices_ok;
+  if (json) {
+    PrintJson(ok, libtpu_ok, libtpu_path, devices);
+  } else if (!ok) {
+    std::fprintf(stderr, "tpu-probe: unhealthy (libtpu %s: %s, %zu device node(s))\n",
+                 libtpu_ok ? "ok" : "missing/invalid", libtpu_path.c_str(),
+                 devices.size());
+  }
+  return ok ? 0 : 1;
+}

@@ -1,0 +1,90 @@
+"""Build and drive the native tpu-probe binary (native/tpu-probe)."""
+
+import json
+import os
+import shutil
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SRC_DIR = os.path.join(REPO, "native", "tpu-probe")
+
+pytestmark = pytest.mark.skipif(shutil.which("g++") is None, reason="no C++ toolchain")
+
+
+@pytest.fixture(scope="session")
+def probe_bin(tmp_path_factory):
+    build = tmp_path_factory.mktemp("tpu-probe-build")
+    subprocess.run(["make", "-C", SRC_DIR, f"BUILD={build}"], check=True,
+                   capture_output=True)
+    return str(build / "tpu-probe")
+
+
+@pytest.fixture
+def fake_devs(tmp_path, monkeypatch):
+    devdir = tmp_path / "dev"
+    devdir.mkdir()
+    for i in range(4):
+        (devdir / f"accel{i}").touch()
+    monkeypatch.setenv("TPU_DEV_GLOBS", str(devdir / "accel*"))
+    return devdir
+
+
+def run_probe(probe_bin, *args, env_extra=None):
+    env = dict(os.environ)
+    env.update(env_extra or {})
+    return subprocess.run([probe_bin, *args], capture_output=True, text=True, env=env)
+
+
+def test_healthy_and_unhealthy_paths(probe_bin, tmp_path, fake_devs):
+    install = tmp_path / "libtpu"
+    install.mkdir()
+    # missing libtpu -> 1
+    assert run_probe(probe_bin, f"--install-dir={install}").returncode == 1
+    # non-ELF file -> 1
+    (install / "libtpu.so").write_bytes(b"not an elf at all")
+    assert run_probe(probe_bin, f"--install-dir={install}").returncode == 1
+    # valid ELF magic -> 0
+    (install / "libtpu.so").write_bytes(b"\x7fELF" + b"\x00" * 32)
+    assert run_probe(probe_bin, f"--install-dir={install}").returncode == 0
+
+
+def test_device_requirement(probe_bin, tmp_path, monkeypatch):
+    install = tmp_path / "libtpu"
+    install.mkdir()
+    (install / "libtpu.so").write_bytes(b"\x7fELF" + b"\x00" * 32)
+    monkeypatch.setenv("TPU_DEV_GLOBS", str(tmp_path / "nothing*"))
+    assert run_probe(probe_bin, f"--install-dir={install}").returncode == 1
+    assert run_probe(probe_bin, f"--install-dir={install}",
+                     "--no-require-devices").returncode == 0
+
+
+def test_json_output_and_device_listing(probe_bin, tmp_path, fake_devs):
+    install = tmp_path / "libtpu"
+    install.mkdir()
+    (install / "libtpu.so").write_bytes(b"\x7fELF" + b"\x00" * 32)
+    out = run_probe(probe_bin, f"--install-dir={install}", "--json")
+    report = json.loads(out.stdout)
+    assert report["ok"] is True and report["libtpu"]["ok"] is True
+    assert len(report["devices"]) == 4
+    listing = run_probe(probe_bin, "devices")
+    assert listing.returncode == 0
+    assert len(listing.stdout.splitlines()) == 4
+
+
+def test_unknown_flag_usage_error(probe_bin):
+    assert run_probe(probe_bin, "--bogus").returncode == 2
+
+
+def test_python_probe_delegates_to_native(probe_bin, tmp_path, fake_devs, monkeypatch):
+    from tpu_operator.validator import driver as driver_mod
+
+    install = tmp_path / "libtpu"
+    install.mkdir()
+    (install / "libtpu.so").write_bytes(b"\x7fELF" + b"\x00" * 32)
+    monkeypatch.setenv("TPU_PROBE_BIN", probe_bin)
+    assert driver_mod.find_probe_binary() == probe_bin
+    assert driver_mod.probe(str(install)) is True
+    (install / "libtpu.so").write_bytes(b"garbage")
+    assert driver_mod.probe(str(install)) is False

@@ -205,7 +205,9 @@ def test_cli_driver_probe_exit_codes(tmp_path, fake_devs):
     install = tmp_path / "libtpu"
     install.mkdir()
     assert validator_run(["-c", "driver-probe", f"--install-dir={install}"]) == 1
-    (install / "libtpu.so").write_bytes(b"x")
+    (install / "libtpu.so").write_bytes(b"not an elf")
+    assert validator_run(["-c", "driver-probe", f"--install-dir={install}"]) == 1
+    (install / "libtpu.so").write_bytes(b"\x7fELF fake")
     assert validator_run(["-c", "driver-probe", f"--install-dir={install}"]) == 0
 
 

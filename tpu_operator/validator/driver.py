@@ -15,6 +15,7 @@ import glob
 import logging
 import os
 import shutil
+import subprocess
 import time
 from typing import List, Optional
 
@@ -56,13 +57,22 @@ def libtpu_path(install_dir: str) -> str:
     return os.path.join(install_dir, LIBTPU_SO)
 
 
+def is_valid_libtpu(path: str) -> bool:
+    """Regular file with an ELF header (same check as native tpu-probe)."""
+    try:
+        with open(path, "rb") as f:
+            return f.read(4) == b"\x7fELF"
+    except OSError:
+        return False
+
+
 def validate(install_dir: str, status: Optional[StatusFiles] = None,
              require_devices: bool = True) -> bool:
     """The driver-validation init container: probe, then write the barrier."""
     status = status or StatusFiles()
     so = libtpu_path(install_dir)
-    if not os.path.exists(so):
-        log.error("driver validation failed: %s missing", so)
+    if not is_valid_libtpu(so):
+        log.error("driver validation failed: %s missing or not an ELF", so)
         return False
     devices = discover_devices()
     if require_devices and not devices:
@@ -73,9 +83,36 @@ def validate(install_dir: str, status: Optional[StatusFiles] = None,
     return True
 
 
+def find_probe_binary() -> Optional[str]:
+    """Locate the native tpu-probe binary (native/tpu-probe): ~1 ms per exec
+    vs ~1 s of Python startup — the difference matters for kubelet exec
+    probes firing every few seconds across a fleet."""
+    explicit = os.environ.get("TPU_PROBE_BIN")
+    if explicit and os.access(explicit, os.X_OK):
+        return explicit
+    found = shutil.which("tpu-probe")
+    if found:
+        return found
+    repo_local = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "native", "tpu-probe", "build", "tpu-probe")
+    if os.access(repo_local, os.X_OK):
+        return repo_local
+    return None
+
+
 def probe(install_dir: str, require_devices: bool = True) -> bool:
-    """startupProbe for the installer DS: cheap, no side effects."""
-    return os.path.exists(libtpu_path(install_dir)) and \
+    """startupProbe for the installer DS: cheap, no side effects. Delegates
+    to the native tpu-probe binary when present."""
+    binary = find_probe_binary()
+    if binary:
+        args = [binary, f"--install-dir={install_dir}"]
+        if not require_devices:
+            args.append("--no-require-devices")
+        try:
+            return subprocess.run(args, capture_output=True, timeout=10).returncode == 0
+        except (OSError, subprocess.TimeoutExpired) as e:
+            log.warning("native probe failed (%s); falling back to file checks", e)
+    return is_valid_libtpu(libtpu_path(install_dir)) and \
         (not require_devices or bool(discover_devices()))
 
 
