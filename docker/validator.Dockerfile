@@ -1,0 +1,27 @@
+# tpu-validator operand image (reference validator/Dockerfile): the one image
+# that runs as driver installer, validator init chain, device plugin,
+# feature discovery, telemetry/node-status exporters and slice partitioner.
+# Built per libtpu release: the pinned libtpu wheel IS the "driver" payload
+# (reference ships a driver image per kernel/driver version the same way).
+ARG LIBTPU_VERSION=latest
+FROM python:3.12-slim AS base
+ARG LIBTPU_VERSION
+
+RUN pip install --no-cache-dir \
+    "jax[tpu]" -f https://storage.googleapis.com/jax-releases/libtpu_releases.html \
+    jinja2 pyyaml requests prometheus_client grpcio
+
+WORKDIR /opt/tpu-operator
+COPY pyproject.toml ./
+COPY tpu_operator/ tpu_operator/
+RUN pip install --no-cache-dir .
+
+# native probe for ~1ms kubelet exec probes
+COPY native/ native/
+RUN apt-get update && apt-get install -y --no-install-recommends g++ make \
+    && make -C native/tpu-probe \
+    && install -m 0755 native/tpu-probe/build/tpu-probe /usr/local/bin/tpu-probe \
+    && apt-get purge -y g++ make && apt-get autoremove -y && rm -rf /var/lib/apt/lists/*
+
+ENV LIBTPU_VERSION=${LIBTPU_VERSION}
+ENTRYPOINT ["tpu-validator"]

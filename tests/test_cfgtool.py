@@ -44,6 +44,15 @@ def test_sample_output_round_trips(capsys):
     assert TPUDriver.from_obj(doc).spec.validate() == []
 
 
+def test_validate_csv_alm_examples(capsys):
+    csv_path = os.path.join(os.path.dirname(SAMPLES), "..", "bundle", "manifests",
+                            "tpu-operator.clusterserviceversion.yaml")
+    assert run(["validate-csv", csv_path]) == 0
+    out = capsys.readouterr().out
+    assert "ClusterPolicy/cluster-policy: OK" in out
+    assert "TPUDriver/default: OK" in out
+
+
 def test_static_deploy_manifest_parses():
     path = os.path.join(os.path.dirname(SAMPLES), "..", "deploy", "operator.yaml")
     with open(path) as f:

@@ -3,6 +3,7 @@ validates ClusterPolicy samples + CSV image digests in CI).
 
 Subcommands:
   validate <file.yaml>...   parse + spec-validate ClusterPolicy/TPUDriver docs
+  validate-csv <csv.yaml>   validate the OLM CSV's alm-examples CRs
   sample [clusterpolicy|tpudriver]   print a complete sample CR
 """
 
@@ -70,15 +71,49 @@ def validate_doc(doc: dict) -> list:
     return [f"unsupported kind {kind!r} (expected ClusterPolicy or TPUDriver)"]
 
 
+def validate_csv(path: str) -> int:
+    """Validate the alm-examples CRs embedded in an OLM CSV (reference
+    cmd/gpuop-cfg validates the same surface)."""
+    import json
+
+    with open(path) as f:
+        csv = yaml.safe_load(f)
+    raw = csv.get("metadata", {}).get("annotations", {}).get("alm-examples", "[]")
+    try:
+        examples = json.loads(raw)
+    except json.JSONDecodeError as e:
+        print(f"{path}: alm-examples is not valid JSON: {e}")
+        return 1
+    failed = False
+    for doc in examples:
+        name = doc.get("metadata", {}).get("name", "?")
+        try:
+            errors = validate_doc(doc)
+        except SpecValidationError as e:
+            errors = [str(e)]
+        if errors:
+            failed = True
+            for err in errors:
+                print(f"{path}: alm-example {doc.get('kind')}/{name}: {err}")
+        else:
+            print(f"{path}: alm-example {doc.get('kind')}/{name}: OK")
+    return 1 if failed else 0
+
+
 def run(argv=None) -> int:
     p = argparse.ArgumentParser(prog="tpuop-cfg")
     sub = p.add_subparsers(dest="cmd", required=True)
     v = sub.add_parser("validate")
     v.add_argument("files", nargs="+")
+    c = sub.add_parser("validate-csv")
+    c.add_argument("csv")
     s = sub.add_parser("sample")
     s.add_argument("kind", nargs="?", default="clusterpolicy",
                    choices=["clusterpolicy", "tpudriver"])
     args = p.parse_args(argv)
+
+    if args.cmd == "validate-csv":
+        return validate_csv(args.csv)
 
     if args.cmd == "sample":
         sample = SAMPLE_CLUSTER_POLICY if args.kind == "clusterpolicy" else SAMPLE_TPU_DRIVER
