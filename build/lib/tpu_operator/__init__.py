@@ -1,0 +1,35 @@
+"""tpu-operator: a TPU-native Kubernetes operator.
+
+A ground-up, TPU-first rebuild of the capabilities of the NVIDIA GPU Operator
+(reference: /root/reference, see SURVEY.md): a CRD-driven control plane that
+takes bare accelerator nodes and reconciles them to a schedulable, validated,
+monitored state.
+
+Where the reference orchestrates a CUDA kernel-driver build, container-toolkit
+runtime rewriting and DCGM telemetry, this operator orchestrates the TPU-native
+equivalents: a libtpu installer DaemonSet, a device plugin advertising
+``google.com/tpu``, an ICI-topology feature-discovery labeler, a libtpu
+telemetry exporter, a slice partition manager (MIG analog) and a validator
+whose accelerator workload is a JAX/XLA allreduce over ICI instead of CUDA
+``vectorAdd``.
+
+Architecture (single state engine, reference's newer internal/state style --
+see SURVEY.md section 7 "Design stance"):
+
+    controllers/   reconcilers + controller-runtime-style manager
+    state/         render-and-sync state engine (skel, driver, nodepool)
+    render/        template renderer: manifests/ -> unstructured objects
+    api/           ClusterPolicy (v1) + TPUDriver (v1alpha1) typed specs
+    client/        minimal k8s API client (REST) + in-memory fake for tests
+    nodeinfo/      node attribute extraction and label filters
+    clusterinfo/   cluster facts provider (versions, runtime)
+    conditions/    CR status condition updaters
+    validator/     on-node validator CLI: status-file barriers + JAX workload
+    upgrade/       per-node rolling-upgrade label state machine
+    partitioner/   TPU slice partition manager (MIG analog)
+    manifests/     templated operand manifests (the data layer)
+"""
+
+__version__ = "0.1.0"
+
+DEFAULT_NAMESPACE = "tpu-operator"

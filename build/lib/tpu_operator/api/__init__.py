@@ -1,0 +1,20 @@
+from .clusterpolicy import (
+    CLUSTER_POLICY_API_VERSION,
+    CLUSTER_POLICY_KIND,
+    ClusterPolicy,
+    ClusterPolicySpec,
+    State,
+)
+from .tpudriver import TPU_DRIVER_API_VERSION, TPU_DRIVER_KIND, TPUDriver, TPUDriverSpec
+
+__all__ = [
+    "CLUSTER_POLICY_API_VERSION",
+    "CLUSTER_POLICY_KIND",
+    "ClusterPolicy",
+    "ClusterPolicySpec",
+    "State",
+    "TPU_DRIVER_API_VERSION",
+    "TPU_DRIVER_KIND",
+    "TPUDriver",
+    "TPUDriverSpec",
+]

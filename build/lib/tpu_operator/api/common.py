@@ -1,0 +1,144 @@
+"""Shared sub-spec types for both CRDs.
+
+Mirrors the reference's per-operand spec pattern (api/nvidia/v1/
+clusterpolicy_types.go:41-97): every operand gets enabled/repository/image/
+version/imagePullPolicy/imagePullSecrets/env/resources/args, and image
+resolution follows CR-field > env-var > error (internal/image/image.go:25-53)
+so OLM-style digest pinning via operator-pod env keeps working.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+from typing import Any, Dict, List, Optional
+
+from .specbase import SpecBase, spec_field
+
+
+class SpecValidationError(ValueError):
+    pass
+
+
+_IMAGE_RE = re.compile(r"^[a-z0-9]+([._/:@-][a-zA-Z0-9._-]+)*$")
+
+
+@dataclasses.dataclass
+class EnvVar(SpecBase):
+    name: str = ""
+    value: Optional[str] = None
+    extra: Dict[str, Any] = spec_field(dict)
+
+
+@dataclasses.dataclass
+class ComponentSpec(SpecBase):
+    enabled: Optional[bool] = None
+    repository: Optional[str] = None
+    image: Optional[str] = None
+    version: Optional[str] = None
+    image_pull_policy: str = "IfNotPresent"
+    image_pull_secrets: List[str] = spec_field(list)
+    env: List[EnvVar] = spec_field(list)
+    args: List[str] = spec_field(list)
+    resources: Optional[Dict[str, Any]] = None
+    extra: Dict[str, Any] = spec_field(dict)
+
+    #: env var consulted when the CR does not pin an image (subclass override)
+    DEFAULT_IMAGE_ENV: str = dataclasses.field(default="", repr=False)
+
+    def is_enabled(self, default: bool = True) -> bool:
+        return default if self.enabled is None else bool(self.enabled)
+
+    def image_path(self) -> str:
+        """Resolve the operand image: CR fields > $<DEFAULT_IMAGE_ENV> > error."""
+        if self.image:
+            image = self.image
+            if self.repository:
+                image = f"{self.repository}/{image}"
+            if self.version:
+                sep = "@" if self.version.startswith("sha256:") else ":"
+                image = f"{image}{sep}{self.version}"
+            return image
+        env_name = self.DEFAULT_IMAGE_ENV
+        if env_name and os.environ.get(env_name):
+            return os.environ[env_name]
+        raise SpecValidationError(
+            f"no image for {type(self).__name__}: set spec fields or ${env_name or '<unset>'}")
+
+    def env_map(self) -> Dict[str, str]:
+        return {e.name: (e.value or "") for e in self.env}
+
+    def validate(self, path: str = "") -> List[str]:
+        errors = []
+        if self.image_pull_policy not in ("Always", "IfNotPresent", "Never"):
+            errors.append(f"{path}.imagePullPolicy: invalid value {self.image_pull_policy!r}")
+        if self.image is not None and not _IMAGE_RE.match(self.image or ""):
+            errors.append(f"{path}.image: malformed image name {self.image!r}")
+        for e in self.env:
+            if not e.name:
+                errors.append(f"{path}.env: entry with empty name")
+        return errors
+
+
+@dataclasses.dataclass
+class DaemonsetsSpec(SpecBase):
+    """Cluster-wide DaemonSet defaults (reference DaemonsetsSpec)."""
+
+    update_strategy: str = "RollingUpdate"
+    rolling_update: Optional[Dict[str, Any]] = None
+    priority_class_name: str = "system-node-critical"
+    tolerations: List[Dict[str, Any]] = spec_field(list)
+    labels: Dict[str, str] = spec_field(dict)
+    annotations: Dict[str, str] = spec_field(dict)
+    extra: Dict[str, Any] = spec_field(dict)
+
+    def validate(self, path: str = "spec.daemonsets") -> List[str]:
+        if self.update_strategy not in ("RollingUpdate", "OnDelete"):
+            return [f"{path}.updateStrategy: must be RollingUpdate or OnDelete"]
+        return []
+
+
+@dataclasses.dataclass
+class DrainSpec(SpecBase):
+    enable: bool = False
+    force: bool = False
+    pod_selector: str = ""
+    timeout_seconds: int = 300
+    delete_empty_dir: bool = False
+    extra: Dict[str, Any] = spec_field(dict)
+
+
+@dataclasses.dataclass
+class PodDeletionSpec(SpecBase):
+    force: bool = False
+    timeout_seconds: int = 300
+    delete_empty_dir: bool = False
+    extra: Dict[str, Any] = spec_field(dict)
+
+
+@dataclasses.dataclass
+class WaitForCompletionSpec(SpecBase):
+    pod_selector: str = ""
+    timeout_seconds: int = 0
+    extra: Dict[str, Any] = spec_field(dict)
+
+
+@dataclasses.dataclass
+class UpgradePolicySpec(SpecBase):
+    """Rolling-upgrade knobs (reference DriverUpgradePolicySpec via
+    k8s-operator-libs; consumed by our upgrade state machine)."""
+
+    auto_upgrade: bool = False
+    max_parallel_upgrades: int = 1
+    max_unavailable: Optional[str] = "25%"
+    wait_for_completion: WaitForCompletionSpec = spec_field(WaitForCompletionSpec)
+    pod_deletion: PodDeletionSpec = spec_field(PodDeletionSpec)
+    drain: DrainSpec = spec_field(DrainSpec)
+    extra: Dict[str, Any] = spec_field(dict)
+
+    def validate(self, path: str = "") -> List[str]:
+        errors = []
+        if self.max_parallel_upgrades < 0:
+            errors.append(f"{path}.maxParallelUpgrades: must be >= 0")
+        return errors

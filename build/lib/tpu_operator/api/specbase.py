@@ -1,0 +1,103 @@
+"""Generic dataclass <-> camelCase-dict serde for CRD spec types.
+
+The reference generates this layer (deepcopy funcs, JSON tags) with
+kubebuilder; here one reflective base class covers every spec type:
+snake_case attributes map to camelCase keys, nested dataclasses and
+List[dataclass] fields recurse, and unknown keys are preserved round-trip so
+the operator never destroys fields written by a newer client.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import typing
+from typing import Any, Dict, get_args, get_origin, get_type_hints
+
+
+def to_camel(name: str) -> str:
+    head, *rest = name.split("_")
+    return head + "".join(part[:1].upper() + part[1:] for part in rest)
+
+
+def _resolve_hints(cls) -> Dict[str, Any]:
+    return get_type_hints(cls)
+
+
+def _unwrap_optional(tp):
+    if get_origin(tp) is typing.Union:
+        args = [a for a in get_args(tp) if a is not type(None)]
+        if len(args) == 1:
+            return args[0]
+    return tp
+
+
+@dataclasses.dataclass
+class SpecBase:
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any] | None):
+        data = dict(data or {})
+        hints = _resolve_hints(cls)
+        kwargs = {}
+        consumed = set()
+        for f in dataclasses.fields(cls):
+            if f.name == "extra":
+                continue
+            key = f.metadata.get("key", to_camel(f.name))
+            if key not in data:
+                continue
+            consumed.add(key)
+            value = data[key]
+            tp = _unwrap_optional(hints[f.name])
+            kwargs[f.name] = _decode(tp, value)
+        extra = {k: v for k, v in data.items() if k not in consumed}
+        obj = cls(**kwargs)
+        if extra and hasattr(obj, "extra"):
+            obj.extra = extra
+        return obj
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for f in dataclasses.fields(self):
+            if f.name == "extra":
+                continue
+            value = getattr(self, f.name)
+            if value is None:
+                continue
+            key = f.metadata.get("key", to_camel(f.name))
+            out[key] = _encode(value)
+        extra = getattr(self, "extra", None)
+        if extra:
+            for k, v in extra.items():
+                out.setdefault(k, v)
+        return out
+
+
+def _decode(tp, value):
+    if dataclasses.is_dataclass(tp) and isinstance(value, dict):
+        return tp.from_dict(value)
+    origin = get_origin(tp)
+    if origin in (list, typing.List) and isinstance(value, list):
+        (item_tp,) = get_args(tp) or (Any,)
+        if dataclasses.is_dataclass(item_tp):
+            return [item_tp.from_dict(v) if isinstance(v, dict) else v for v in value]
+        return list(value)
+    return value
+
+
+def _encode(value):
+    if isinstance(value, SpecBase):
+        return value.to_dict()
+    if dataclasses.is_dataclass(value) and not isinstance(value, type):
+        return dataclasses.asdict(value)
+    if isinstance(value, list):
+        return [_encode(v) for v in value]
+    if isinstance(value, dict):
+        return {k: _encode(v) for k, v in value.items()}
+    return value
+
+
+def spec_field(default=None, key: str | None = None, **kw):
+    metadata = {"key": key} if key else {}
+    if callable(default):
+        return dataclasses.field(default_factory=default, metadata=metadata, **kw)
+    return dataclasses.field(default=default, metadata=metadata, **kw)

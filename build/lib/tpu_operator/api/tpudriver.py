@@ -1,0 +1,88 @@
+"""TPUDriver CRD (tpu.ai/v1alpha1): per-node-pool driver (libtpu) instance.
+
+Analog of the reference's NVIDIADriver CRD
+(api/nvidia/v1alpha1/nvidiadriver_types.go:40-186): lets different node pools
+run different libtpu versions, selected by nodeSelector, with conflict
+validation ensuring no node is claimed by two instances. Where the reference
+pools nodes by kernel version (it compiles kernel modules), TPU pools are
+partitioned by accelerator type + slice topology (internal/state/nodepool.go
+analog in tpu_operator/state/nodepool.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+from .common import ComponentSpec, SpecValidationError, UpgradePolicySpec
+from .specbase import SpecBase, spec_field
+
+TPU_DRIVER_API_VERSION = "tpu.ai/v1alpha1"
+TPU_DRIVER_KIND = "TPUDriver"
+
+#: label every TPU node gets (analog of nvidia.com/gpu.present=true,
+#: reference state_manager.go:113-117)
+TPU_PRESENT_LABEL = "tpu.ai/tpu.present"
+
+DRIVER_TYPES = ("standard",)  # reference has gpu/vgpu/vgpu-host-manager; TPU has one
+
+
+@dataclasses.dataclass
+class TPUDriverSpec(ComponentSpec):
+    DEFAULT_IMAGE_ENV: str = dataclasses.field(default="DRIVER_IMAGE", repr=False)
+
+    driver_type: str = "standard"
+    libtpu_version: Optional[str] = None
+    install_dir: str = "/home/kubernetes/bin/libtpu"
+    node_selector: Dict[str, str] = spec_field(dict)
+    labels: Dict[str, str] = spec_field(dict)
+    annotations: Dict[str, str] = spec_field(dict)
+    tolerations: List[Dict[str, Any]] = spec_field(list)
+    node_affinity: Optional[Dict[str, Any]] = None
+    priority_class_name: str = "system-node-critical"
+    upgrade_policy: UpgradePolicySpec = spec_field(UpgradePolicySpec)
+
+    def get_node_selector(self) -> Dict[str, str]:
+        """Defaults to every TPU node (reference GetNodeSelector:504)."""
+        return dict(self.node_selector) if self.node_selector else {TPU_PRESENT_LABEL: "true"}
+
+    def validate(self, path: str = "spec") -> List[str]:
+        errors = super().validate(path)
+        if self.driver_type not in DRIVER_TYPES:
+            errors.append(f"{path}.driverType: invalid {self.driver_type!r}")
+        errors += self.upgrade_policy.validate(f"{path}.upgradePolicy")
+        return errors
+
+
+@dataclasses.dataclass
+class TPUDriver:
+    name: str
+    spec: TPUDriverSpec
+    obj: Dict[str, Any]
+
+    @classmethod
+    def from_obj(cls, obj: Dict[str, Any]) -> "TPUDriver":
+        if obj.get("kind") != TPU_DRIVER_KIND:
+            raise SpecValidationError(f"not a TPUDriver: kind={obj.get('kind')!r}")
+        return cls(
+            name=obj.get("metadata", {}).get("name", ""),
+            spec=TPUDriverSpec.from_dict(obj.get("spec", {})),
+            obj=obj,
+        )
+
+    @property
+    def uid(self) -> str:
+        return self.obj.get("metadata", {}).get("uid", "")
+
+    @property
+    def status(self) -> Dict[str, Any]:
+        return self.obj.setdefault("status", {})
+
+
+def new_tpu_driver(name: str, spec: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    return {
+        "apiVersion": TPU_DRIVER_API_VERSION,
+        "kind": TPU_DRIVER_KIND,
+        "metadata": {"name": name},
+        "spec": spec or {},
+    }

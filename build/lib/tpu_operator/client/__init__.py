@@ -1,0 +1,15 @@
+from .errors import ApiError, ConflictError, NotFoundError
+from .interface import Client, WatchEvent
+from .fake import FakeClient
+from .scheme import Scheme, default_scheme
+
+__all__ = [
+    "ApiError",
+    "ConflictError",
+    "NotFoundError",
+    "Client",
+    "WatchEvent",
+    "FakeClient",
+    "Scheme",
+    "default_scheme",
+]

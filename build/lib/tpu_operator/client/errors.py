@@ -1,0 +1,24 @@
+from __future__ import annotations
+
+
+class ApiError(Exception):
+    """Kubernetes API error with an HTTP-style status code."""
+
+    code = 500
+
+    def __init__(self, message: str, code: int | None = None):
+        super().__init__(message)
+        if code is not None:
+            self.code = code
+
+
+class NotFoundError(ApiError):
+    code = 404
+
+
+class ConflictError(ApiError):
+    code = 409
+
+
+class AlreadyExistsError(ConflictError):
+    code = 409

@@ -1,0 +1,238 @@
+"""In-memory fake Kubernetes API for unit tests.
+
+The reference's entire unit-test strategy is built on controller-runtime's
+fake client (SURVEY.md section 4.1; e.g. controllers/object_controls_test.go:241).
+This fake replicates the parts that matter to an operator: identity + metadata
+bookkeeping (uid/resourceVersion/creationTimestamp), optimistic-concurrency
+conflicts, label/field selectors, watches, and ownerReference garbage
+collection (which real clusters do server-side).
+"""
+
+from __future__ import annotations
+
+import copy
+import queue
+import threading
+import time
+import uuid
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..utils.objects import deep_get, json_merge_patch
+from .errors import AlreadyExistsError, ConflictError, NotFoundError
+from .interface import Client, WatchEvent, WatchHandle
+from .scheme import Scheme, default_scheme
+
+Key = Tuple[str, str, str, str]
+
+
+def match_label_selector(labels: Optional[dict], selector: Optional[dict]) -> bool:
+    """Equality-based selector; a value of None means 'key exists'."""
+    if not selector:
+        return True
+    labels = labels or {}
+    for key, want in selector.items():
+        if want is None:
+            if key not in labels:
+                return False
+        elif labels.get(key) != want:
+            return False
+    return True
+
+
+def match_field_selector(obj: dict, selector: Optional[dict]) -> bool:
+    if not selector:
+        return True
+    for path, want in selector.items():
+        if deep_get(obj, *path.split(".")) != want:
+            return False
+    return True
+
+
+class _FakeWatch(WatchHandle):
+    def __init__(self, owner: "FakeClient", key: Tuple[str, str, str],
+                 handler: Optional[Callable[[WatchEvent], None]]):
+        self._owner = owner
+        self._key = key
+        self._handler = handler
+        self._queue: "queue.Queue[WatchEvent]" = queue.Queue()
+        self._stopped = False
+
+    def push(self, event: WatchEvent) -> None:
+        if self._stopped:
+            return
+        if self._handler is not None:
+            self._handler(event)
+        else:
+            self._queue.put(event)
+
+    def stop(self) -> None:
+        self._stopped = True
+        self._owner._remove_watch(self)
+
+    def events(self, idle_timeout: float = 0.5):
+        """Yield events as they arrive; return after ``idle_timeout`` s of quiet."""
+        while not self._stopped:
+            try:
+                yield self._queue.get(timeout=idle_timeout)
+            except queue.Empty:
+                return
+
+
+class FakeClient(Client):
+    def __init__(self, scheme: Optional[Scheme] = None, objects: Optional[List[dict]] = None):
+        self.scheme = scheme or default_scheme()
+        self._lock = threading.RLock()
+        self._store: Dict[Key, dict] = {}
+        self._rv = 0
+        self._watches: List[_FakeWatch] = []
+        for obj in objects or []:
+            self.create(obj)
+
+    # -- helpers -------------------------------------------------------------
+    def _key(self, api_version: str, kind: str, name: str, namespace: Optional[str]) -> Key:
+        ns = (namespace or "default") if self.scheme.is_namespaced(api_version, kind) else ""
+        return (api_version, kind, ns, name)
+
+    def _next_rv(self) -> str:
+        self._rv += 1
+        return str(self._rv)
+
+    def _notify(self, event_type: str, obj: dict) -> None:
+        for w in list(self._watches):
+            api_version, kind, ns = w._key
+            if api_version != obj.get("apiVersion") or kind != obj.get("kind"):
+                continue
+            if ns and obj.get("metadata", {}).get("namespace", "") != ns:
+                continue
+            w.push(WatchEvent(type=event_type, object=copy.deepcopy(obj)))
+
+    def _remove_watch(self, w: _FakeWatch) -> None:
+        with self._lock:
+            if w in self._watches:
+                self._watches.remove(w)
+
+    # -- reads ---------------------------------------------------------------
+    def get(self, api_version: str, kind: str, name: str, namespace: Optional[str] = None) -> dict:
+        with self._lock:
+            key = self._key(api_version, kind, name, namespace)
+            if key not in self._store:
+                raise NotFoundError(f"{kind} {namespace or ''}/{name} not found")
+            return copy.deepcopy(self._store[key])
+
+    def list(self, api_version, kind, namespace=None, label_selector=None, field_selector=None) -> List[dict]:
+        with self._lock:
+            out = []
+            for (av, k, ns, _), obj in sorted(self._store.items()):
+                if av != api_version or k != kind:
+                    continue
+                if namespace and ns != namespace:
+                    continue
+                if not match_label_selector(deep_get(obj, "metadata", "labels"), label_selector):
+                    continue
+                if not match_field_selector(obj, field_selector):
+                    continue
+                out.append(copy.deepcopy(obj))
+            return out
+
+    # -- writes --------------------------------------------------------------
+    def create(self, obj: dict) -> dict:
+        obj = copy.deepcopy(obj)
+        meta = obj.setdefault("metadata", {})
+        with self._lock:
+            namespaced = self.scheme.is_namespaced(obj["apiVersion"], obj["kind"])
+            if namespaced:
+                meta.setdefault("namespace", "default")
+            key = self._key(obj["apiVersion"], obj["kind"], meta["name"], meta.get("namespace"))
+            if key in self._store:
+                raise AlreadyExistsError(f"{obj['kind']} {meta['name']} already exists")
+            meta.setdefault("uid", str(uuid.uuid4()))
+            meta.setdefault("creationTimestamp", time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
+            meta["resourceVersion"] = self._next_rv()
+            meta.setdefault("generation", 1)
+            self._store[key] = obj
+            self._notify("ADDED", obj)
+            return copy.deepcopy(obj)
+
+    def update(self, obj: dict) -> dict:
+        obj = copy.deepcopy(obj)
+        meta = obj.get("metadata", {})
+        with self._lock:
+            key = self._key(obj["apiVersion"], obj["kind"], meta["name"], meta.get("namespace"))
+            current = self._store.get(key)
+            if current is None:
+                raise NotFoundError(f"{obj['kind']} {meta.get('name')} not found")
+            sent_rv = meta.get("resourceVersion")
+            if sent_rv is not None and sent_rv != current["metadata"]["resourceVersion"]:
+                raise ConflictError(f"resourceVersion conflict on {obj['kind']}/{meta['name']}")
+            # no-op writes don't bump resourceVersion or emit events, matching
+            # the real apiserver (prevents self-sustaining watch loops)
+            normalized = copy.deepcopy(obj)
+            normalized["metadata"] = {**current["metadata"],
+                                      **{k: v for k, v in meta.items() if k != "resourceVersion"}}
+            if normalized == current:
+                return copy.deepcopy(current)
+            meta["uid"] = current["metadata"]["uid"]
+            meta["creationTimestamp"] = current["metadata"]["creationTimestamp"]
+            meta["resourceVersion"] = self._next_rv()
+            old_spec = current.get("spec")
+            if obj.get("spec") != old_spec:
+                meta["generation"] = current["metadata"].get("generation", 1) + 1
+            else:
+                meta["generation"] = current["metadata"].get("generation", 1)
+            self._store[key] = obj
+            self._notify("MODIFIED", obj)
+            return copy.deepcopy(obj)
+
+    def patch(self, api_version, kind, name, patch, namespace=None) -> dict:
+        with self._lock:
+            current = self.get(api_version, kind, name, namespace)
+            json_merge_patch(current, patch)
+            current["metadata"].pop("resourceVersion", None)
+            return self.update(current)
+
+    def delete(self, api_version, kind, name, namespace=None) -> None:
+        with self._lock:
+            key = self._key(api_version, kind, name, namespace)
+            obj = self._store.pop(key, None)
+            if obj is None:
+                raise NotFoundError(f"{kind} {namespace or ''}/{name} not found")
+            self._notify("DELETED", obj)
+            self._collect_orphans(obj["metadata"]["uid"])
+
+    def _collect_orphans(self, owner_uid: str) -> None:
+        """Server-side ownerReference garbage collection (cascade)."""
+        doomed = []
+        for key, obj in self._store.items():
+            for ref in deep_get(obj, "metadata", "ownerReferences", default=[]) or []:
+                if ref.get("uid") == owner_uid:
+                    doomed.append(key)
+                    break
+        for api_version, kind, ns, name in doomed:
+            try:
+                self.delete(api_version, kind, name, ns or None)
+            except NotFoundError:
+                pass
+
+    def update_status(self, obj: dict) -> dict:
+        with self._lock:
+            meta = obj.get("metadata", {})
+            current = self.get(obj["apiVersion"], obj["kind"], meta["name"], meta.get("namespace"))
+            if current.get("status", {}) == obj.get("status", {}):
+                return current  # no-op status write
+            current["status"] = copy.deepcopy(obj.get("status", {}))
+            current["metadata"].pop("resourceVersion", None)
+            # status updates must not bump generation
+            saved_gen = current["metadata"].get("generation", 1)
+            updated = self.update(current)
+            updated["metadata"]["generation"] = saved_gen
+            return updated
+
+    def server_version(self) -> str:
+        return "v1.31.0-fake"
+
+    # -- watches -------------------------------------------------------------
+    def watch(self, api_version, kind, namespace=None, handler=None) -> WatchHandle:
+        with self._lock:
+            w = _FakeWatch(self, (api_version, kind, namespace or ""), handler)
+            self._watches.append(w)
+            return w

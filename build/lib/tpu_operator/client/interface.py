@@ -1,0 +1,74 @@
+"""The client interface every consumer in the operator programs against.
+
+Mirrors the slice of controller-runtime's client.Client the reference actually
+uses (Get/List/Create/Update/Patch/Delete + Status().Update + watches). All
+objects are unstructured plain dicts -- the same decision as the reference's
+new-style engine which applies []unstructured.Unstructured
+(internal/state/state_skel.go:223-285).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Iterable, List, Optional
+
+
+@dataclass
+class WatchEvent:
+    type: str  # ADDED | MODIFIED | DELETED
+    object: dict
+
+
+class Client:
+    """Abstract k8s API client. Implementations: FakeClient, RestClient."""
+
+    # -- reads ---------------------------------------------------------------
+    def get(self, api_version: str, kind: str, name: str, namespace: Optional[str] = None) -> dict:
+        raise NotImplementedError
+
+    def list(
+        self,
+        api_version: str,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[dict] = None,
+        field_selector: Optional[dict] = None,
+    ) -> List[dict]:
+        raise NotImplementedError
+
+    # -- writes --------------------------------------------------------------
+    def create(self, obj: dict) -> dict:
+        raise NotImplementedError
+
+    def update(self, obj: dict) -> dict:
+        raise NotImplementedError
+
+    def patch(self, api_version: str, kind: str, name: str, patch: dict, namespace: Optional[str] = None) -> dict:
+        """JSON-merge-patch semantics."""
+        raise NotImplementedError
+
+    def delete(self, api_version: str, kind: str, name: str, namespace: Optional[str] = None) -> None:
+        raise NotImplementedError
+
+    def update_status(self, obj: dict) -> dict:
+        """Update only the status subresource."""
+        raise NotImplementedError
+
+    # -- watches -------------------------------------------------------------
+    def watch(
+        self,
+        api_version: str,
+        kind: str,
+        namespace: Optional[str] = None,
+        handler: Optional[Callable[[WatchEvent], None]] = None,
+    ) -> "WatchHandle":
+        """Subscribe to change events. Returns a handle with .stop()."""
+        raise NotImplementedError
+
+
+class WatchHandle:
+    def stop(self) -> None:
+        raise NotImplementedError
+
+    def events(self) -> Iterable[WatchEvent]:
+        raise NotImplementedError

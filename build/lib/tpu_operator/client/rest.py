@@ -1,0 +1,240 @@
+"""Real Kubernetes API client over plain REST.
+
+The reference gets this layer for free from client-go; here it is ~200 lines
+because the operator only needs typed-less (unstructured) access: GET/LIST/
+POST/PUT/PATCH/DELETE plus streaming watches. In-cluster auth uses the
+standard serviceaccount token + CA bundle mounts.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+from typing import Callable, List, Optional
+
+import requests
+
+from .errors import AlreadyExistsError, ApiError, ConflictError, NotFoundError
+from .interface import Client, WatchEvent, WatchHandle
+from .scheme import Scheme, default_scheme
+
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+def _in_cluster_config() -> dict:
+    host = os.environ.get("KUBERNETES_SERVICE_HOST")
+    port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+    if not host:
+        raise ApiError("not running in-cluster: KUBERNETES_SERVICE_HOST unset", 500)
+    token_path = os.path.join(SA_DIR, "token")
+    ca_path = os.path.join(SA_DIR, "ca.crt")
+    with open(token_path) as f:
+        token = f.read().strip()
+    return {
+        "base_url": f"https://{host}:{port}",
+        "token": token,
+        "verify": ca_path if os.path.exists(ca_path) else True,
+    }
+
+
+class RestClient(Client):
+    def __init__(
+        self,
+        base_url: Optional[str] = None,
+        token: Optional[str] = None,
+        verify=None,
+        scheme: Optional[Scheme] = None,
+        session: Optional[requests.Session] = None,
+    ):
+        if base_url is None:
+            cfg = _in_cluster_config()
+            base_url, token, verify = cfg["base_url"], cfg["token"], cfg["verify"]
+        self.base_url = base_url.rstrip("/")
+        self.scheme = scheme or default_scheme()
+        self._session = session or requests.Session()
+        if token:
+            self._session.headers["Authorization"] = f"Bearer {token}"
+        self._session.verify = verify if verify is not None else True
+
+    # -- url building --------------------------------------------------------
+    def resource_url(self, api_version: str, kind: str, namespace: Optional[str] = None,
+                     name: Optional[str] = None, subresource: Optional[str] = None) -> str:
+        info = self.scheme.info(api_version, kind)
+        prefix = "/api" if "/" not in api_version else "/apis"
+        parts = [self.base_url, prefix.lstrip("/"), api_version]
+        if info.namespaced:
+            parts += ["namespaces", namespace or "default"]
+        parts.append(info.plural)
+        if name:
+            parts.append(name)
+        if subresource:
+            parts.append(subresource)
+        return "/".join(parts)
+
+    @staticmethod
+    def _selector_param(selector: Optional[dict]) -> Optional[str]:
+        if not selector:
+            return None
+        terms = []
+        for k, v in selector.items():
+            terms.append(k if v is None else f"{k}={v}")
+        return ",".join(terms)
+
+    def _raise_for(self, resp: requests.Response) -> None:
+        if resp.status_code < 400:
+            return
+        try:
+            message = resp.json().get("message", resp.text)
+        except ValueError:
+            message = resp.text
+        if resp.status_code == 404:
+            raise NotFoundError(message)
+        if resp.status_code == 409:
+            if "already exists" in message:
+                raise AlreadyExistsError(message)
+            raise ConflictError(message)
+        raise ApiError(message, resp.status_code)
+
+    # -- CRUD ----------------------------------------------------------------
+    def get(self, api_version, kind, name, namespace=None) -> dict:
+        resp = self._session.get(self.resource_url(api_version, kind, namespace, name))
+        self._raise_for(resp)
+        return resp.json()
+
+    def list(self, api_version, kind, namespace=None, label_selector=None, field_selector=None) -> List[dict]:
+        params = {}
+        if label_selector:
+            params["labelSelector"] = self._selector_param(label_selector)
+        if field_selector:
+            params["fieldSelector"] = ",".join(f"{k}={v}" for k, v in field_selector.items())
+        resp = self._session.get(self.resource_url(api_version, kind, namespace), params=params)
+        self._raise_for(resp)
+        body = resp.json()
+        items = body.get("items", [])
+        # list items omit apiVersion/kind; restore them
+        for item in items:
+            item.setdefault("apiVersion", api_version)
+            item.setdefault("kind", kind)
+        return items
+
+    def create(self, obj: dict) -> dict:
+        ns = obj.get("metadata", {}).get("namespace")
+        resp = self._session.post(self.resource_url(obj["apiVersion"], obj["kind"], ns), json=obj)
+        self._raise_for(resp)
+        return resp.json()
+
+    def update(self, obj: dict) -> dict:
+        meta = obj["metadata"]
+        url = self.resource_url(obj["apiVersion"], obj["kind"], meta.get("namespace"), meta["name"])
+        resp = self._session.put(url, json=obj)
+        self._raise_for(resp)
+        return resp.json()
+
+    def patch(self, api_version, kind, name, patch, namespace=None) -> dict:
+        url = self.resource_url(api_version, kind, namespace, name)
+        resp = self._session.patch(url, data=json.dumps(patch),
+                                   headers={"Content-Type": "application/merge-patch+json"})
+        self._raise_for(resp)
+        return resp.json()
+
+    def delete(self, api_version, kind, name, namespace=None) -> None:
+        resp = self._session.delete(self.resource_url(api_version, kind, namespace, name))
+        self._raise_for(resp)
+
+    def update_status(self, obj: dict) -> dict:
+        meta = obj["metadata"]
+        url = self.resource_url(obj["apiVersion"], obj["kind"], meta.get("namespace"), meta["name"], "status")
+        resp = self._session.put(url, json=obj)
+        self._raise_for(resp)
+        return resp.json()
+
+    def server_version(self) -> str:
+        resp = self._session.get(f"{self.base_url}/version")
+        self._raise_for(resp)
+        return resp.json().get("gitVersion", "unknown")
+
+    # -- watch ---------------------------------------------------------------
+    def watch(self, api_version, kind, namespace=None, handler=None) -> WatchHandle:
+        return _RestWatch(self, api_version, kind, namespace, handler)
+
+
+class _RestWatch(WatchHandle):
+    """Streaming watch on a background thread with auto-reconnect.
+
+    Informer semantics on (re)connect: when the resumption resourceVersion is
+    unknown or lost, the watcher re-LISTs and synthesises an ADDED event per
+    item before streaming — so consumers never miss state changed while the
+    stream was down (they may see duplicates; reconcilers are level-driven and
+    idempotent, same contract as controller-runtime's informers).
+    """
+
+    def __init__(self, client: RestClient, api_version: str, kind: str,
+                 namespace: Optional[str], handler: Optional[Callable[[WatchEvent], None]]):
+        self._client = client
+        self._api_version = api_version
+        self._kind = kind
+        self._namespace = namespace
+        self._handler = handler
+        self._stopped = threading.Event()
+        self._queue: "queue.Queue[WatchEvent]" = queue.Queue()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _emit(self, event: WatchEvent) -> None:
+        if self._handler:
+            self._handler(event)
+        else:
+            self._queue.put(event)
+
+    def _relist(self) -> str:
+        items = self._client.list(self._api_version, self._kind, self._namespace)
+        rv = ""
+        for item in items:
+            rv = item.get("metadata", {}).get("resourceVersion", rv)
+            self._emit(WatchEvent(type="ADDED", object=item))
+        return rv
+
+    def _run(self) -> None:
+        url = self._client.resource_url(self._api_version, self._kind, self._namespace)
+        rv = ""
+        while not self._stopped.is_set():
+            try:
+                if not rv:
+                    rv = self._relist()
+                params = {"watch": "true", "allowWatchBookmarks": "true"}
+                if rv:
+                    params["resourceVersion"] = rv
+                with self._client._session.get(url, params=params, stream=True, timeout=330) as resp:
+                    if resp.status_code >= 400:
+                        self._stopped.wait(2.0)
+                        rv = ""
+                        continue
+                    for line in resp.iter_lines():
+                        if self._stopped.is_set():
+                            return
+                        if not line:
+                            continue
+                        event = json.loads(line)
+                        etype, obj = event.get("type"), event.get("object", {})
+                        rv = obj.get("metadata", {}).get("resourceVersion", rv)
+                        if etype == "BOOKMARK":
+                            continue
+                        obj.setdefault("apiVersion", self._api_version)
+                        obj.setdefault("kind", self._kind)
+                        self._emit(WatchEvent(type=etype, object=obj))
+            except (requests.RequestException, json.JSONDecodeError, ValueError):
+                self._stopped.wait(2.0)
+                rv = ""
+
+    def stop(self) -> None:
+        self._stopped.set()
+
+    def events(self, idle_timeout: float = 0.5):
+        """Yield events as they arrive; return after ``idle_timeout`` s of quiet."""
+        while not self._stopped.is_set():
+            try:
+                yield self._queue.get(timeout=idle_timeout)
+            except queue.Empty:
+                return

@@ -1,0 +1,34 @@
+"""``tpu-operator`` binary entrypoint (reference: cmd/gpu-operator/main.go:74-233)."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .. import __version__
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="tpu-operator",
+                                description="TPU-native cluster operator controller manager")
+    p.add_argument("--api-server", default=None,
+                   help="API server base URL (default: in-cluster config)")
+    p.add_argument("--token", default=None, help="Bearer token (default: serviceaccount token)")
+    p.add_argument("--namespace", default=None, help="operator namespace (default: $OPERATOR_NAMESPACE)")
+    p.add_argument("--metrics-port", type=int, default=8080, help="Prometheus metrics port (0 disables)")
+    p.add_argument("--health-port", type=int, default=8081, help="healthz port (0 disables)")
+    p.add_argument("--log-level", default="info", choices=["debug", "info", "warning", "error"])
+    p.add_argument("--version", action="version", version=f"tpu-operator {__version__}")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    # Deferred import so --help/--version work without a cluster.
+    from ..controllers.manager import run_operator
+
+    return run_operator(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,15 @@
+"""``tpu-validator`` binary entrypoint (reference: validator/main.go:220-365)."""
+
+from __future__ import annotations
+
+import sys
+
+
+def main(argv=None) -> int:
+    from ..validator.main import run
+
+    return run(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

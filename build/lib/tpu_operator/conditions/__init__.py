@@ -1,0 +1,109 @@
+"""CR status condition updaters (reference: internal/conditions/).
+
+Both CRDs share the Ready/Error condition pair; reasons follow the
+reference's vocabulary (internal/conditions/consts.go) with TPU-specific
+additions.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from ..client.errors import ConflictError, NotFoundError
+from ..client.interface import Client
+
+READY = "Ready"
+ERROR = "Error"
+
+# Reasons (reference internal/conditions/consts.go)
+REASON_READY = "Ready"
+REASON_RECONCILE_FAILED = "ReconcileFailed"
+REASON_OPERAND_NOT_READY = "OperandNotReady"
+REASON_NO_TPU_NODES = "NoTPUNodes"
+REASON_DISCOVERY_LABELS_MISSING = "DiscoveryLabelsMissing"
+REASON_CONFLICTING_NODE_SELECTOR = "ConflictingNodeSelector"
+REASON_DRIVER_NOT_READY = "DriverNotReady"
+
+
+def _now() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+def make_condition(type_: str, status: str, reason: str, message: str = "") -> dict:
+    return {
+        "type": type_,
+        "status": status,
+        "reason": reason,
+        "message": message,
+        "lastTransitionTime": _now(),
+    }
+
+
+def set_condition(conditions: List[dict], new: dict) -> List[dict]:
+    """Upsert by type; keep lastTransitionTime when status is unchanged."""
+    for i, existing in enumerate(conditions):
+        if existing.get("type") == new["type"]:
+            if existing.get("status") == new["status"]:
+                new["lastTransitionTime"] = existing.get("lastTransitionTime", new["lastTransitionTime"])
+            conditions[i] = new
+            return conditions
+    conditions.append(new)
+    return conditions
+
+
+def mark_ready(obj: dict, message: str = "All operands are ready") -> None:
+    """Mutate obj.status.conditions to Ready; caller persists the status."""
+    _mark(obj, [
+        make_condition(READY, "True", REASON_READY, message),
+        make_condition(ERROR, "False", REASON_READY, ""),
+    ])
+
+
+def mark_error(obj: dict, reason: str, message: str) -> None:
+    _mark(obj, [
+        make_condition(READY, "False", reason, ""),
+        make_condition(ERROR, "True", reason, message),
+    ])
+
+
+def _mark(obj: dict, new_conditions: List[dict]) -> None:
+    conditions = obj.setdefault("status", {}).setdefault("conditions", [])
+    for c in new_conditions:
+        set_condition(conditions, c)
+
+
+class Updater:
+    """Writes Ready/Error condition pairs to a CR's status subresource.
+
+    Prefer the pure :func:`mark_ready`/:func:`mark_error` + one explicit
+    ``update_status`` when the caller also changes other status fields —
+    status and conditions must land in a single write so readers never see a
+    ready state with stale conditions.
+    """
+
+    def __init__(self, client: Client):
+        self._client = client
+
+    def set_ready(self, obj: dict, message: str = "All operands are ready") -> None:
+        mark_ready(obj, message)
+        self._write(obj)
+
+    def set_error(self, obj: dict, reason: str, message: str) -> None:
+        mark_error(obj, reason, message)
+        self._write(obj)
+
+    def _write(self, obj: dict) -> None:
+        try:
+            self._client.update_status(obj)
+        except (ConflictError, NotFoundError):
+            # Level-driven reconcilers re-run on the next event; a lost status
+            # write self-heals (reference relies on the same requeue property).
+            pass
+
+
+def get_condition(obj: dict, type_: str) -> Optional[dict]:
+    for c in obj.get("status", {}).get("conditions", []):
+        if c.get("type") == type_:
+            return c
+    return None

@@ -1,0 +1,59 @@
+"""Shared constants (reference: internal/consts/consts.go).
+
+Label/annotation vocabulary for the TPU operator. GKE-standard TPU node labels
+are consumed (never written) by discovery; everything under ``tpu.ai/`` is
+owned by this operator.
+"""
+
+# -- operator identity -------------------------------------------------------
+OPERATOR_NAME = "tpu-operator"
+NAMESPACE_ENV = "OPERATOR_NAMESPACE"
+DEFAULT_NAMESPACE = "tpu-operator"
+
+# -- labels/annotations written by the operator ------------------------------
+#: every object created by the state engine carries the state that owns it
+STATE_LABEL = "tpu.ai/operator.state"
+#: DaemonSet spec-drift detection (FNV-32a over canonical JSON of the spec)
+SPEC_HASH_ANNOTATION = "tpu.ai/operator-spec-hash"
+#: set on TPU nodes (analog of nvidia.com/gpu.present)
+TPU_PRESENT_LABEL = "tpu.ai/tpu.present"
+#: per-operand node kill-switches (analog of nvidia.com/gpu.deploy.<operand>)
+DEPLOY_LABEL_PREFIX = "tpu.ai/tpu.deploy."
+#: chip/topology labels written by feature discovery
+TPU_CHIP_TYPE_LABEL = "tpu.ai/tpu.chip-type"
+TPU_CHIP_COUNT_LABEL = "tpu.ai/tpu.chip-count"
+TPU_TOPOLOGY_LABEL = "tpu.ai/tpu.topology"
+TPU_SLICE_CONFIG_LABEL = "tpu.ai/slice.config"
+TPU_SLICE_STATE_LABEL = "tpu.ai/slice.config.state"
+#: upgrade state machine's per-node persistent state
+UPGRADE_STATE_LABEL = "tpu.ai/tpu-driver-upgrade-state"
+UPGRADE_SKIP_DRAIN_LABEL = "tpu.ai/tpu-driver-upgrade-drain.skip"
+
+# -- labels read from the platform (GKE / device discovery) -------------------
+GKE_TPU_ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"
+GKE_TPU_TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"
+
+# -- node-local paths ---------------------------------------------------------
+#: status-file barrier dir (analog of /run/nvidia/validations)
+VALIDATION_STATUS_DIR = "/run/tpu/validations"
+DEFAULT_LIBTPU_DIR = "/home/kubernetes/bin/libtpu"
+#: TPU device nodes on a TPU VM
+TPU_DEV_GLOBS = ("/dev/accel*", "/dev/vfio/*")
+
+#: schedulable extended resource
+TPU_RESOURCE_NAME = "google.com/tpu"
+
+#: operand names, used for deploy labels + state wiring
+OPERANDS = (
+    "driver",
+    "device-plugin",
+    "feature-discovery",
+    "telemetry",
+    "node-status-exporter",
+    "operator-validator",
+    "slice-partitioner",
+)
+
+
+def deploy_label(operand: str) -> str:
+    return DEPLOY_LABEL_PREFIX + operand

@@ -1,0 +1,173 @@
+"""ClusterPolicy reconciler: the main loop of the whole system.
+
+Mirrors the reference's hot path (SURVEY.md 3.2,
+controllers/clusterpolicy_controller.go:94-235 + state_manager.go:753-979):
+each reconcile labels TPU nodes, sweeps the ordered state DAG, and gates
+``status.state=ready`` on every state's readiness, requeueing after 5 s while
+anything is NotReady. Level-driven and idempotent: every sweep re-renders and
+re-applies everything (hash-skips make that cheap).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import List, Optional
+
+from .. import consts
+from ..api.clusterpolicy import ClusterPolicy, State
+from ..client.errors import ConflictError, NotFoundError
+from ..client.interface import Client, WatchEvent
+from ..conditions import (
+    REASON_OPERAND_NOT_READY,
+    REASON_RECONCILE_FAILED,
+    mark_error,
+    mark_ready,
+)
+from ..nodeinfo import label_tpu_nodes
+from ..state.manager import (
+    INFO_CLUSTER_INFO,
+    INFO_CLUSTER_POLICY,
+    INFO_NAMESPACE,
+    INFO_NODES,
+    InfoCatalog,
+    Manager,
+)
+from ..state.operands import cluster_policy_states
+from ..utils import deep_get
+from .metrics import OperatorMetrics
+from .runtime import Controller, Reconciler, Request, Result
+
+log = logging.getLogger(__name__)
+
+#: reference requeues 5 s on NotReady (clusterpolicy_controller.go:165,193)
+NOT_READY_REQUEUE = 5.0
+
+
+class ClusterPolicyReconciler(Reconciler):
+    name = "clusterpolicy"
+
+    def __init__(self, client: Client, namespace: Optional[str] = None,
+                 metrics: Optional[OperatorMetrics] = None,
+                 cluster_info=None, requeue_after: float = NOT_READY_REQUEUE):
+        self.client = client
+        self.namespace = namespace or os.environ.get(consts.NAMESPACE_ENV, consts.DEFAULT_NAMESPACE)
+        self.metrics = metrics or OperatorMetrics()
+        self.cluster_info = cluster_info
+        self.requeue_after = requeue_after
+        self.state_manager = Manager(cluster_policy_states(client))
+
+    # -- singleton guard (reference clusterpolicy_controller.go:121-126) ------
+    def _resolve_singleton(self, request: Request) -> Optional[ClusterPolicy]:
+        policies = self.client.list("tpu.ai/v1", "ClusterPolicy")
+        if not policies:
+            return None
+        policies.sort(key=lambda p: (p["metadata"].get("creationTimestamp", ""),
+                                     p["metadata"]["name"]))
+        primary = policies[0]
+        for extra in policies[1:]:
+            if deep_get(extra, "status", "state") != State.IGNORED:
+                extra.setdefault("status", {})["state"] = State.IGNORED
+                self._write_status(extra)
+        if primary["metadata"]["name"] != request.name:
+            return None  # reconcile of a non-primary instance: nothing to do
+        return ClusterPolicy.from_obj(primary)
+
+    def _write_status(self, obj: dict) -> None:
+        try:
+            self.client.update_status(obj)
+        except (ConflictError, NotFoundError):
+            # benign write race with a concurrent editor; the level-driven
+            # requeue re-reads and self-heals (reference relies on the same)
+            pass
+
+    def reconcile(self, request: Request) -> Result:
+        self.metrics.reconciliation_total.inc()
+        try:
+            return self._reconcile(request)
+        except Exception:
+            self.metrics.reconciliation_failed.inc()
+            self.metrics.reconciliation_status.set(0)
+            raise
+
+    def _reconcile(self, request: Request) -> Result:
+        start = time.monotonic()
+        try:
+            policy = self._resolve_singleton(request)
+        except NotFoundError:
+            policy = None
+        if policy is None:
+            return Result()
+
+        # node labeling sweep (state_manager.go:857 labelGPUNodes analog)
+        label_result = label_tpu_nodes(self.client, policy)
+        self.metrics.tpu_nodes_total.set(label_result.tpu_nodes)
+
+        catalog = InfoCatalog()
+        catalog[INFO_CLUSTER_POLICY] = policy
+        catalog[INFO_NAMESPACE] = self.namespace
+        catalog[INFO_CLUSTER_INFO] = self.cluster_info
+        catalog[INFO_NODES] = label_result.nodes
+
+        results = self.state_manager.sync_state(catalog)
+
+        if results.ready:
+            policy.set_state(State.READY, self.namespace)
+            mark_ready(policy.obj)
+            self._write_status(policy.obj)  # state + conditions atomically
+            self.metrics.reconciliation_status.set(1)
+            self.metrics.reconciliation_last_success.set_to_current_time()
+            log.info("ClusterPolicy %s ready (%.3fs, %d TPU nodes)",
+                     policy.name, time.monotonic() - start, label_result.tpu_nodes)
+            return Result()
+
+        blocker = results.first_not_ready()
+        policy.set_state(State.NOT_READY, self.namespace)
+        reason = (REASON_RECONCILE_FAILED if blocker and blocker.status.value == "error"
+                  else REASON_OPERAND_NOT_READY)
+        message = f"state {blocker.state_name} is {blocker.status.value}" if blocker else "not ready"
+        if blocker and blocker.message:
+            message += f": {blocker.message}"
+        mark_error(policy.obj, reason, message)
+        self._write_status(policy.obj)  # state + conditions atomically
+        self.metrics.reconciliation_status.set(0)
+        log.info("ClusterPolicy %s not ready: %s", policy.name, message)
+        return Result(requeue_after=self.requeue_after)
+
+
+# -- watch wiring (reference SetupWithManager, clusterpolicy_controller.go:355-423)
+
+def _all_policy_requests(client: Client) -> List[Request]:
+    return [Request(name=p["metadata"]["name"])
+            for p in client.list("tpu.ai/v1", "ClusterPolicy")]
+
+
+def setup_clusterpolicy_controller(client: Client,
+                                   reconciler: ClusterPolicyReconciler) -> Controller:
+    controller = Controller(reconciler)
+
+    def map_policy(event: WatchEvent) -> List[Request]:
+        return [Request(name=event.object["metadata"]["name"])]
+
+    def map_node(event: WatchEvent) -> List[Request]:
+        # node added/changed/removed -> re-reconcile the policy (node labeling
+        # + DS scheduling may change; reference addWatchNewGPUNode :256-352)
+        return _all_policy_requests(client)
+
+    def map_owned(event: WatchEvent) -> List[Request]:
+        labels = deep_get(event.object, "metadata", "labels", default={}) or {}
+        if consts.STATE_LABEL in labels:
+            return _all_policy_requests(client)
+        return []
+
+    def map_tpudriver(event: WatchEvent) -> List[Request]:
+        # TPUDriver instances appearing/disappearing flips ownership of the
+        # driver state (hand-over/hand-back), so the policy must re-reconcile
+        return _all_policy_requests(client)
+
+    controller.watches("tpu.ai/v1", "ClusterPolicy", map_policy)
+    controller.watches("v1", "Node", map_node)
+    controller.watches("apps/v1", "DaemonSet", map_owned)
+    controller.watches("tpu.ai/v1alpha1", "TPUDriver", map_tpudriver)
+    return controller

@@ -1,0 +1,129 @@
+"""Operator process wiring (reference cmd/gpu-operator/main.go:74-233):
+build the client, register controllers, serve metrics/health, run forever.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import signal
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .. import __version__
+from ..client.rest import RestClient
+from .clusterpolicy_controller import (
+    ClusterPolicyReconciler,
+    setup_clusterpolicy_controller,
+)
+from .metrics import OperatorMetrics
+from .runtime import ControllerManager, Request
+
+log = logging.getLogger(__name__)
+
+
+def serve_health_and_metrics(metrics: OperatorMetrics, metrics_port: int,
+                             health_port: int):
+    servers = []
+
+    class MetricsHandler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            if self.path.rstrip("/") == "/metrics":
+                payload = metrics.scrape()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+            else:
+                self.send_response(404)
+                self.end_headers()
+
+    class HealthHandler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            body = json.dumps({"status": "ok", "version": __version__}).encode()
+            code = 200 if self.path.rstrip("/") in ("/healthz", "/readyz") else 404
+            self.send_response(code)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            if code == 200:
+                self.wfile.write(body)
+
+    for port, handler in ((metrics_port, MetricsHandler), (health_port, HealthHandler)):
+        if not port:
+            continue
+        server = ThreadingHTTPServer(("0.0.0.0", port), handler)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        servers.append(server)
+    return servers
+
+
+class OperatorApp:
+    """The assembled operator: client + controllers + metrics/health servers."""
+
+    def __init__(self, client, namespace=None, metrics_port: int = 0, health_port: int = 0):
+        self.client = client
+        self.metrics = OperatorMetrics()
+        self.manager = ControllerManager(client)
+        self.clusterpolicy_reconciler = ClusterPolicyReconciler(
+            client, namespace=namespace, metrics=self.metrics)
+        self.clusterpolicy_controller = self.manager.add(
+            setup_clusterpolicy_controller(client, self.clusterpolicy_reconciler))
+        from .tpudriver_controller import TPUDriverReconciler, setup_tpudriver_controller
+
+        self.tpudriver_reconciler = TPUDriverReconciler(client, namespace=namespace)
+        self.tpudriver_controller = self.manager.add(
+            setup_tpudriver_controller(client, self.tpudriver_reconciler))
+        from .upgrade_controller import UpgradeReconciler, setup_upgrade_controller
+
+        self.upgrade_reconciler = UpgradeReconciler(client, namespace=namespace,
+                                                    metrics=self.metrics)
+        self.upgrade_controller = self.manager.add(
+            setup_upgrade_controller(client, self.upgrade_reconciler))
+        self._metrics_port = metrics_port
+        self._health_port = health_port
+        self._servers: list = []
+
+    def start(self) -> None:
+        self._servers = serve_health_and_metrics(
+            self.metrics, self._metrics_port, self._health_port)
+        self.manager.start()
+        # kick an initial reconcile even if no watch event ever fires
+        for policy in self.client.list("tpu.ai/v1", "ClusterPolicy"):
+            self.clusterpolicy_controller.queue.add(Request(name=policy["metadata"]["name"]))
+
+    def stop(self) -> None:
+        self.manager.stop()
+        for s in self._servers:
+            s.shutdown()
+
+
+def run_operator(args) -> int:
+    logging.basicConfig(
+        level=getattr(logging, args.log_level.upper()),
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    log.info("tpu-operator %s starting", __version__)
+
+    client = RestClient(base_url=args.api_server, token=args.token)
+    app = OperatorApp(client, namespace=args.namespace,
+                      metrics_port=args.metrics_port, health_port=args.health_port)
+
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            signal.signal(sig, lambda *_: stop.set())
+        except ValueError:
+            pass  # not the main thread (tests)
+
+    app.start()
+    log.info("controllers running; metrics :%s health :%s", args.metrics_port, args.health_port)
+    stop.wait()
+    log.info("shutting down")
+    app.stop()
+    return 0

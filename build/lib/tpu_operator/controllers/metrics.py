@@ -1,0 +1,51 @@
+"""Operator Prometheus metrics (reference: controllers/operator_metrics.go:29-201).
+
+Same metric vocabulary, ``gpu`` -> ``tpu``. Registered on a dedicated
+registry so tests can scrape without global-state collisions.
+"""
+
+from __future__ import annotations
+
+from prometheus_client import CollectorRegistry, Counter, Gauge, generate_latest
+
+
+class OperatorMetrics:
+    def __init__(self, registry: CollectorRegistry | None = None):
+        self.registry = registry or CollectorRegistry()
+        self.reconciliation_total = Counter(
+            "tpu_operator_reconciliation_total",
+            "Total number of ClusterPolicy reconciliations", registry=self.registry)
+        self.reconciliation_failed = Counter(
+            "tpu_operator_reconciliation_failed_total",
+            "Number of failed ClusterPolicy reconciliations", registry=self.registry)
+        self.reconciliation_status = Gauge(
+            "tpu_operator_reconciliation_status",
+            "1 when the last reconciliation reached ready, 0 otherwise",
+            registry=self.registry)
+        self.reconciliation_last_success = Gauge(
+            "tpu_operator_reconciliation_last_success_ts_seconds",
+            "Timestamp of the last successful reconciliation", registry=self.registry)
+        self.tpu_nodes_total = Gauge(
+            "tpu_operator_tpu_nodes_total",
+            "Number of TPU nodes in the cluster", registry=self.registry)
+        self.driver_render_failed = Counter(
+            "tpu_operator_driver_render_failed_total",
+            "Driver manifest render failures", registry=self.registry)
+        self.upgrades_in_progress = Gauge(
+            "tpu_operator_nodes_upgrades_in_progress",
+            "Nodes currently upgrading the TPU driver", registry=self.registry)
+        self.upgrades_done = Gauge(
+            "tpu_operator_nodes_upgrades_done",
+            "Nodes that completed driver upgrade", registry=self.registry)
+        self.upgrades_failed = Gauge(
+            "tpu_operator_nodes_upgrades_failed",
+            "Nodes with failed driver upgrade", registry=self.registry)
+        self.upgrades_pending = Gauge(
+            "tpu_operator_nodes_upgrades_pending",
+            "Nodes pending driver upgrade", registry=self.registry)
+        self.upgrades_available = Gauge(
+            "tpu_operator_nodes_upgrades_available",
+            "Nodes available for driver upgrade", registry=self.registry)
+
+    def scrape(self) -> bytes:
+        return generate_latest(self.registry)

@@ -1,0 +1,195 @@
+"""Minimal controller-runtime: workqueue + watches + single-flight workers.
+
+The scheduling model copies what the reference actually relies on from
+controller-runtime (SURVEY.md 5.2/5.3): one worker per controller
+(MaxConcurrentReconciles=1), request dedup in the queue, exponential
+per-item backoff 100ms-3s on error, and explicit requeue-after support
+(clusterpolicy_controller.go:51-52,165,193).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..client.interface import Client, WatchEvent
+
+log = logging.getLogger(__name__)
+
+BASE_BACKOFF = 0.1
+MAX_BACKOFF = 3.0
+
+
+@dataclasses.dataclass(frozen=True)
+class Request:
+    name: str
+    namespace: str = ""
+
+
+@dataclasses.dataclass
+class Result:
+    requeue_after: Optional[float] = None
+
+
+class Reconciler:
+    name = "reconciler"
+
+    def reconcile(self, request: Request) -> Result:
+        raise NotImplementedError
+
+
+class RateLimitingQueue:
+    """Deduplicating delay queue with per-item exponential backoff."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._heap: List[Tuple[float, int, Request]] = []
+        self._due: Dict[Request, float] = {}  # pending requests -> earliest due time
+        self._failures: Dict[Request, int] = {}
+        self._seq = 0
+        self._shutdown = False
+
+    def add(self, request: Request, delay: float = 0.0) -> None:
+        """Enqueue; re-adding a pending request keeps the EARLIER due time
+        (an immediate watch event must not wait out a pending slow requeue)."""
+        due = time.monotonic() + delay
+        with self._cond:
+            if self._shutdown:
+                return
+            current = self._due.get(request)
+            if current is not None and current <= due:
+                return
+            self._due[request] = due
+            self._seq += 1
+            heapq.heappush(self._heap, (due, self._seq, request))
+            self._cond.notify()
+
+    def add_rate_limited(self, request: Request) -> None:
+        failures = self._failures.get(request, 0)
+        self._failures[request] = failures + 1
+        self.add(request, min(BASE_BACKOFF * (2 ** failures), MAX_BACKOFF))
+
+    def forget(self, request: Request) -> None:
+        self._failures.pop(request, None)
+
+    def get(self, timeout: Optional[float] = None) -> Optional[Request]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while True:
+                if self._shutdown:
+                    return None
+                now = time.monotonic()
+                while self._heap and self._heap[0][0] <= now:
+                    due, _, request = heapq.heappop(self._heap)
+                    if self._due.get(request) != due:
+                        continue  # stale entry superseded by an earlier add
+                    del self._due[request]
+                    return request
+                wait = self._heap[0][0] - now if self._heap else None
+                if deadline is not None:
+                    remaining = deadline - now
+                    if remaining <= 0:
+                        return None
+                    wait = remaining if wait is None else min(wait, remaining)
+                self._cond.wait(wait)
+
+    def shutdown(self) -> None:
+        with self._cond:
+            self._shutdown = True
+            self._cond.notify_all()
+
+    def __len__(self) -> int:
+        with self._cond:
+            return len(self._due)
+
+
+@dataclasses.dataclass
+class _WatchSpec:
+    api_version: str
+    kind: str
+    namespace: Optional[str]
+    mapper: Callable[[WatchEvent], List[Request]]
+
+
+class Controller:
+    def __init__(self, reconciler: Reconciler):
+        self.reconciler = reconciler
+        self.queue = RateLimitingQueue()
+        self.watch_specs: List[_WatchSpec] = []
+        self._handles: list = []
+        self._thread: Optional[threading.Thread] = None
+
+    def watches(self, api_version: str, kind: str,
+                mapper: Callable[[WatchEvent], List[Request]],
+                namespace: Optional[str] = None) -> "Controller":
+        self.watch_specs.append(_WatchSpec(api_version, kind, namespace, mapper))
+        return self
+
+    def start(self, client: Client) -> None:
+        for spec in self.watch_specs:
+            def handler(event: WatchEvent, _spec=spec) -> None:
+                try:
+                    for request in _spec.mapper(event):
+                        self.queue.add(request)
+                except Exception:
+                    log.exception("%s: watch mapper failed", self.reconciler.name)
+            self._handles.append(client.watch(spec.api_version, spec.kind, spec.namespace, handler))
+        self._thread = threading.Thread(target=self._worker, daemon=True,
+                                        name=f"{self.reconciler.name}-worker")
+        self._thread.start()
+
+    def _worker(self) -> None:
+        while True:
+            request = self.queue.get()
+            if request is None:
+                return
+            try:
+                result = self.reconciler.reconcile(request)
+            except Exception:
+                log.exception("%s: reconcile %s failed", self.reconciler.name, request)
+                self.queue.add_rate_limited(request)
+                continue
+            self.queue.forget(request)
+            if result and result.requeue_after is not None:
+                self.queue.add(request, result.requeue_after)
+
+    def stop(self) -> None:
+        for h in self._handles:
+            h.stop()
+        self.queue.shutdown()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def wait_idle(self, timeout: float = 10.0, settle: float = 0.05) -> bool:
+        """Test helper: wait until the queue drains and stays drained."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if len(self.queue) == 0:
+                time.sleep(settle)
+                if len(self.queue) == 0:
+                    return True
+            else:
+                time.sleep(0.01)
+        return False
+
+
+class ControllerManager:
+    def __init__(self, client: Client):
+        self.client = client
+        self.controllers: List[Controller] = []
+
+    def add(self, controller: Controller) -> Controller:
+        self.controllers.append(controller)
+        return controller
+
+    def start(self) -> None:
+        for c in self.controllers:
+            c.start(self.client)
+
+    def stop(self) -> None:
+        for c in self.controllers:
+            c.stop()

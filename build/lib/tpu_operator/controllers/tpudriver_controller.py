@@ -1,0 +1,188 @@
+"""TPUDriver reconciler: per-instance libtpu rollout with pool fan-out.
+
+Analog of the reference's NVIDIADriver controller + stateDriver (SURVEY.md
+3.3; controllers/nvidiadriver_controller.go:75-207, internal/state/
+driver.go:129-301): each TPUDriver CR selects a set of nodes, the nodes are
+partitioned into (accelerator, topology) pools, and one libtpu DaemonSet is
+rendered per pool. Conflicting instances (two CRs selecting the same node)
+are rejected with a ConflictingNodeSelector condition; stale per-pool DSes
+are garbage-collected when pools disappear.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Dict, List, Optional
+
+from .. import consts
+from ..api.clusterpolicy import ClusterPolicy, State
+from ..api.tpudriver import TPUDriver
+from ..client.errors import ConflictError, NotFoundError
+from ..client.interface import Client, WatchEvent
+from ..conditions import (
+    REASON_CONFLICTING_NODE_SELECTOR,
+    REASON_RECONCILE_FAILED,
+    mark_error,
+    mark_ready,
+)
+from ..nodeinfo import is_tpu_node
+from ..state.driver import DriverRenderOverrides, StateDriver
+from ..state.nodepool import get_node_pools
+from ..state.skel import StateSkel, SyncState, node_matches_selector
+from ..utils import deep_get
+from .runtime import Controller, Reconciler, Request, Result
+
+log = logging.getLogger(__name__)
+
+#: DS label tying a DaemonSet to its owning TPUDriver instance
+INSTANCE_LABEL = "tpu.ai/driver-instance"
+
+NOT_READY_REQUEUE = 5.0
+
+
+def find_selector_conflicts(instances: List[TPUDriver], nodes: List[dict]) -> Dict[str, List[str]]:
+    """node name -> list of instance names claiming it (len>1 == conflict)
+    (reference internal/validator/validator.go:31-47)."""
+    claims: Dict[str, List[str]] = {}
+    for instance in instances:
+        selector = instance.spec.get_node_selector()
+        for node in nodes:
+            if node_matches_selector(node, selector):
+                claims.setdefault(node["metadata"]["name"], []).append(instance.name)
+    return {n: owners for n, owners in claims.items() if len(owners) > 1}
+
+
+class TPUDriverReconciler(Reconciler):
+    name = "tpudriver"
+
+    def __init__(self, client: Client, namespace: Optional[str] = None,
+                 requeue_after: float = NOT_READY_REQUEUE):
+        self.client = client
+        self.namespace = namespace or os.environ.get(consts.NAMESPACE_ENV, consts.DEFAULT_NAMESPACE)
+        self.requeue_after = requeue_after
+        self.state_driver = StateDriver(client)
+
+    # -- helpers --------------------------------------------------------------
+    def _cluster_policy(self) -> Optional[ClusterPolicy]:
+        policies = self.client.list("tpu.ai/v1", "ClusterPolicy")
+        if not policies:
+            return None
+        policies.sort(key=lambda p: (p["metadata"].get("creationTimestamp", ""),
+                                     p["metadata"]["name"]))
+        return ClusterPolicy.from_obj(policies[0])
+
+    def _write_status(self, obj: dict) -> None:
+        try:
+            self.client.update_status(obj)
+        except (ConflictError, NotFoundError):
+            pass
+
+    def _set_state(self, driver: TPUDriver, state: str) -> None:
+        driver.status["state"] = state
+        self._write_status(driver.obj)
+
+    # -- reconcile ------------------------------------------------------------
+    def reconcile(self, request: Request) -> Result:
+        try:
+            obj = self.client.get("tpu.ai/v1alpha1", "TPUDriver", request.name)
+        except NotFoundError:
+            return Result()  # deleted; owned DSes go via ownerRef GC
+        driver = TPUDriver.from_obj(obj)
+
+        policy = self._cluster_policy()
+        if policy is None:
+            driver.status["state"] = State.NOT_READY
+            mark_error(driver.obj, REASON_RECONCILE_FAILED,
+                       "no ClusterPolicy found; TPUDriver requires one for cluster defaults")
+            self._write_status(driver.obj)
+            return Result(requeue_after=self.requeue_after)
+
+        errors = driver.spec.validate()
+        if errors:
+            driver.status["state"] = State.NOT_READY
+            mark_error(driver.obj, REASON_RECONCILE_FAILED, "; ".join(errors))
+            self._write_status(driver.obj)
+            return Result()  # spec is wrong; requeue only on CR edit
+
+        all_nodes = [n for n in self.client.list("v1", "Node") if is_tpu_node(n)]
+        instances = [TPUDriver.from_obj(o)
+                     for o in self.client.list("tpu.ai/v1alpha1", "TPUDriver")]
+        conflicts = find_selector_conflicts(instances, all_nodes)
+        mine_conflicted = {n for n, owners in conflicts.items() if driver.name in owners}
+        if mine_conflicted:
+            driver.status["state"] = State.NOT_READY
+            mark_error(driver.obj, REASON_CONFLICTING_NODE_SELECTOR,
+                       f"nodes claimed by multiple TPUDrivers: {sorted(mine_conflicted)}")
+            self._write_status(driver.obj)
+            return Result(requeue_after=self.requeue_after)
+
+        selector = driver.spec.get_node_selector()
+        selected = [n for n in all_nodes if node_matches_selector(n, selector)]
+        pools = get_node_pools(selected)
+
+        skel = StateSkel(f"tpudriver-{driver.name}", self.client)
+        desired_names = set()
+        applied: List[dict] = []
+        for pool in pools:
+            app_name = f"libtpu-driver-{driver.name}-{pool.name}"[:63].rstrip("-")
+            desired_names.add(app_name)
+            overrides = DriverRenderOverrides(
+                app_name=app_name,
+                node_selector={**pool.node_selector, **selector},
+                libtpu_version=driver.spec.libtpu_version,
+                image=driver.spec.image_path(),
+                extra_labels={INSTANCE_LABEL: driver.name,
+                              "tpu.ai/node-pool": pool.name},
+            )
+            objs = self.state_driver.render_objects(policy, self.namespace,
+                                                    overrides, driver_spec=driver.spec)
+            applied.extend(skel.create_or_update_objs(objs, owner=driver.obj))
+
+        self._cleanup_stale(skel, desired_names)
+
+        status = skel.get_sync_state(applied, nodes=all_nodes)
+        if status == SyncState.READY:
+            driver.status["state"] = State.READY
+            driver.status["pools"] = {p.name: p.size for p in pools}
+            mark_ready(driver.obj, f"{len(pools)} pool(s) ready")
+            self._write_status(driver.obj)
+            log.info("TPUDriver %s ready (%d pools, %d nodes)",
+                     driver.name, len(pools), len(selected))
+            return Result()
+        driver.status["state"] = State.NOT_READY
+        mark_error(driver.obj, "DriverNotReady", "per-pool driver DaemonSets not ready")
+        self._write_status(driver.obj)
+        return Result(requeue_after=self.requeue_after)
+
+    def _cleanup_stale(self, skel: StateSkel, desired_names: set) -> None:
+        """Remove per-pool DSes whose pool vanished (reference
+        cleanupStaleDriverDaemonsets, internal/state/driver.go:181)."""
+        for ds in skel.list_owned("apps/v1", "DaemonSet", self.namespace):
+            name = ds["metadata"]["name"]
+            if name not in desired_names:
+                log.info("cleaning stale pool DS %s", name)
+                try:
+                    self.client.delete("apps/v1", "DaemonSet", name, self.namespace)
+                except NotFoundError:
+                    pass
+
+
+def setup_tpudriver_controller(client: Client, reconciler: TPUDriverReconciler) -> Controller:
+    controller = Controller(reconciler)
+
+    def all_instances(_event: WatchEvent) -> List[Request]:
+        return [Request(name=o["metadata"]["name"])
+                for o in client.list("tpu.ai/v1alpha1", "TPUDriver")]
+
+    def map_instance(event: WatchEvent) -> List[Request]:
+        return [Request(name=event.object["metadata"]["name"])]
+
+    def map_owned(event: WatchEvent) -> List[Request]:
+        instance = deep_get(event.object, "metadata", "labels", INSTANCE_LABEL)
+        return [Request(name=instance)] if instance else []
+
+    controller.watches("tpu.ai/v1alpha1", "TPUDriver", map_instance)
+    controller.watches("v1", "Node", all_instances)
+    controller.watches("apps/v1", "DaemonSet", map_owned)
+    return controller

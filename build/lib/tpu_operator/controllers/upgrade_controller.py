@@ -1,0 +1,89 @@
+"""Upgrade reconciler (reference controllers/upgrade_controller.go:81-198):
+drives the per-node upgrade state machine from the ClusterPolicy's
+driver.upgradePolicy, publishes progress metrics, requeues every 2 minutes.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import List, Optional
+
+from .. import consts
+from ..api.clusterpolicy import ClusterPolicy
+from ..client.interface import Client, WatchEvent
+from ..nodeinfo import is_tpu_node
+from ..upgrade import UpgradeStateMachine
+from ..utils import deep_get
+from .metrics import OperatorMetrics
+from .runtime import Controller, Reconciler, Request, Result
+
+log = logging.getLogger(__name__)
+
+#: reference plans a requeue every 2 min (upgrade_controller.go:59,197)
+PLANNED_REQUEUE = 120.0
+
+SINGLETON_REQUEST = Request(name="driver-upgrade")
+
+
+class UpgradeReconciler(Reconciler):
+    name = "upgrade"
+
+    def __init__(self, client: Client, namespace: Optional[str] = None,
+                 metrics: Optional[OperatorMetrics] = None,
+                 requeue_after: float = PLANNED_REQUEUE):
+        self.client = client
+        self.namespace = namespace or os.environ.get(consts.NAMESPACE_ENV, consts.DEFAULT_NAMESPACE)
+        self.metrics = metrics or OperatorMetrics()
+        self.requeue_after = requeue_after
+
+    def _policy(self) -> Optional[ClusterPolicy]:
+        policies = self.client.list("tpu.ai/v1", "ClusterPolicy")
+        if not policies:
+            return None
+        policies.sort(key=lambda p: (p["metadata"].get("creationTimestamp", ""),
+                                     p["metadata"]["name"]))
+        return ClusterPolicy.from_obj(policies[0])
+
+    def _tpu_nodes(self) -> List[dict]:
+        return [n for n in self.client.list("v1", "Node") if is_tpu_node(n)]
+
+    def reconcile(self, request: Request) -> Result:
+        policy = self._policy()
+        nodes = self._tpu_nodes()
+        machine = UpgradeStateMachine(
+            self.client, self.namespace,
+            policy.spec.driver.upgrade_policy if policy else None)
+
+        if policy is None or not policy.spec.driver.upgrade_policy.auto_upgrade:
+            machine.clear_all(nodes)
+            return Result()
+
+        counts = machine.process(nodes)
+        self.metrics.upgrades_pending.set(counts.pending)
+        self.metrics.upgrades_in_progress.set(counts.in_progress)
+        self.metrics.upgrades_done.set(counts.done)
+        self.metrics.upgrades_failed.set(counts.failed)
+        self.metrics.upgrades_available.set(counts.available)
+        if counts.pending or counts.in_progress:
+            log.info("upgrade sweep: %s", counts.as_dict())
+        return Result(requeue_after=self.requeue_after)
+
+
+def setup_upgrade_controller(client: Client, reconciler: UpgradeReconciler) -> Controller:
+    controller = Controller(reconciler)
+
+    def singleton(_event: WatchEvent) -> List[Request]:
+        return [SINGLETON_REQUEST]
+
+    def map_pod(event: WatchEvent) -> List[Request]:
+        component = deep_get(event.object, "metadata", "labels",
+                             "app.kubernetes.io/component", default="")
+        if component in ("tpu-driver", "tpu-operator-validator"):
+            return [SINGLETON_REQUEST]
+        return []
+
+    controller.watches("tpu.ai/v1", "ClusterPolicy", singleton)
+    controller.watches("v1", "Node", singleton)
+    controller.watches("v1", "Pod", map_pod)
+    return controller

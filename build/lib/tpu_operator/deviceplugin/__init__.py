@@ -1,0 +1,3 @@
+from .plugin import TPUDevicePlugin
+
+__all__ = ["TPUDevicePlugin"]

@@ -1,0 +1,8 @@
+import os
+import sys
+
+# protoc --python_out generates a module that imports itself by bare name
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from . import deviceplugin_pb2  # noqa: E402,F401
+
+__all__ = ["deviceplugin_pb2"]

@@ -1,0 +1,11 @@
+from .node_info import NodeAttributes, NodeFilter, is_tpu_node, tpu_capacity
+from .labeler import LabelResult, label_tpu_nodes
+
+__all__ = [
+    "NodeAttributes",
+    "NodeFilter",
+    "is_tpu_node",
+    "tpu_capacity",
+    "LabelResult",
+    "label_tpu_nodes",
+]

@@ -1,0 +1,100 @@
+"""TPU node labeling: presence marker + per-operand deploy labels.
+
+Analog of the reference's labelGPUNodes + gpuStateLabels
+(controllers/state_manager.go:86-111,363-421,481-581): every TPU node gets
+``tpu.ai/tpu.present=true`` plus one ``tpu.ai/tpu.deploy.<operand>`` label per
+enabled operand. Pre-existing ``...deploy.*=false`` values are honored as
+per-node kill switches (state_manager.go:377-383). Labels are removed when a
+node stops being a TPU node (hardware removed / relabeled).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Dict, List, Optional
+
+from .. import consts
+from ..api.clusterpolicy import ClusterPolicy
+from ..client.interface import Client
+from ..utils import deep_get
+from .node_info import is_tpu_node
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class LabelResult:
+    tpu_nodes: int = 0
+    labeled: int = 0
+    cleaned: int = 0
+    #: post-labeling node snapshot, reusable by the same reconcile sweep
+    nodes: List[dict] = dataclasses.field(default_factory=list)
+
+
+def operand_enabled(policy: ClusterPolicy, operand: str) -> bool:
+    spec = policy.spec
+    return {
+        "driver": spec.driver.is_enabled(),
+        "device-plugin": spec.device_plugin.is_enabled(),
+        "feature-discovery": spec.feature_discovery.is_enabled(),
+        "telemetry": spec.telemetry.is_enabled(),
+        "node-status-exporter": spec.node_status_exporter.is_enabled(),
+        "operator-validator": spec.validator.is_enabled(),
+        "slice-partitioner": spec.slice_partitioner.is_enabled(),
+    }.get(operand, False)
+
+
+def desired_state_labels(policy: ClusterPolicy) -> Dict[str, str]:
+    labels = {consts.TPU_PRESENT_LABEL: "true"}
+    for operand in consts.OPERANDS:
+        if operand_enabled(policy, operand):
+            labels[consts.deploy_label(operand)] = "true"
+    return labels
+
+
+def _apply_label_patch(node: dict, patch: Dict[str, Optional[str]]) -> None:
+    labels = node.setdefault("metadata", {}).setdefault("labels", {})
+    for key, value in patch.items():
+        if value is None:
+            labels.pop(key, None)
+        else:
+            labels[key] = value
+
+
+def label_tpu_nodes(client: Client, policy: ClusterPolicy) -> LabelResult:
+    result = LabelResult(nodes=client.list("v1", "Node"))
+    for node in result.nodes:
+        name = node["metadata"]["name"]
+        labels = deep_get(node, "metadata", "labels", default={}) or {}
+        if is_tpu_node(node):
+            result.tpu_nodes += 1
+            patch: Dict[str, Optional[str]] = {}
+            for key, value in desired_state_labels(policy).items():
+                if labels.get(key) == "false" and key != consts.TPU_PRESENT_LABEL:
+                    continue  # per-node kill switch wins
+                if labels.get(key) != value:
+                    patch[key] = value
+            # disabled operands lose their deploy label (unless kill-switched)
+            for operand in consts.OPERANDS:
+                key = consts.deploy_label(operand)
+                if key in labels and labels[key] != "false" and not operand_enabled(policy, operand):
+                    patch[key] = None
+            if patch:
+                log.info("labeling TPU node %s: %s", name, patch)
+                client.patch("v1", "Node", name, {"metadata": {"labels": patch}})
+                _apply_label_patch(node, patch)  # keep the snapshot current
+                result.labeled += 1
+        else:
+            stale = [k for k in labels
+                     if k == consts.TPU_PRESENT_LABEL or k.startswith(consts.DEPLOY_LABEL_PREFIX)]
+            if stale:
+                log.info("cleaning TPU labels from node %s", name)
+                client.patch("v1", "Node", name, {"metadata": {"labels": {k: None for k in stale}}})
+                _apply_label_patch(node, {k: None for k in stale})
+                result.cleaned += 1
+    return result
+
+
+def tpu_nodes(client: Client) -> List[dict]:
+    return [n for n in client.list("v1", "Node") if is_tpu_node(n)]

@@ -1,0 +1,90 @@
+"""Node attribute extraction and filtering (reference: internal/nodeinfo/).
+
+A node is a TPU node when any of these hold (cheapest signal first):
+the GKE accelerator label, our own ``tpu.ai/tpu.present`` marker, or a
+non-zero ``google.com/tpu`` entry in node capacity. The reference's analog
+keys off the NFD PCI vendor label 0x10de (state_manager.go:113-117); GKE TPU
+pools come pre-labeled so no NFD dependency is needed — bare metal can set
+the label by hand or via our feature-discovery operand.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+from .. import consts
+from ..utils import deep_get, parse_quantity
+
+
+def tpu_capacity(node: dict) -> int:
+    raw = deep_get(node, "status", "capacity", consts.TPU_RESOURCE_NAME, default=0)
+    try:
+        return int(parse_quantity(raw))
+    except ValueError:
+        return 0
+
+
+def is_tpu_node(node: dict) -> bool:
+    labels = deep_get(node, "metadata", "labels", default={}) or {}
+    if consts.GKE_TPU_ACCELERATOR_LABEL in labels:
+        return True
+    if labels.get(consts.TPU_PRESENT_LABEL) == "true":
+        return True
+    return tpu_capacity(node) > 0
+
+
+@dataclasses.dataclass
+class NodeAttributes:
+    """Attributes mined from a node's labels (attributes.go:58-71 analog)."""
+
+    name: str = ""
+    hostname: str = ""
+    arch: str = ""
+    os: str = ""
+    accelerator: str = ""   # e.g. tpu-v5-lite-podslice
+    topology: str = ""      # e.g. 2x4
+    chip_count: int = 0
+
+    @classmethod
+    def from_node(cls, node: dict) -> "NodeAttributes":
+        labels = deep_get(node, "metadata", "labels", default={}) or {}
+        return cls(
+            name=deep_get(node, "metadata", "name", default=""),
+            hostname=labels.get("kubernetes.io/hostname", ""),
+            arch=labels.get("kubernetes.io/arch", ""),
+            os=labels.get("kubernetes.io/os", ""),
+            accelerator=labels.get(consts.GKE_TPU_ACCELERATOR_LABEL,
+                                   labels.get(consts.TPU_CHIP_TYPE_LABEL, "")),
+            topology=labels.get(consts.GKE_TPU_TOPOLOGY_LABEL,
+                                labels.get(consts.TPU_TOPOLOGY_LABEL, "")),
+            chip_count=tpu_capacity(node),
+        )
+
+
+class NodeFilter:
+    """Label-based node list filter (filter.go NodeLabelFilterBuilder analog)."""
+
+    def __init__(self):
+        self._required: Dict[str, Optional[str]] = {}
+
+    def with_label(self, key: str, value: Optional[str] = None) -> "NodeFilter":
+        self._required[key] = value
+        return self
+
+    def with_tpu(self) -> "NodeFilter":
+        return self.with_label(consts.TPU_PRESENT_LABEL, "true")
+
+    def apply(self, nodes: List[dict]) -> List[dict]:
+        out = []
+        for node in nodes:
+            labels = deep_get(node, "metadata", "labels", default={}) or {}
+            ok = True
+            for key, want in self._required.items():
+                if want is None:
+                    ok = ok and key in labels
+                else:
+                    ok = ok and labels.get(key) == want
+            if ok:
+                out.append(node)
+        return out

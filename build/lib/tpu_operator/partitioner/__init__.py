@@ -1,0 +1,9 @@
+from .partitioner import (
+    PartitionError,
+    compute_partition,
+    load_config,
+    run,
+    sync_once,
+)
+
+__all__ = ["PartitionError", "compute_partition", "load_config", "run", "sync_once"]

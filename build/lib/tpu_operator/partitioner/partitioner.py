@@ -1,0 +1,160 @@
+"""TPU slice partition manager (reference: mig-manager operand + the
+mig.config label flow, state_manager.go:539-546, applyMIGConfiguration
+object_controls.go:2410-2422).
+
+MIG carves one GPU into hardware slices; the TPU analog carves one node's
+chips into independently schedulable sub-slices (e.g. a v5e 2x4 host split
+into two 2x2 groups). There is no device-side call to make: sub-slicing on
+TPU is a scheduling contract, so "applying" a partition means atomically
+publishing the chip grouping where the device plugin picks it up (a hostPath
+JSON handoff file) and reporting progress through node labels:
+
+    tpu.ai/slice.config        (desired; set by the admin / ClusterPolicy)
+    tpu.ai/slice.config.state  (pending -> success | failed; set by us)
+
+The handoff file format is the contract with the device plugin:
+``{"partition": <name>, "groups": [{"topology": "2x2", "chips": [0,1,2,3]}]}``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Dict, List, Optional
+
+import yaml
+
+from .. import consts
+from ..utils import deep_get
+from ..validator.driver import discover_devices
+
+log = logging.getLogger(__name__)
+
+DEFAULT_HANDOFF_DIR = "/var/lib/tpu-partitions"
+HANDOFF_FILE = "partition.json"
+
+STATE_PENDING = "pending"
+STATE_SUCCESS = "success"
+STATE_FAILED = "failed"
+
+
+class PartitionError(ValueError):
+    pass
+
+
+def load_config(path: str) -> Dict[str, List[dict]]:
+    with open(path) as f:
+        raw = yaml.safe_load(f) or {}
+    partitions = raw.get("partitions")
+    if not isinstance(partitions, dict):
+        raise PartitionError(f"{path}: missing 'partitions' mapping")
+    return partitions
+
+
+def compute_partition(layout: List[dict], total_chips: int) -> List[dict]:
+    """Expand a named layout into explicit chip-id groups."""
+    groups: List[dict] = []
+    next_chip = 0
+    for entry in layout or []:
+        chips = int(entry.get("chips", 1))
+        if chips <= 0:
+            raise PartitionError(f"invalid chips count {chips}")
+        count = entry.get("count", 1)
+        n = (total_chips - next_chip) // chips if count == "all" else int(count)
+        for _ in range(n):
+            if next_chip + chips > total_chips:
+                raise PartitionError(
+                    f"layout needs more than {total_chips} chips")
+            groups.append({
+                "topology": entry.get("topology", f"1x{chips}"),
+                "chips": list(range(next_chip, next_chip + chips)),
+            })
+            next_chip += chips
+    return groups
+
+
+def write_handoff(groups: List[dict], name: str,
+                  handoff_dir: str = DEFAULT_HANDOFF_DIR) -> str:
+    os.makedirs(handoff_dir, exist_ok=True)
+    path = os.path.join(handoff_dir, HANDOFF_FILE)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"partition": name, "groups": groups, "applied_at": time.time()}, f)
+    os.replace(tmp, path)  # the device plugin must never read a torn file
+    return path
+
+
+def read_handoff(handoff_dir: str = DEFAULT_HANDOFF_DIR) -> Optional[dict]:
+    try:
+        with open(os.path.join(handoff_dir, HANDOFF_FILE)) as f:
+            return json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError):
+        return None
+
+
+def sync_once(client, node_name: str, config_path: str,
+              handoff_dir: str = DEFAULT_HANDOFF_DIR,
+              total_chips: Optional[int] = None) -> Optional[str]:
+    """One reconcile pass; returns the state written (None = nothing to do)."""
+    node = client.get("v1", "Node", node_name)
+    labels = deep_get(node, "metadata", "labels", default={}) or {}
+    desired = labels.get(consts.TPU_SLICE_CONFIG_LABEL)
+    state = labels.get(consts.TPU_SLICE_STATE_LABEL)
+    if not desired:
+        if state:  # config removed: clear our state label + handoff
+            client.patch("v1", "Node", node_name,
+                         {"metadata": {"labels": {consts.TPU_SLICE_STATE_LABEL: None}}})
+            try:
+                os.remove(os.path.join(handoff_dir, HANDOFF_FILE))
+            except FileNotFoundError:
+                pass
+            return None
+        return None
+    current = read_handoff(handoff_dir)
+    if current and current.get("partition") == desired and state == STATE_SUCCESS:
+        return STATE_SUCCESS  # already applied
+
+    def set_state(value: str) -> None:
+        client.patch("v1", "Node", node_name,
+                     {"metadata": {"labels": {consts.TPU_SLICE_STATE_LABEL: value}}})
+
+    set_state(STATE_PENDING)
+    try:
+        table = load_config(config_path)
+        if desired not in table:
+            raise PartitionError(f"unknown partition {desired!r}; have {sorted(table)}")
+        if total_chips is None:
+            chips_label = labels.get(consts.TPU_CHIP_COUNT_LABEL)
+            total_chips = int(chips_label) if chips_label else len(discover_devices())
+        if total_chips <= 0:
+            raise PartitionError("no TPU chips discoverable on this node")
+        groups = compute_partition(table[desired], total_chips)
+        write_handoff(groups, desired, handoff_dir)
+        set_state(STATE_SUCCESS)
+        log.info("partition %s applied on %s: %d group(s)", desired, node_name, len(groups))
+        return STATE_SUCCESS
+    except (PartitionError, OSError, ValueError) as e:
+        log.error("partition %s failed on %s: %s", desired, node_name, e)
+        set_state(STATE_FAILED)
+        return STATE_FAILED
+
+
+def run(client, config_path: str, node_name: Optional[str] = None,
+        handoff_dir: str = DEFAULT_HANDOFF_DIR, sleep_interval: float = 15.0,
+        iterations: Optional[int] = None) -> int:
+    node_name = node_name or os.environ.get("NODE_NAME", "")
+    if not node_name:
+        log.error("slice partitioner: NODE_NAME unset")
+        return 1
+    count = 0
+    while True:
+        try:
+            sync_once(client, node_name, config_path, handoff_dir)
+        except Exception:
+            log.exception("slice partitioner pass failed")
+        count += 1
+        if iterations is not None and count >= iterations:
+            return 0
+        time.sleep(sleep_interval)

@@ -1,0 +1,3 @@
+from .render import RenderError, Renderer
+
+__all__ = ["Renderer", "RenderError"]

@@ -1,0 +1,111 @@
+"""state-driver: place libtpu on every TPU node (reference internal/state/driver.go).
+
+TPU redesign: the reference builds/loads a kernel module per kernel-version
+pool with a ~20-minute probe budget; libtpu is a userspace .so, so this state
+reduces to an installer DaemonSet whose probe is "libtpu present + device
+nodes visible". Per-pool fan-out (one DS per accelerator-type/topology pool,
+reference getNodePools nodepool.go:55-132) is driven by the TPUDriver
+controller via :meth:`StateDriver.sync_pools`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, List, Optional
+
+from .. import consts
+from ..api.clusterpolicy import ClusterPolicy
+from ..client.interface import Client
+from ..render import Renderer
+from .manager import (
+    INFO_CLUSTER_POLICY,
+    INFO_NAMESPACE,
+    INFO_NODES,
+    InfoCatalog,
+    StateResult,
+)
+from .skel import StateSkel, SyncState
+
+MANIFEST_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "manifests")
+
+DEFAULT_APP_NAME = "libtpu-driver"
+
+
+@dataclasses.dataclass
+class DriverRenderOverrides:
+    """Per-pool knobs the TPUDriver controller injects (driver.go:94-104)."""
+
+    app_name: str = DEFAULT_APP_NAME
+    node_selector: Optional[Dict[str, str]] = None
+    node_affinity: Optional[dict] = None
+    libtpu_version: Optional[str] = None
+    image: Optional[str] = None
+    extra_labels: Optional[Dict[str, str]] = None
+
+
+class StateDriver:
+    name = "state-driver"
+
+    def __init__(self, client: Client, manifest_dir: Optional[str] = None):
+        self.client = client
+        self.renderer = Renderer(manifest_dir or os.path.join(MANIFEST_DIR, "state-driver"))
+        self.skel = StateSkel(self.name, client)
+
+    # -- render data ----------------------------------------------------------
+    def render_data(self, policy: ClusterPolicy, namespace: str,
+                    overrides: Optional[DriverRenderOverrides] = None,
+                    driver_spec=None) -> dict:
+        """``driver_spec`` lets the TPUDriver controller substitute a per-
+        instance spec (TPUDriverSpec shares the field shape with DriverSpec)."""
+        o = overrides or DriverRenderOverrides()
+        driver = driver_spec if driver_spec is not None else policy.spec.driver
+        return {
+            "app_name": o.app_name,
+            "namespace": namespace,
+            "deploy_label": consts.deploy_label("driver"),
+            "tpu_resource": consts.TPU_RESOURCE_NAME,
+            "validation_status_dir": consts.VALIDATION_STATUS_DIR,
+            "node_selector": o.node_selector or {},
+            "node_affinity": o.node_affinity,
+            "extra_labels": o.extra_labels or {},
+            "daemonsets": {
+                "update_strategy": policy.spec.daemonsets.update_strategy,
+                "rolling_update": policy.spec.daemonsets.rolling_update,
+                "priority_class_name": policy.spec.daemonsets.priority_class_name,
+                "tolerations": policy.spec.daemonsets.tolerations,
+                "annotations": policy.spec.daemonsets.annotations,
+            },
+            "driver": {
+                "image": o.image or driver.image_path(),
+                "image_pull_policy": driver.image_pull_policy,
+                "image_pull_secrets": driver.image_pull_secrets,
+                "install_dir": driver.install_dir,
+                "libtpu_version": o.libtpu_version or driver.libtpu_version,
+                "env": [{"name": e.name, "value": e.value} for e in driver.env],
+                "resources": driver.resources,
+            },
+        }
+
+    def render_objects(self, policy: ClusterPolicy, namespace: str,
+                       overrides: Optional[DriverRenderOverrides] = None,
+                       driver_spec=None) -> List[dict]:
+        return self.renderer.render_objects(
+            self.render_data(policy, namespace, overrides, driver_spec))
+
+    # -- ClusterPolicy-path sync (one DS for all TPU nodes) -------------------
+    def sync(self, catalog: InfoCatalog) -> StateResult:
+        policy: ClusterPolicy = catalog.require(INFO_CLUSTER_POLICY)
+        namespace: str = catalog.require(INFO_NAMESPACE)
+        if self.client.list("tpu.ai/v1alpha1", "TPUDriver"):
+            # TPUDriver instances own driver DSes now; hand over and clean up
+            # the ClusterPolicy-owned one (reference state_manager.go:951-961)
+            self.skel.delete_objs(self.skel.list_owned("apps/v1", "DaemonSet", namespace))
+            return StateResult(self.name, SyncState.IGNORE, "TPUDriver CRs own the driver")
+        if not policy.spec.driver.is_enabled():
+            self.skel.delete_objs(self.skel.list_owned("apps/v1", "DaemonSet", namespace))
+            return StateResult(self.name, SyncState.IGNORE, "driver disabled")
+        objs = self.render_objects(policy, namespace)
+        applied = self.skel.create_or_update_objs(objs, owner=policy.obj)
+        status = self.skel.get_sync_state(applied, nodes=catalog.get(INFO_NODES))
+        return StateResult(self.name, status)

@@ -1,0 +1,69 @@
+"""Node-pool partitioning for per-pool driver fan-out.
+
+The reference partitions GPU nodes by OS / kernel / RHCOS version because it
+compiles kernel modules per pool (internal/state/nodepool.go:55-132). TPU
+nodes need no kernel build; what actually varies across a fleet is the
+accelerator generation and slice topology, so pools are keyed on
+(accelerator type, topology) — each pool gets its own libtpu DaemonSet,
+letting different generations pin different libtpu builds.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, List
+
+from .. import consts
+from ..utils import deep_get
+
+_SANITIZE = re.compile(r"[^a-z0-9-]+")
+
+
+def sanitize_name(raw: str) -> str:
+    return _SANITIZE.sub("-", raw.lower()).strip("-") or "default"
+
+
+@dataclasses.dataclass
+class NodePool:
+    name: str                      # DNS-safe pool suffix, e.g. v5-lite-podslice-2x4
+    accelerator: str
+    topology: str
+    node_selector: Dict[str, str]  # selects exactly this pool's nodes
+    node_names: List[str]
+
+    @property
+    def size(self) -> int:
+        return len(self.node_names)
+
+
+def get_node_pools(nodes: List[dict]) -> List[NodePool]:
+    """Group TPU nodes by (accelerator, topology); stable name per pool."""
+    pools: Dict[tuple, NodePool] = {}
+    for node in nodes:
+        labels = deep_get(node, "metadata", "labels", default={}) or {}
+        accelerator = labels.get(consts.GKE_TPU_ACCELERATOR_LABEL,
+                                 labels.get(consts.TPU_CHIP_TYPE_LABEL, "unknown"))
+        topology = labels.get(consts.GKE_TPU_TOPOLOGY_LABEL,
+                              labels.get(consts.TPU_TOPOLOGY_LABEL, ""))
+        key = (accelerator, topology)
+        if key not in pools:
+            selector: Dict[str, str] = {}
+            if consts.GKE_TPU_ACCELERATOR_LABEL in labels:
+                selector[consts.GKE_TPU_ACCELERATOR_LABEL] = accelerator
+            elif consts.TPU_CHIP_TYPE_LABEL in labels:
+                selector[consts.TPU_CHIP_TYPE_LABEL] = accelerator
+            if consts.GKE_TPU_TOPOLOGY_LABEL in labels:
+                selector[consts.GKE_TPU_TOPOLOGY_LABEL] = topology
+            elif consts.TPU_TOPOLOGY_LABEL in labels and topology:
+                selector[consts.TPU_TOPOLOGY_LABEL] = topology
+            name = sanitize_name("-".join(
+                p for p in (accelerator.removeprefix("tpu-"), topology) if p))
+            pools[key] = NodePool(name=name, accelerator=accelerator,
+                                  topology=topology, node_selector=selector,
+                                  node_names=[])
+        pools[key].node_names.append(deep_get(node, "metadata", "name", default=""))
+    out = sorted(pools.values(), key=lambda p: p.name)
+    for pool in out:
+        pool.node_names.sort()
+    return out

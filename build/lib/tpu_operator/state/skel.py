@@ -1,0 +1,198 @@
+"""Common state implementation: apply rendered objects, walk readiness.
+
+Analog of the reference's stateSkel (internal/state/state_skel.go): every
+state renders manifests to unstructured objects, then create-or-updates them
+with owner references, a state label, and DaemonSet hash-skip; sync state is
+derived by walking the readiness of what was applied
+(state_skel.go:223-285,383-444).
+"""
+
+from __future__ import annotations
+
+import copy
+import enum
+import logging
+from typing import Dict, List, Optional
+
+from .. import consts
+from ..client.errors import ConflictError, NotFoundError
+from ..client.interface import Client
+from ..utils import deep_get, object_hash
+
+log = logging.getLogger(__name__)
+
+
+class SyncState(str, enum.Enum):
+    READY = "ready"
+    NOT_READY = "notReady"
+    IGNORE = "ignore"
+    ERROR = "error"
+
+
+def owner_reference(owner: dict, controller: bool = True) -> dict:
+    return {
+        "apiVersion": owner["apiVersion"],
+        "kind": owner["kind"],
+        "name": owner["metadata"]["name"],
+        "uid": owner["metadata"].get("uid", ""),
+        "controller": controller,
+        "blockOwnerDeletion": True,
+    }
+
+
+# -- readiness predicates (state_skel.go:414-444, object_controls.go:3525) ----
+
+def is_daemonset_ready(ds: dict, expected_nodes: Optional[int] = None) -> bool:
+    """DS readiness (reference state_skel.go:414-444) hardened against the
+    fresh-DS race: a just-created DaemonSet reports desired=0 before the DS
+    controller sweeps, which must not read as "ready" when nodes should match.
+
+    Freshness signal: ``status.observedGeneration`` — the DS controller has
+    seen this spec. Only when that is absent (controller hasn't written status
+    at all yet) fall back to comparing desired against a nodeSelector label
+    count; the DS controller's own desired is authoritative otherwise (it also
+    accounts for taints/affinity, which a label count cannot)."""
+    status = ds.get("status", {})
+    desired = status.get("desiredNumberScheduled", 0)
+    observed = status.get("observedGeneration")
+    generation = deep_get(ds, "metadata", "generation", default=1)
+    if observed is not None:
+        if observed < generation:
+            return False  # stale status for an updated spec
+    elif expected_nodes is not None and desired != expected_nodes:
+        return False  # fresh DS: no status yet but nodes should match
+    if desired == 0:
+        return True  # genuinely no eligible nodes
+    return (
+        status.get("numberAvailable", 0) == desired
+        and status.get("updatedNumberScheduled", 0) == desired
+    )
+
+
+def node_matches_selector(node: dict, selector: dict) -> bool:
+    labels = deep_get(node, "metadata", "labels", default={}) or {}
+    return all(labels.get(k) == v for k, v in (selector or {}).items())
+
+
+def is_deployment_ready(dep: dict) -> bool:
+    want = deep_get(dep, "spec", "replicas", default=1)
+    return dep.get("status", {}).get("readyReplicas", 0) >= want
+
+
+def is_pod_ready(pod: dict) -> bool:
+    phase = deep_get(pod, "status", "phase")
+    if phase == "Succeeded":
+        return True
+    if phase != "Running":
+        return False
+    for cond in deep_get(pod, "status", "conditions", default=[]) or []:
+        if cond.get("type") == "Ready":
+            return cond.get("status") == "True"
+    return False
+
+
+_READINESS = {
+    "DaemonSet": is_daemonset_ready,
+    "Deployment": is_deployment_ready,
+    "Pod": is_pod_ready,
+}
+
+#: fields the API server (or other controllers) own; preserved on update
+#: (mergeObjects analog, state_skel.go:344)
+_PRESERVE_ON_UPDATE = {
+    "Service": [("spec", "clusterIP"), ("spec", "clusterIPs")],
+    "ServiceAccount": [("secrets",), ("imagePullSecrets",)],
+}
+
+
+class StateSkel:
+    """Create-or-update a batch of unstructured objects and report readiness."""
+
+    def __init__(self, name: str, client: Client):
+        self.name = name
+        self.client = client
+
+    # -- apply ----------------------------------------------------------------
+    def create_or_update_objs(self, objs: List[dict], owner: Optional[dict] = None) -> List[dict]:
+        applied = []
+        for obj in objs:
+            applied.append(self._apply_one(copy.deepcopy(obj), owner))
+        return applied
+
+    def _apply_one(self, desired: dict, owner: Optional[dict]) -> dict:
+        meta = desired.setdefault("metadata", {})
+        meta.setdefault("labels", {})[consts.STATE_LABEL] = self.name
+        if owner is not None:
+            meta["ownerReferences"] = [owner_reference(owner)]
+        if desired.get("kind") == "DaemonSet":
+            meta.setdefault("annotations", {})[consts.SPEC_HASH_ANNOTATION] = object_hash(desired.get("spec", {}))
+
+        api_version, kind = desired["apiVersion"], desired["kind"]
+        name, namespace = meta["name"], meta.get("namespace")
+        try:
+            current = self.client.get(api_version, kind, name, namespace)
+        except NotFoundError:
+            log.info("state %s: creating %s/%s", self.name, kind, name)
+            return self.client.create(desired)
+
+        if kind == "DaemonSet":
+            current_hash = deep_get(current, "metadata", "annotations", consts.SPEC_HASH_ANNOTATION)
+            if current_hash == meta["annotations"][consts.SPEC_HASH_ANNOTATION]:
+                return current  # unchanged: skip write (object_controls.go:4316)
+
+        for path in _PRESERVE_ON_UPDATE.get(kind, []):
+            value = deep_get(current, *path)
+            if value is not None:
+                node = desired
+                for step in path[:-1]:
+                    node = node.setdefault(step, {})
+                node.setdefault(path[-1], value)
+
+        desired["metadata"]["resourceVersion"] = current["metadata"].get("resourceVersion")
+        if "status" in current:
+            desired.setdefault("status", current["status"])
+        log.info("state %s: updating %s/%s", self.name, kind, name)
+        try:
+            return self.client.update(desired)
+        except ConflictError:
+            # lost a write race; the next reconcile sweep re-applies
+            return current
+
+    # -- readiness ------------------------------------------------------------
+    def get_sync_state(self, objs: List[dict], nodes: Optional[List[dict]] = None) -> SyncState:
+        """Walk readiness of applied objects. ``nodes`` lets the caller share
+        one per-sweep Node snapshot instead of one LIST per DS-bearing state."""
+        for obj in objs:
+            check = _READINESS.get(obj.get("kind"))
+            if check is None:
+                continue
+            meta = obj.get("metadata", {})
+            try:
+                live = self.client.get(obj["apiVersion"], obj["kind"], meta["name"], meta.get("namespace"))
+            except NotFoundError:
+                return SyncState.NOT_READY
+            if obj["kind"] == "DaemonSet":
+                if nodes is None:
+                    nodes = self.client.list("v1", "Node")
+                selector = deep_get(live, "spec", "template", "spec", "nodeSelector", default={})
+                expected = sum(1 for n in nodes if node_matches_selector(n, selector))
+                ok = is_daemonset_ready(live, expected_nodes=expected)
+            else:
+                ok = check(live)
+            if not ok:
+                log.info("state %s: %s/%s not ready", self.name, obj.get("kind"), meta.get("name"))
+                return SyncState.NOT_READY
+        return SyncState.READY
+
+    # -- deletion (state disabled) -------------------------------------------
+    def delete_objs(self, objs: List[dict]) -> None:
+        for obj in objs:
+            meta = obj.get("metadata", {})
+            try:
+                self.client.delete(obj["apiVersion"], obj["kind"], meta["name"], meta.get("namespace"))
+            except NotFoundError:
+                pass
+
+    def list_owned(self, api_version: str, kind: str, namespace: Optional[str] = None) -> List[dict]:
+        return self.client.list(api_version, kind, namespace,
+                                label_selector={consts.STATE_LABEL: self.name})

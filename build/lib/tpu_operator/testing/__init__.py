@@ -1,0 +1,3 @@
+from .apiserver import MiniApiServer
+
+__all__ = ["MiniApiServer"]

@@ -1,0 +1,101 @@
+"""Kubelet/scheduler simulator for cluster-free e2e tests.
+
+Plays the role a real cluster's kubelets play against the operator
+(the analog of the reference's holodeck single-GPU instance, SURVEY.md 4.3):
+
+- DaemonSet controller: counts nodes matching each DS's nodeSelector and
+  reports desired/available/updated in DS status (instant healthy rollout,
+  optionally delayed).
+- Device-plugin registration: when the device-plugin DS covers a TPU node,
+  the node's ``google.com/tpu`` capacity appears — the moment the node
+  becomes schedulable, which is the north-star timestamp.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+from .. import consts
+from ..client.errors import ApiError
+from ..client.interface import Client
+from ..state.skel import node_matches_selector
+from ..utils import deep_get
+
+log = logging.getLogger(__name__)
+
+
+class KubeletSimulator:
+    def __init__(self, client: Client, namespace: str = consts.DEFAULT_NAMESPACE,
+                 chips_per_node: int = 4, interval: float = 0.05,
+                 rollout_ticks: int = 0):
+        self.client = client
+        self.namespace = namespace
+        self.chips_per_node = chips_per_node
+        self.interval = interval
+        self.rollout_ticks = rollout_ticks  # ticks a DS stays unavailable first
+        self._seen: dict = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "KubeletSimulator":
+        self._thread = threading.Thread(target=self._run, daemon=True, name="kubelet-sim")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.tick()
+            except ApiError as e:
+                log.debug("kubelet sim tick error: %s", e)
+
+    # one scheduling pass; public so tests can drive it deterministically
+    def tick(self) -> None:
+        nodes = self.client.list("v1", "Node")
+        for ds in self.client.list("apps/v1", "DaemonSet", self.namespace):
+            selector = deep_get(ds, "spec", "template", "spec", "nodeSelector", default={})
+            matching = [n for n in nodes if node_matches_selector(n, selector)]
+            desired = len(matching)
+            key = (ds["metadata"]["name"], ds["metadata"].get("generation"))
+            ticks = self._seen.get(key, 0)
+            self._seen[key] = ticks + 1
+            available = desired if ticks >= self.rollout_ticks else 0
+            status = {
+                "observedGeneration": ds["metadata"].get("generation", 1),
+                "desiredNumberScheduled": desired,
+                "currentNumberScheduled": available,
+                "numberReady": available,
+                "numberAvailable": available,
+                "updatedNumberScheduled": desired if ticks >= self.rollout_ticks else available,
+            }
+            if ds.get("status") != status:
+                ds["status"] = status
+                self.client.update_status(ds)
+            if available and self._is_device_plugin(ds):
+                for node in matching:
+                    self._register_tpus(node)
+
+    @staticmethod
+    def _is_device_plugin(ds: dict) -> bool:
+        component = deep_get(ds, "spec", "template", "metadata", "labels",
+                             "app.kubernetes.io/component", default="")
+        return component == "tpu-device-plugin"
+
+    def _register_tpus(self, node: dict) -> None:
+        name = node["metadata"]["name"]
+        live = self.client.get("v1", "Node", name)
+        capacity = live.setdefault("status", {}).setdefault("capacity", {})
+        want = str(self.chips_per_node)
+        if capacity.get(consts.TPU_RESOURCE_NAME) != want:
+            capacity[consts.TPU_RESOURCE_NAME] = want
+            live["status"].setdefault("allocatable", {})[consts.TPU_RESOURCE_NAME] = want
+            self.client.update_status(live)
+            log.info("kubelet sim: node %s now advertises %s=%s",
+                     name, consts.TPU_RESOURCE_NAME, want)

@@ -1,0 +1,8 @@
+from .machine import (
+    STATES,
+    UpgradeStateCounts,
+    UpgradeStateMachine,
+    node_upgrade_state,
+)
+
+__all__ = ["STATES", "UpgradeStateCounts", "UpgradeStateMachine", "node_upgrade_state"]

@@ -1,0 +1,274 @@
+"""Per-node rolling driver-upgrade state machine.
+
+TPU rebuild of the reference's vendored upgrade library
+(vendor/github.com/NVIDIA/k8s-operator-libs/pkg/upgrade, states at
+consts.go:43-67): each node's upgrade progress is persisted as a node label,
+so the machine is fully resumable from cluster state — the operator can crash
+at any point and the next sweep continues where it left off.
+
+State flow per node:
+
+    (outdated driver pod detected)
+    upgrade-required -> cordon-required -> wait-for-jobs-required
+    -> pod-deletion-required -> drain-required -> pod-restart-required
+    -> validation-required -> uncordon-required -> upgrade-done
+    (validation failure -> upgrade-failed)
+
+TPU simplifications vs the reference: no safe-driver-load dance (libtpu is
+not a kernel module), and "driver pod outdated" means the pod's installer
+image/args differ from the DaemonSet's current template (no DTK/precompiled
+variants).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Dict, List, Optional
+
+from .. import consts
+from ..api.common import UpgradePolicySpec
+from ..client.errors import ApiError, NotFoundError
+from ..client.interface import Client
+from ..utils import deep_get
+
+log = logging.getLogger(__name__)
+
+# states (reference upgrade/consts.go:43-67)
+UNKNOWN = ""
+UPGRADE_REQUIRED = "upgrade-required"
+CORDON_REQUIRED = "cordon-required"
+WAIT_FOR_JOBS_REQUIRED = "wait-for-jobs-required"
+POD_DELETION_REQUIRED = "pod-deletion-required"
+DRAIN_REQUIRED = "drain-required"
+POD_RESTART_REQUIRED = "pod-restart-required"
+VALIDATION_REQUIRED = "validation-required"
+UNCORDON_REQUIRED = "uncordon-required"
+DONE = "upgrade-done"
+FAILED = "upgrade-failed"
+
+STATES = (UPGRADE_REQUIRED, CORDON_REQUIRED, WAIT_FOR_JOBS_REQUIRED,
+          POD_DELETION_REQUIRED, DRAIN_REQUIRED, POD_RESTART_REQUIRED,
+          VALIDATION_REQUIRED, UNCORDON_REQUIRED, DONE, FAILED)
+
+IN_PROGRESS_STATES = (CORDON_REQUIRED, WAIT_FOR_JOBS_REQUIRED,
+                      POD_DELETION_REQUIRED, DRAIN_REQUIRED,
+                      POD_RESTART_REQUIRED, VALIDATION_REQUIRED,
+                      UNCORDON_REQUIRED)
+
+#: label selector for driver pods (set in our DS pod templates)
+DRIVER_COMPONENT = "tpu-driver"
+VALIDATOR_COMPONENT = "tpu-operator-validator"
+
+
+def node_upgrade_state(node: dict) -> str:
+    return deep_get(node, "metadata", "labels", consts.UPGRADE_STATE_LABEL, default=UNKNOWN)
+
+
+@dataclasses.dataclass
+class UpgradeStateCounts:
+    pending: int = 0
+    in_progress: int = 0
+    done: int = 0
+    failed: int = 0
+    available: int = 0
+
+    def as_dict(self) -> Dict[str, int]:
+        return dataclasses.asdict(self)
+
+
+class UpgradeStateMachine:
+    def __init__(self, client: Client, namespace: str,
+                 policy: Optional[UpgradePolicySpec] = None):
+        self.client = client
+        self.namespace = namespace
+        self.policy = policy or UpgradePolicySpec()
+
+    # -- cluster inspection ---------------------------------------------------
+    def _pods_on(self, node_name: str, component: Optional[str] = None) -> List[dict]:
+        label_selector = {"app.kubernetes.io/component": component} if component else None
+        return self.client.list("v1", "Pod", self.namespace,
+                                label_selector=label_selector,
+                                field_selector={"spec.nodeName": node_name})
+
+    def _driver_ds_for(self, node: dict) -> Optional[dict]:
+        from ..state.skel import node_matches_selector
+
+        for ds in self.client.list("apps/v1", "DaemonSet", self.namespace):
+            component = deep_get(ds, "spec", "template", "metadata", "labels",
+                                 "app.kubernetes.io/component")
+            if component != DRIVER_COMPONENT:
+                continue
+            selector = deep_get(ds, "spec", "template", "spec", "nodeSelector", default={})
+            if node_matches_selector(node, selector):
+                return ds
+        return None
+
+    @staticmethod
+    def _pod_outdated(pod: dict, ds: dict) -> bool:
+        """Outdated = installer container differs from the DS's template."""
+        want = deep_get(ds, "spec", "template", "spec", "containers", default=[])
+        have = deep_get(pod, "spec", "containers", default=[])
+        if not want or not have:
+            return False
+        return (want[0].get("image") != have[0].get("image")
+                or want[0].get("args") != have[0].get("args"))
+
+    # -- node operations ------------------------------------------------------
+    def _set_state(self, node: dict, state: str) -> None:
+        name = node["metadata"]["name"]
+        log.info("upgrade: node %s -> %s", name, state or "<clear>")
+        self.client.patch("v1", "Node", name,
+                          {"metadata": {"labels": {consts.UPGRADE_STATE_LABEL: state or None}}})
+        node.setdefault("metadata", {}).setdefault("labels", {})[consts.UPGRADE_STATE_LABEL] = state
+
+    def _cordon(self, node: dict, unschedulable: bool) -> None:
+        self.client.patch("v1", "Node", node["metadata"]["name"],
+                          {"spec": {"unschedulable": unschedulable or None}})
+
+    def _tpu_consumer_pods(self, node_name: str) -> List[dict]:
+        out = []
+        for pod in self._pods_on(node_name):
+            if deep_get(pod, "metadata", "labels", "app.kubernetes.io/component"):
+                continue  # our own operands
+            for ctr in deep_get(pod, "spec", "containers", default=[]):
+                limits = deep_get(ctr, "resources", "limits", default={}) or {}
+                if consts.TPU_RESOURCE_NAME in limits:
+                    out.append(pod)
+                    break
+        return out
+
+    def _delete_pod(self, pod: dict) -> None:
+        try:
+            self.client.delete("v1", "Pod", pod["metadata"]["name"],
+                               pod["metadata"].get("namespace"))
+        except NotFoundError:
+            pass
+
+    # -- the sweep ------------------------------------------------------------
+    def process(self, nodes: List[dict]) -> UpgradeStateCounts:
+        counts = UpgradeStateCounts()
+        in_progress = sum(1 for n in nodes if node_upgrade_state(n) in IN_PROGRESS_STATES)
+        max_parallel = self.policy.max_parallel_upgrades or len(nodes)
+
+        for node in nodes:
+            before = node_upgrade_state(node)
+            try:
+                state = self._process_node(node, in_progress, max_parallel)
+            except ApiError as e:
+                log.warning("upgrade: node %s sweep error: %s", node["metadata"]["name"], e)
+                state = before
+            if state == UPGRADE_REQUIRED:
+                counts.pending += 1
+            elif state in IN_PROGRESS_STATES:
+                counts.in_progress += 1
+            elif state == DONE:
+                counts.done += 1
+            elif state == FAILED:
+                counts.failed += 1
+            else:
+                counts.available += 1
+            if state in IN_PROGRESS_STATES and before not in IN_PROGRESS_STATES:
+                in_progress += 1
+        return counts
+
+    def _process_node(self, node: dict, in_progress: int, max_parallel: int) -> str:
+        name = node["metadata"]["name"]
+        state = node_upgrade_state(node)
+        ds = self._driver_ds_for(node)
+        driver_pods = self._pods_on(name, DRIVER_COMPONENT)
+
+        if state in (UNKNOWN, DONE):
+            if ds and any(self._pod_outdated(p, ds) for p in driver_pods):
+                self._set_state(node, UPGRADE_REQUIRED)
+                return UPGRADE_REQUIRED
+            if state == DONE:
+                # fully settled: clear the label so the node reads available
+                self._set_state(node, UNKNOWN)
+            return UNKNOWN
+
+        if state == UPGRADE_REQUIRED:
+            if in_progress >= max_parallel:
+                return state  # throttled (reference maxParallelUpgrades)
+            self._cordon(node, True)
+            self._set_state(node, CORDON_REQUIRED)
+            state = CORDON_REQUIRED  # fall through the chain in one sweep
+
+        if state == CORDON_REQUIRED:
+            # cordon is idempotent; re-assert and move on
+            self._cordon(node, True)
+            self._set_state(node, WAIT_FOR_JOBS_REQUIRED)
+            state = WAIT_FOR_JOBS_REQUIRED
+
+        if state == WAIT_FOR_JOBS_REQUIRED:
+            if self.policy.wait_for_completion.pod_selector:
+                key, _, value = self.policy.wait_for_completion.pod_selector.partition("=")
+                waiting = [p for p in self._pods_on(name)
+                           if deep_get(p, "metadata", "labels", key) == (value or None)
+                           and deep_get(p, "status", "phase") in ("Running", "Pending")]
+                if waiting:
+                    return state
+            self._set_state(node, POD_DELETION_REQUIRED)
+            state = POD_DELETION_REQUIRED
+
+        if state == POD_DELETION_REQUIRED:
+            for pod in self._tpu_consumer_pods(name):
+                self._delete_pod(pod)
+            self._set_state(node, DRAIN_REQUIRED)
+            state = DRAIN_REQUIRED
+
+        if state == DRAIN_REQUIRED:
+            skip = deep_get(node, "metadata", "labels",
+                            consts.UPGRADE_SKIP_DRAIN_LABEL) == "true"
+            if self.policy.drain.enable and not skip:
+                for pod in self._pods_on(name):
+                    if deep_get(pod, "metadata", "labels", "app.kubernetes.io/component"):
+                        continue  # operand DS pods stay (like kubectl drain ignores DS)
+                    self._delete_pod(pod)
+            self._set_state(node, POD_RESTART_REQUIRED)
+            state = POD_RESTART_REQUIRED
+
+        if state == POD_RESTART_REQUIRED:
+            outdated = [p for p in self._pods_on(name, DRIVER_COMPONENT)
+                        if ds and self._pod_outdated(p, ds)]
+            for pod in outdated:
+                self._delete_pod(pod)
+            if outdated:
+                return state  # wait for the DS controller to restart them
+            fresh = self._pods_on(name, DRIVER_COMPONENT)
+            if not fresh:
+                return state  # restart pending
+            if any(deep_get(p, "status", "phase") == "Failed" for p in fresh):
+                self._set_state(node, FAILED)
+                return FAILED
+            from ..state.skel import is_pod_ready
+
+            if not all(is_pod_ready(p) for p in fresh):
+                return state
+            self._set_state(node, VALIDATION_REQUIRED)
+            state = VALIDATION_REQUIRED
+
+        if state == VALIDATION_REQUIRED:
+            from ..state.skel import is_pod_ready
+
+            validators = self._pods_on(name, VALIDATOR_COMPONENT)
+            if not validators or not all(is_pod_ready(p) for p in validators):
+                return state  # validator not green yet (reference validation_manager)
+            self._set_state(node, UNCORDON_REQUIRED)
+            state = UNCORDON_REQUIRED
+
+        if state == UNCORDON_REQUIRED:
+            self._cordon(node, False)
+            self._set_state(node, DONE)
+            return DONE
+
+        return state
+
+    def clear_all(self, nodes: List[dict]) -> None:
+        """Remove upgrade labels (autoUpgrade disabled; reference
+        removeNodeUpgradeStateLabels, upgrade_controller.go:202)."""
+        for node in nodes:
+            if node_upgrade_state(node) != UNKNOWN:
+                self._cordon(node, False)
+                self._set_state(node, UNKNOWN)

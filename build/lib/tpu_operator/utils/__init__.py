@@ -1,0 +1,22 @@
+from .hash import fnv32a, object_hash
+from .objects import (
+    deep_get,
+    deep_merge,
+    ensure_list,
+    json_merge_patch,
+    obj_key,
+    parse_quantity,
+    same_object,
+)
+
+__all__ = [
+    "fnv32a",
+    "object_hash",
+    "deep_get",
+    "deep_merge",
+    "ensure_list",
+    "json_merge_patch",
+    "obj_key",
+    "parse_quantity",
+    "same_object",
+]

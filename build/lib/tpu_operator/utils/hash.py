@@ -1,0 +1,31 @@
+"""Deterministic object hashing for spec-drift detection.
+
+The reference detects DaemonSet spec drift by hashing a go-spew dump with
+FNV-32a and storing it in an annotation (reference:
+internal/utils/utils.go:64-76, controllers/object_controls.go:4302-4347).
+We keep FNV-32a but hash a canonical JSON encoding instead of a spew dump --
+key-sorted JSON is order-insensitive for mappings, which removes the
+reference's subtlest failure mode (map-iteration-order-sensitive hashes).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+_FNV_OFFSET_32 = 0x811C9DC5
+_FNV_PRIME_32 = 0x01000193
+
+
+def fnv32a(data: bytes) -> int:
+    h = _FNV_OFFSET_32
+    for b in data:
+        h ^= b
+        h = (h * _FNV_PRIME_32) & 0xFFFFFFFF
+    return h
+
+
+def object_hash(obj: Any) -> str:
+    """Canonical FNV-32a hash of any JSON-serialisable object."""
+    payload = json.dumps(obj, sort_keys=True, separators=(",", ":"), default=str)
+    return format(fnv32a(payload.encode("utf-8")), "x")

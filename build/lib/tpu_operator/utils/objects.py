@@ -1,0 +1,94 @@
+"""Helpers for working with unstructured (plain-dict) Kubernetes objects."""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Iterable, Mapping, Optional, Tuple
+
+
+def deep_get(obj: Optional[Mapping], *path: str, default: Any = None) -> Any:
+    """Walk nested mappings; return ``default`` on any missing step."""
+    cur: Any = obj
+    for step in path:
+        if not isinstance(cur, Mapping) or step not in cur:
+            return default
+        cur = cur[step]
+    return cur
+
+
+def deep_merge(base: dict, overlay: Mapping) -> dict:
+    """Recursively merge ``overlay`` into ``base`` (strategic-merge-lite).
+
+    Mappings merge per-key; any other value (lists included) replaces. This is
+    the same semantic the reference uses when it re-applies rendered manifests
+    over live objects while preserving fields it does not manage.
+    """
+    for key, value in overlay.items():
+        if isinstance(value, Mapping) and isinstance(base.get(key), dict):
+            deep_merge(base[key], value)
+        else:
+            base[key] = value if not isinstance(value, Mapping) else dict(value)
+    return base
+
+
+def json_merge_patch(target: dict, patch: Mapping) -> dict:
+    """RFC 7386 JSON merge patch: null deletes, mappings recurse, rest replaces."""
+    for key, value in patch.items():
+        if value is None:
+            target.pop(key, None)
+        elif isinstance(value, Mapping):
+            node = target.get(key)
+            if not isinstance(node, dict):
+                node = target[key] = {}
+            json_merge_patch(node, value)
+        else:
+            target[key] = value
+    return target
+
+
+def ensure_list(value: Any) -> list:
+    if value is None:
+        return []
+    if isinstance(value, list):
+        return value
+    return [value]
+
+
+def obj_key(obj: Mapping) -> Tuple[str, str, str, str]:
+    """(apiVersion, kind, namespace, name) identity of an object."""
+    meta = obj.get("metadata", {})
+    return (
+        obj.get("apiVersion", ""),
+        obj.get("kind", ""),
+        meta.get("namespace", ""),
+        meta.get("name", ""),
+    )
+
+
+def same_object(a: Mapping, b: Mapping) -> bool:
+    return obj_key(a) == obj_key(b)
+
+
+_QUANTITY_RE = re.compile(r"^([0-9.]+)([a-zA-Z]*)$")
+_SUFFIXES = {
+    "": 1,
+    "k": 10**3, "M": 10**6, "G": 10**9, "T": 10**12, "P": 10**15,
+    "Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40, "Pi": 2**50,
+    "m": 1e-3,
+}
+
+
+def parse_quantity(value: Any) -> float:
+    """Parse a k8s resource quantity ("4", "500m", "1Gi") to a float."""
+    if isinstance(value, (int, float)):
+        return float(value)
+    m = _QUANTITY_RE.match(str(value))
+    if not m or m.group(2) not in _SUFFIXES:
+        raise ValueError(f"unparseable quantity: {value!r}")
+    return float(m.group(1)) * _SUFFIXES[m.group(2)]
+
+
+def iter_containers(pod_spec: Mapping) -> Iterable[dict]:
+    for field in ("initContainers", "containers"):
+        for c in pod_spec.get(field, []) or []:
+            yield c

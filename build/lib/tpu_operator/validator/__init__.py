@@ -1,0 +1,14 @@
+"""On-node validator: status-file barriers + TPU validation components.
+
+The TPU rebuild of the reference's ``nvidia-validator`` binary
+(validator/main.go): one CLI, ``-c <component>`` dispatch, each component
+writing a ``<component>-ready`` status file under ``/run/tpu/validations`` —
+the node-local synchronization barriers that gate operand start order
+(SURVEY.md 3.5). The accelerator workload is a JAX/XLA allreduce + ICI ring
+sweep over every local chip instead of CUDA ``vectorAdd``.
+"""
+
+from .status import StatusFiles
+from .workload import IciCheckReport, ici_health_check
+
+__all__ = ["StatusFiles", "IciCheckReport", "ici_health_check"]

@@ -1,0 +1,96 @@
+"""TPU feature discovery (reference: the GFD operand, assets/gpu-feature-discovery/).
+
+Mines chip type / count / topology from the node itself and writes
+``tpu.ai/tpu.*`` labels. Sources, best first: live JAX device enumeration
+(authoritative: device_kind like "TPU v5 lite"), then GKE's own labels
+(passthrough), then raw device-node counting.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Dict, Optional
+
+from .. import consts
+from ..utils import deep_get
+from .driver import discover_devices
+
+log = logging.getLogger(__name__)
+
+_KIND_TO_TYPE = {
+    "tpu v2": "tpu-v2",
+    "tpu v3": "tpu-v3",
+    "tpu v4": "tpu-v4",
+    "tpu v5 lite": "tpu-v5-lite-podslice",
+    "tpu v5e": "tpu-v5-lite-podslice",
+    "tpu v5p": "tpu-v5p-slice",
+    "tpu v6 lite": "tpu-v6e-slice",
+    "tpu v6e": "tpu-v6e-slice",
+}
+
+
+def chip_type_from_kind(device_kind: str) -> str:
+    kind = device_kind.lower()
+    for prefix, label in _KIND_TO_TYPE.items():
+        if kind.startswith(prefix):
+            return label
+    return kind.replace(" ", "-") or "unknown"
+
+
+def discover(use_jax: bool = True) -> Dict[str, str]:
+    """Return the label set this node should carry."""
+    labels: Dict[str, str] = {}
+    chip_count = 0
+    if use_jax and os.environ.get("TPU_FD_SKIP_JAX") != "1":
+        try:
+            import jax
+
+            devices = [d for d in jax.local_devices() if d.platform == "tpu"]
+            if devices:
+                chip_count = len(devices)
+                labels[consts.TPU_CHIP_TYPE_LABEL] = chip_type_from_kind(devices[0].device_kind)
+        except Exception as e:  # no TPU runtime in this container
+            log.debug("feature discovery: jax enumeration unavailable: %s", e)
+    if chip_count == 0:
+        chip_count = len(discover_devices())
+    if chip_count:
+        labels[consts.TPU_CHIP_COUNT_LABEL] = str(chip_count)
+    return labels
+
+
+def sync_node_labels(client, node_name: str, use_jax: bool = True) -> Dict[str, str]:
+    """One discovery pass: compute labels, mirror GKE labels, patch if drifted."""
+    node = client.get("v1", "Node", node_name)
+    current = deep_get(node, "metadata", "labels", default={}) or {}
+    desired = discover(use_jax=use_jax)
+    # passthrough: surface GKE's accelerator/topology labels under tpu.ai/*
+    if consts.GKE_TPU_ACCELERATOR_LABEL in current:
+        desired.setdefault(consts.TPU_CHIP_TYPE_LABEL, current[consts.GKE_TPU_ACCELERATOR_LABEL])
+    if consts.GKE_TPU_TOPOLOGY_LABEL in current:
+        desired[consts.TPU_TOPOLOGY_LABEL] = current[consts.GKE_TPU_TOPOLOGY_LABEL]
+    patch = {k: v for k, v in desired.items() if current.get(k) != v}
+    if patch:
+        client.patch("v1", "Node", node_name, {"metadata": {"labels": patch}})
+        log.info("feature discovery: %s labels %s", node_name, patch)
+    return desired
+
+
+def run(client, node_name: Optional[str] = None, sleep_interval: float = 60.0,
+        iterations: Optional[int] = None) -> int:
+    node_name = node_name or os.environ.get("NODE_NAME", "")
+    if not node_name:
+        log.error("feature discovery: NODE_NAME unset")
+        return 1
+    count = 0
+    while iterations is None or count < iterations:
+        try:
+            sync_node_labels(client, node_name)
+        except Exception:
+            log.exception("feature discovery pass failed")
+        count += 1
+        if iterations is not None and count >= iterations:
+            break
+        time.sleep(sleep_interval)
+    return 0

@@ -1,0 +1,191 @@
+"""``tpu-validator`` CLI: one binary, ``-c <component>`` dispatch
+(reference validator/main.go:220-365,479-596).
+
+Components:
+
+==================  =========================================================
+driver              validate libtpu install + device nodes; write barrier
+driver-daemon       installer DS main: place libtpu, heartbeat barrier
+driver-probe        cheap startupProbe (exit code only)
+plugin              wait for the TPU extended resource on this node
+workload            spawn allreduce pod via device plugin; write barrier
+workload-local      run the ICI health sweep in-process (inside the pod)
+workload-multihost  slice-wide sweep after jax.distributed rendezvous
+wait                block on another component's barrier (--for)
+sleep               validator DS main container: idle heartbeat
+metrics             node-status exporter (status files -> Prometheus)
+telemetry           libtpu telemetry exporter (DCGM analog)
+feature-discovery   chip/topology node labeler loop
+slice-partitioner   apply the node's slice partition config (MIG analog)
+==================  =========================================================
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+
+from .. import consts
+from .status import StatusFiles
+
+log = logging.getLogger("tpu-validator")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="tpu-validator")
+    p.add_argument("-c", "--component", required=True,
+                   choices=["driver", "driver-daemon", "driver-probe", "plugin",
+                            "workload", "workload-local", "workload-multihost",
+                            "wait", "sleep", "metrics", "telemetry",
+                            "feature-discovery", "slice-partitioner",
+                            "device-plugin"])
+    p.add_argument("--install-dir", default=consts.DEFAULT_LIBTPU_DIR)
+    p.add_argument("--libtpu-version", default=None)
+    p.add_argument("--status-dir", default=os.environ.get("STATUS_DIR", consts.VALIDATION_STATUS_DIR))
+    p.add_argument("--resource", default=consts.TPU_RESOURCE_NAME)
+    p.add_argument("--for", dest="wait_for", default="driver", help="barrier to wait on")
+    p.add_argument("--timeout", type=float, default=300.0)
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--sleep-interval", type=float, default=60.0)
+    p.add_argument("--matrix-dim", type=int, default=512)
+    p.add_argument("--coordinator", default=os.environ.get("TPU_COORDINATOR_ADDRESS", ""))
+    p.add_argument("--num-processes", type=int,
+                   default=int(os.environ.get("TPU_NUM_PROCESSES", "1")))
+    p.add_argument("--process-id", type=int,
+                   default=int(os.environ.get("TPU_WORKER_ID", "0")))
+    p.add_argument("--config", default="/etc/tpu-slice-partitioner/config.yaml")
+    p.add_argument("--no-require-devices", action="store_true",
+                   help="skip /dev checks (CI or pre-provisioned nodes)")
+    p.add_argument("--log-level", default="info")
+    return p
+
+
+def make_client():
+    from ..client.rest import RestClient
+
+    return RestClient(base_url=os.environ.get("KUBE_API_URL"))
+
+
+def run(argv=None, client=None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(level=getattr(logging, args.log_level.upper()),
+                        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    status = StatusFiles(args.status_dir)
+    component = args.component
+    require_devices = not args.no_require_devices
+
+    if component == "driver":
+        from . import driver
+
+        return 0 if driver.validate(args.install_dir, status, require_devices) else 1
+
+    if component == "driver-daemon":
+        from . import driver
+
+        return driver.daemon(args.install_dir, args.libtpu_version, status)
+
+    if component == "driver-probe":
+        from . import driver
+
+        return 0 if driver.probe(args.install_dir, require_devices) else 1
+
+    if component == "plugin":
+        from . import plugin
+
+        client = client or make_client()
+        return 0 if plugin.validate(client, resource=args.resource, status=status,
+                                    timeout=args.timeout) else 1
+
+    if component == "workload":
+        from .workload import spawn_workload_pod
+
+        client = client or make_client()
+        node_name = os.environ.get("NODE_NAME", "")
+        namespace = os.environ.get(consts.NAMESPACE_ENV, consts.DEFAULT_NAMESPACE)
+        image = os.environ.get("VALIDATOR_IMAGE", "")
+        if not node_name or not image:
+            log.error("workload: NODE_NAME and VALIDATOR_IMAGE required")
+            return 1
+        ok = spawn_workload_pod(client, namespace, node_name, image,
+                                resource_name=args.resource, timeout=args.timeout)
+        if ok:
+            status.write("workload", {"mode": "pod"})
+            return 0
+        return 1
+
+    if component == "workload-local":
+        from .workload import ici_health_check
+
+        report = ici_health_check(matrix_dim=args.matrix_dim)
+        print(json.dumps(report.to_dict()))
+        if report.passed:
+            status.write("workload", report.to_dict())
+        return 0 if report.passed else 1
+
+    if component == "workload-multihost":
+        from .workload import run_multihost
+
+        if not args.coordinator:
+            log.error("workload-multihost: --coordinator required")
+            return 1
+        report = run_multihost(args.coordinator, args.num_processes,
+                               args.process_id, matrix_dim=args.matrix_dim)
+        print(json.dumps(report.to_dict()))
+        if report.passed:
+            status.write("workload", report.to_dict())
+        return 0 if report.passed else 1
+
+    if component == "wait":
+        ok = status.wait_for(args.wait_for, timeout=args.timeout)
+        if not ok:
+            log.error("timed out waiting for %s barrier", args.wait_for)
+        return 0 if ok else 1
+
+    if component == "sleep":
+        import time
+
+        log.info("all validations complete; sleeping")
+        while True:
+            time.sleep(args.sleep_interval)
+
+    if component == "metrics":
+        from . import metrics
+
+        return metrics.serve(args.port, refresh_interval=min(args.sleep_interval, 60.0))
+
+    if component == "telemetry":
+        from . import telemetry
+
+        return telemetry.serve(args.port, refresh_interval=min(args.sleep_interval, 60.0))
+
+    if component == "feature-discovery":
+        from . import feature_discovery
+
+        client = client or make_client()
+        return feature_discovery.run(client, sleep_interval=args.sleep_interval)
+
+    if component == "device-plugin":
+        from ..deviceplugin import TPUDevicePlugin
+
+        plugin = TPUDevicePlugin(resource_name=args.resource,
+                                 libtpu_dir=args.install_dir)
+        return plugin.run_forever()
+
+    if component == "slice-partitioner":
+        from ..partitioner import run as partitioner_run
+
+        client = client or make_client()
+        return partitioner_run(client, config_path=args.config)
+
+    raise AssertionError(f"unhandled component {component}")
+
+
+def main(argv=None) -> int:
+    return run(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

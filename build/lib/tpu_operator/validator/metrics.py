@@ -1,0 +1,85 @@
+"""Node-status exporter (reference validator/metrics.go:34-149): turn the
+node-local status files into Prometheus gauges, refreshed periodically."""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from prometheus_client import CollectorRegistry, Gauge, generate_latest
+
+from .driver import discover_devices
+from .status import StatusFiles
+
+log = logging.getLogger(__name__)
+
+COMPONENTS = ("driver", "plugin", "workload")
+REFRESH_INTERVAL = 30.0  # reference refreshes 30-60s
+
+
+class NodeMetrics:
+    def __init__(self, status: Optional[StatusFiles] = None,
+                 registry: Optional[CollectorRegistry] = None):
+        self.status = status or StatusFiles()
+        self.registry = registry or CollectorRegistry()
+        self.ready = {
+            c: Gauge(f"tpu_operator_node_{c}_ready",
+                     f"1 when the {c} validation barrier is present on this node",
+                     registry=self.registry)
+            for c in COMPONENTS
+        }
+        self.device_nodes = Gauge("tpu_operator_node_tpu_device_nodes",
+                                  "TPU device nodes visible on this node",
+                                  registry=self.registry)
+        self.last_refresh = Gauge("tpu_operator_node_metrics_last_refresh_ts_seconds",
+                                  "Timestamp of the last metrics refresh",
+                                  registry=self.registry)
+
+    def refresh(self) -> None:
+        for component, gauge in self.ready.items():
+            gauge.set(1 if self.status.is_ready(component) else 0)
+        self.device_nodes.set(len(discover_devices()))
+        self.last_refresh.set(time.time())
+
+    def scrape(self) -> bytes:
+        return generate_latest(self.registry)
+
+
+def serve(port: int, metrics: Optional[NodeMetrics] = None,
+          refresh_interval: float = REFRESH_INTERVAL,
+          ready_event: Optional[threading.Event] = None,
+          stop_event: Optional[threading.Event] = None) -> int:
+    metrics = metrics or NodeMetrics()
+    metrics.refresh()
+    stop = stop_event or threading.Event()
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            if self.path.rstrip("/") != "/metrics":
+                self.send_response(404)
+                self.end_headers()
+                return
+            payload = metrics.scrape()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+    server = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    if ready_event:
+        ready_event.set()
+    log.info("node-status exporter on :%d", server.server_address[1])
+    try:
+        while not stop.wait(refresh_interval):
+            metrics.refresh()
+    finally:
+        server.shutdown()
+    return 0

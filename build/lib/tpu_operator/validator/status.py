@@ -1,0 +1,80 @@
+"""Node-local status-file barriers (reference validator/main.go:137-176).
+
+Files like ``driver-ready`` under ``/run/tpu/validations`` survive pod
+restarts (hostPath) and act as resumable barriers: operand init containers
+block on them, so operand start order is enforced per node without any
+central coordination.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import List, Optional
+
+from .. import consts
+
+#: wait budgets (reference waits 60x5s for workload pods, 30x5s for resources)
+DEFAULT_WAIT_TIMEOUT = 300.0
+DEFAULT_POLL_INTERVAL = 5.0
+
+
+class StatusFiles:
+    def __init__(self, directory: str = consts.VALIDATION_STATUS_DIR):
+        self.directory = directory
+
+    def path(self, component: str) -> str:
+        return os.path.join(self.directory, f"{component}-ready")
+
+    def write(self, component: str, details: Optional[dict] = None) -> str:
+        os.makedirs(self.directory, exist_ok=True)
+        payload = {"component": component, "timestamp": time.time(),
+                   "host": os.environ.get("NODE_NAME", os.uname().nodename)}
+        if details:
+            payload.update(details)
+        path = self.path(component)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)  # atomic: a reader never sees a partial barrier
+        return path
+
+    def clear(self, component: str) -> None:
+        try:
+            os.remove(self.path(component))
+        except FileNotFoundError:
+            pass
+
+    def clear_all(self) -> None:
+        if not os.path.isdir(self.directory):
+            return
+        for name in os.listdir(self.directory):
+            if name.endswith("-ready"):
+                os.remove(os.path.join(self.directory, name))
+
+    def is_ready(self, component: str) -> bool:
+        return os.path.exists(self.path(component))
+
+    def read(self, component: str) -> Optional[dict]:
+        try:
+            with open(self.path(component)) as f:
+                return json.load(f)
+        except (FileNotFoundError, json.JSONDecodeError):
+            return None
+
+    def ready_components(self) -> List[str]:
+        if not os.path.isdir(self.directory):
+            return []
+        return sorted(n[: -len("-ready")] for n in os.listdir(self.directory)
+                      if n.endswith("-ready"))
+
+    def wait_for(self, component: str, timeout: float = DEFAULT_WAIT_TIMEOUT,
+                 poll: float = DEFAULT_POLL_INTERVAL) -> bool:
+        deadline = time.monotonic() + timeout
+        while True:
+            if self.is_ready(component):
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(min(poll, max(0.01, deadline - time.monotonic())))

@@ -1,0 +1,103 @@
+"""libtpu telemetry exporter (reference: DCGM + dcgm-exporter operands).
+
+TPU-first single-tier design: libtpu exposes runtime state through the JAX
+client directly (device enumeration, per-chip HBM via memory_stats), so one
+in-process exporter replaces the reference's hostengine+exporter pair.
+Metrics use the dcgm-exporter naming style with a tpu_ prefix so existing
+dashboards translate mechanically.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from prometheus_client import CollectorRegistry, Gauge, generate_latest
+
+from .driver import discover_devices
+
+log = logging.getLogger(__name__)
+
+REFRESH_INTERVAL = 15.0
+
+
+class TelemetryMetrics:
+    def __init__(self, registry: Optional[CollectorRegistry] = None):
+        self.registry = registry or CollectorRegistry()
+        self.up = Gauge("tpu_chip_up", "1 when the chip is enumerable",
+                        ["chip", "kind"], registry=self.registry)
+        self.hbm_used = Gauge("tpu_hbm_used_bytes", "HBM bytes in use",
+                              ["chip"], registry=self.registry)
+        self.hbm_total = Gauge("tpu_hbm_total_bytes", "HBM capacity bytes",
+                               ["chip"], registry=self.registry)
+        self.chips = Gauge("tpu_chips_total", "TPU chips visible to libtpu",
+                           registry=self.registry)
+        self.device_nodes = Gauge("tpu_device_nodes_total",
+                                  "TPU device nodes present on the host",
+                                  registry=self.registry)
+
+    def refresh(self) -> None:
+        self.device_nodes.set(len(discover_devices()))
+        try:
+            import jax
+
+            devices = [d for d in jax.local_devices() if d.platform == "tpu"]
+        except Exception as e:
+            log.debug("telemetry: no TPU runtime: %s", e)
+            devices = []
+        self.chips.set(len(devices))
+        for d in devices:
+            chip = str(d.id)
+            self.up.labels(chip=chip, kind=d.device_kind).set(1)
+            try:
+                stats = d.memory_stats() or {}
+                if "bytes_in_use" in stats:
+                    self.hbm_used.labels(chip=chip).set(stats["bytes_in_use"])
+                limit = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
+                if limit:
+                    self.hbm_total.labels(chip=chip).set(limit)
+            except Exception:
+                pass  # memory_stats unsupported on some platforms
+
+    def scrape(self) -> bytes:
+        return generate_latest(self.registry)
+
+
+def serve(port: int, metrics: Optional[TelemetryMetrics] = None,
+          refresh_interval: float = REFRESH_INTERVAL,
+          ready_event: Optional[threading.Event] = None,
+          stop_event: Optional[threading.Event] = None) -> int:
+    metrics = metrics or TelemetryMetrics()
+    metrics.refresh()
+    stop = stop_event or threading.Event()
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            if self.path.rstrip("/") != "/metrics":
+                self.send_response(404)
+                self.end_headers()
+                return
+            payload = metrics.scrape()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+    server = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    if ready_event:
+        ready_event.set()
+    log.info("telemetry exporter on :%d", server.server_address[1])
+    try:
+        while not stop.wait(refresh_interval):
+            metrics.refresh()
+    finally:
+        server.shutdown()
+    return 0

@@ -1,0 +1,191 @@
+"""The accelerator workload check: JAX/XLA ICI health sweep.
+
+Replaces the reference's CUDA ``vectorAdd`` pod (validator/main.go:1357-1430,
+cuda-workload-validation.yaml) with the TPU-native equivalent: real math on
+every chip through the whole stack — libtpu, device plugin mounts, XLA
+compilation, and the ICI fabric. Four sub-checks, all inside ONE jitted
+program so XLA schedules them on the MXU/ICI natively:
+
+1. compute: per-chip bf16 matmul (exercises the MXU systolic array)
+2. psum allreduce over all chips (exercises the ICI reduction tree)
+3. ppermute ring pass (exercises every ICI link in the ring individually)
+4. all_gather (exercises broadcast paths)
+
+Integer-valued operands make every check exact — no tolerance tuning, a
+wrong-by-one-ULP link is a hard fail.
+
+Multi-host slices (e.g. v5e-16 = 4 VMs x 4 chips): call
+``jax.distributed.initialize`` first (see ``run_multihost``); the same jitted
+program then spans all chips of the slice over ICI, with DCN used only for
+the coordination bootstrap — the design the reference cannot express (its
+validator is strictly per-node; SURVEY.md 5.8).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Optional
+
+
+@dataclasses.dataclass
+class IciCheckReport:
+    passed: bool
+    n_devices: int
+    platform: str
+    elapsed_s: float
+    compile_s: float
+    details: dict
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def ici_health_check(matrix_dim: int = 512, devices=None) -> IciCheckReport:
+    """Run the 4-way ICI/MXU health sweep over all (or given) local devices.
+
+    Multi-process safe: the input is a global sharded array (each process
+    contributes only its addressable shards) and the output is fully
+    replicated via an in-program all_gather, so every process can fetch the
+    complete per-chip result matrix.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    shard_map = jax.shard_map
+
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    mesh = Mesh(devices, ("chips",))
+    start = time.monotonic()
+
+    def per_chip(ids):
+        # ids: (1,) int32 — this chip's ordinal
+        me = ids[0]
+        # 1. MXU: deterministic integer-valued bf16 matmul, exact result
+        a = jnp.full((matrix_dim, matrix_dim), 1, dtype=jnp.bfloat16)
+        b = jnp.full((matrix_dim, matrix_dim), 2, dtype=jnp.bfloat16)
+        c = (a @ b).astype(jnp.float32)  # every element == 2*dim exactly
+        compute_ok = jnp.all(c == 2.0 * matrix_dim)
+        # 2. psum allreduce: sum of ordinals 0..n-1
+        total = jax.lax.psum(me, axis_name="chips")
+        psum_ok = total == (n * (n - 1)) // 2
+        # 3. ppermute ring: n hops returns own ordinal, touching every link
+        token = me
+        for _ in range(n):
+            token = jax.lax.ppermute(token, axis_name="chips",
+                                     perm=[(i, (i + 1) % n) for i in range(n)])
+        ring_ok = token == me
+        # 4. all_gather: every chip sees every ordinal
+        gathered = jax.lax.all_gather(me, axis_name="chips")
+        gather_ok = jnp.all(gathered == jnp.arange(n))
+        flags = jnp.stack([compute_ok, psum_ok, ring_ok, gather_ok]).astype(jnp.int32)
+        # Scatter my row into an (n, 4) one-hot matrix and psum it: the result
+        # is the full per-chip matrix, replicated by construction on every
+        # chip (psum output is mesh-invariant), so any process can fetch it.
+        mine = jnp.zeros((n, 4), jnp.int32).at[me].set(flags)
+        return jax.lax.psum(mine, axis_name="chips")
+
+    check = jax.jit(shard_map(per_chip, mesh=mesh,
+                              in_specs=P("chips"), out_specs=P()))
+    ids_host = np.arange(n, dtype=np.int32)
+    ids = jax.make_array_from_callback(
+        (n,), NamedSharding(mesh, P("chips")), lambda idx: ids_host[idx])
+    compiled_at = time.monotonic()
+    per_chip_results = np.asarray(jax.device_get(check(ids)))  # (n, 4) 0/1 flags
+    elapsed = time.monotonic() - start
+
+    names = ["compute", "psum", "ring", "all_gather"]
+    details = {
+        name: {"passed": bool(per_chip_results[:, i].all()),
+               "failed_chips": [int(c) for c in range(n) if not per_chip_results[c, i]]}
+        for i, name in enumerate(names)
+    }
+    return IciCheckReport(
+        passed=bool(per_chip_results.all()),
+        n_devices=n,
+        platform=devices[0].platform,
+        elapsed_s=round(elapsed, 4),
+        compile_s=round(compiled_at - start, 4),
+        details=details,
+    )
+
+
+def run_multihost(coordinator: str, num_processes: int, process_id: int,
+                  matrix_dim: int = 512) -> IciCheckReport:
+    """Slice-wide validation: rendezvous over DCN, then the same sweep over
+    every chip of the slice via ICI (the v5e-16 north-star path)."""
+    import jax
+
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    return ici_health_check(matrix_dim=matrix_dim)
+
+
+# -- pod-spawning mode (reference runWorkload: spawn pod on own node) ---------
+
+WORKLOAD_POD_TEMPLATE = {
+    "apiVersion": "v1",
+    "kind": "Pod",
+    "metadata": {"name": "tpu-workload-validation", "labels": {"app": "tpu-workload-validation"}},
+    "spec": {
+        "restartPolicy": "Never",
+        "tolerations": [{"key": "google.com/tpu", "operator": "Exists", "effect": "NoSchedule"}],
+        "containers": [{
+            "name": "tpu-workload",
+            "image": "FILLED_BY_VALIDATOR",
+            "command": ["tpu-validator"],
+            "args": ["-c", "workload-local"],
+            "resources": {"limits": {"google.com/tpu": "FILLED_BY_VALIDATOR"}},
+        }],
+    },
+}
+
+
+def spawn_workload_pod(client, namespace: str, node_name: str, image: str,
+                       resource_name: str = "google.com/tpu", chips: Optional[int] = None,
+                       timeout: float = 300.0, poll: float = 1.0) -> bool:
+    """Create a validation pod pinned to this node requesting TPU resources
+    through the device plugin, wait for Succeeded (validator/main.go:1180)."""
+    import copy
+
+    from ..client.errors import NotFoundError
+    from ..utils import deep_get
+
+    if chips is None:
+        node = client.get("v1", "Node", node_name)
+        chips = int(deep_get(node, "status", "allocatable", resource_name,
+                             default=deep_get(node, "status", "capacity", resource_name, default=1)))
+    pod = copy.deepcopy(WORKLOAD_POD_TEMPLATE)
+    pod["metadata"]["namespace"] = namespace
+    pod["metadata"]["name"] = f"tpu-workload-validation-{node_name}"[:63]
+    pod["spec"]["nodeName"] = node_name
+    ctr = pod["spec"]["containers"][0]
+    ctr["image"] = image
+    ctr["resources"]["limits"] = {resource_name: str(chips)}
+
+    try:
+        client.delete("v1", "Pod", pod["metadata"]["name"], namespace)
+    except NotFoundError:
+        pass
+    client.create(pod)
+    deadline = time.monotonic() + timeout
+    try:
+        while time.monotonic() < deadline:
+            live = client.get("v1", "Pod", pod["metadata"]["name"], namespace)
+            phase = deep_get(live, "status", "phase")
+            if phase == "Succeeded":
+                return True
+            if phase == "Failed":
+                return False
+            time.sleep(poll)
+        return False
+    finally:
+        try:
+            client.delete("v1", "Pod", pod["metadata"]["name"], namespace)
+        except NotFoundError:
+            pass

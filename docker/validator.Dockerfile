@@ -7,9 +7,16 @@ ARG LIBTPU_VERSION=latest
 FROM python:3.12-slim AS base
 ARG LIBTPU_VERSION
 
-RUN pip install --no-cache-dir \
-    "jax[tpu]" -f https://storage.googleapis.com/jax-releases/libtpu_releases.html \
-    jinja2 pyyaml requests prometheus_client grpcio
+# LIBTPU_VERSION pins the actual payload: the bundled libtpu wheel IS what
+# driver.install() places on the host, so the label and the .so must agree.
+RUN if [ "$LIBTPU_VERSION" = "latest" ]; then \
+      pip install --no-cache-dir "jax[tpu]" \
+        -f https://storage.googleapis.com/jax-releases/libtpu_releases.html; \
+    else \
+      pip install --no-cache-dir "jax[tpu]" "libtpu==${LIBTPU_VERSION}" \
+        -f https://storage.googleapis.com/jax-releases/libtpu_releases.html; \
+    fi \
+    && pip install --no-cache-dir jinja2 pyyaml requests prometheus_client grpcio
 
 WORKDIR /opt/tpu-operator
 COPY pyproject.toml ./

@@ -53,6 +53,35 @@ def test_validate_csv_alm_examples(capsys):
     assert "TPUDriver/default: OK" in out
 
 
+def test_validate_csv_rejects_bad_inputs(tmp_path, capsys):
+    empty = tmp_path / "empty.yaml"
+    empty.write_text("")
+    assert run(["validate-csv", str(empty)]) == 1
+    no_examples = tmp_path / "no-examples.yaml"
+    no_examples.write_text("metadata:\n  annotations: {}\n")
+    assert run(["validate-csv", str(no_examples)]) == 1
+    assert "missing alm-examples" in capsys.readouterr().out
+
+
+def test_wheel_ships_manifest_package_data(tmp_path):
+    """The installed package must carry its manifests (docker image runtime)."""
+    import subprocess
+    import sys
+    import zipfile
+
+    repo = os.path.dirname(SAMPLES).rsplit("/config", 1)[0]
+    result = subprocess.run(
+        [sys.executable, "-m", "pip", "wheel", "--no-deps", "--no-build-isolation",
+         "-w", str(tmp_path), repo],
+        capture_output=True, text=True)
+    assert result.returncode == 0, result.stderr[-2000:]
+    wheel = next(p for p in os.listdir(tmp_path) if p.endswith(".whl"))
+    names = zipfile.ZipFile(os.path.join(tmp_path, wheel)).namelist()
+    assert any(n.endswith("manifests/state-driver/0500_daemonset.yaml") for n in names)
+    assert any(n.endswith("manifests/_includes/common.j2") for n in names)
+    assert any(n.endswith("api/crds/tpu.ai_clusterpolicies.yaml") for n in names)
+
+
 def test_static_deploy_manifest_parses():
     path = os.path.join(os.path.dirname(SAMPLES), "..", "deploy", "operator.yaml")
     with open(path) as f:

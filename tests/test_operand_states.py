@@ -93,6 +93,27 @@ def test_validator_ds_has_validation_chain(fake_client):
     assert inits == ["driver-validation", "plugin-validation", "workload-validation"]
 
 
+def test_device_plugin_builtin_vs_external(fake_client):
+    # builtin (default): tpu-validator entrypoint forced
+    rendered = render_all(fake_client)
+    ds = [o for o in rendered["state-device-plugin"] if o["kind"] == "DaemonSet"][0]
+    ctr = ds["spec"]["template"]["spec"]["containers"][0]
+    assert ctr["command"] == ["tpu-validator"]
+    assert "-c" in ctr["args"] and "device-plugin" in ctr["args"]
+    # external image: no command override; image entrypoint + optional args
+    rendered = render_all(fake_client, {"devicePlugin": {
+        "builtinPlugin": False, "args": ["--flag=1"]}})
+    ds = [o for o in rendered["state-device-plugin"] if o["kind"] == "DaemonSet"][0]
+    ctr = ds["spec"]["template"]["spec"]["containers"][0]
+    assert "command" not in ctr
+    assert ctr["args"] == ["--flag=1"]
+    # external image, no args: bare entrypoint
+    rendered = render_all(fake_client, {"devicePlugin": {"builtinPlugin": False}})
+    ctr = [o for o in rendered["state-device-plugin"] if o["kind"] == "DaemonSet"][0][
+        "spec"]["template"]["spec"]["containers"][0]
+    assert "command" not in ctr and "args" not in ctr
+
+
 def test_manager_full_sweep_with_disabled_states(fake_client):
     p = policy({"telemetry": {"enabled": False}})
     manager = Manager(cluster_policy_states(fake_client))
