@@ -55,16 +55,17 @@ std::vector<std::string> DiscoverDevices(const char* extra_globs_env) {
   return found;
 }
 
-// libtpu present = regular file with a valid ELF shared-object header.
+// libtpu present = regular readable file with the ELF magic (same 4-byte
+// check as the Python fallback in validator/driver.py — keep them agreeing).
 bool CheckLibtpu(const std::string& install_dir, std::string* path_out) {
   const std::string path = install_dir + "/libtpu.so";
   *path_out = path;
   FILE* f = std::fopen(path.c_str(), "rb");
   if (f == nullptr) return false;
-  unsigned char header[EI_NIDENT] = {0};
-  const size_t read = std::fread(header, 1, sizeof(header), f);
+  unsigned char magic[SELFMAG] = {0};
+  const size_t read = std::fread(magic, 1, sizeof(magic), f);
   std::fclose(f);
-  return read == sizeof(header) && std::memcmp(header, ELFMAG, SELFMAG) == 0;
+  return read == sizeof(magic) && std::memcmp(magic, ELFMAG, SELFMAG) == 0;
 }
 
 void PrintJson(bool ok, bool libtpu_ok, const std::string& libtpu_path,
