@@ -65,6 +65,7 @@ def default_scheme() -> Scheme:
     s.register("rbac.authorization.k8s.io/v1", "ClusterRole", "clusterroles", namespaced=False)
     s.register("rbac.authorization.k8s.io/v1", "ClusterRoleBinding", "clusterrolebindings", namespaced=False)
 
+    s.register("coordination.k8s.io/v1", "Lease", "leases")
     s.register("node.k8s.io/v1", "RuntimeClass", "runtimeclasses", namespaced=False)
     s.register("scheduling.k8s.io/v1", "PriorityClass", "priorityclasses", namespaced=False)
     s.register("policy/v1", "PodDisruptionBudget", "poddisruptionbudgets")

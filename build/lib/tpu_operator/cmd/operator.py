@@ -18,6 +18,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--metrics-port", type=int, default=8080, help="Prometheus metrics port (0 disables)")
     p.add_argument("--health-port", type=int, default=8081, help="healthz port (0 disables)")
     p.add_argument("--log-level", default="info", choices=["debug", "info", "warning", "error"])
+    p.add_argument("--leader-elect", action="store_true",
+                   help="enable Lease-based leader election (multi-replica deployments)")
     p.add_argument("--version", action="version", version=f"tpu-operator {__version__}")
     return p
 

@@ -1,0 +1,141 @@
+"""Lease-based leader election for multi-replica operator deployments.
+
+The reference gets this from controller-runtime's optional leader election
+(cmd/gpu-operator/main.go enables it by flag). Same semantics here:
+coordination.k8s.io/v1 Lease named after the operator, holderIdentity +
+renewTime, takeover after leaseDurationSeconds without renewal.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+import uuid
+from typing import Callable, Optional
+
+from ..client.errors import ApiError, ConflictError, NotFoundError
+from ..client.interface import Client
+
+log = logging.getLogger(__name__)
+
+LEASE_NAME = "tpu-operator-leader"
+
+
+def _now() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%S.000000Z", time.gmtime())
+
+
+def _parse(ts: str) -> float:
+    import calendar
+
+    try:
+        return calendar.timegm(time.strptime(ts.split(".")[0], "%Y-%m-%dT%H:%M:%S"))
+    except (ValueError, AttributeError):
+        return 0.0
+
+
+class LeaderElector:
+    def __init__(self, client: Client, namespace: str,
+                 identity: Optional[str] = None,
+                 lease_name: str = LEASE_NAME,
+                 lease_duration: float = 15.0,
+                 renew_period: float = 5.0,
+                 retry_period: float = 2.0):
+        self.client = client
+        self.namespace = namespace
+        self.identity = identity or f"{os.uname().nodename}_{uuid.uuid4().hex[:8]}"
+        self.lease_name = lease_name
+        self.lease_duration = lease_duration
+        self.renew_period = renew_period
+        self.retry_period = retry_period
+        self.is_leader = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lease mechanics ------------------------------------------------------
+    def _lease_obj(self, transitions: int = 0) -> dict:
+        return {
+            "apiVersion": "coordination.k8s.io/v1",
+            "kind": "Lease",
+            "metadata": {"name": self.lease_name, "namespace": self.namespace},
+            "spec": {
+                "holderIdentity": self.identity,
+                "leaseDurationSeconds": max(1, int(self.lease_duration)),
+                "acquireTime": _now(),
+                "renewTime": _now(),
+                "leaseTransitions": transitions,
+            },
+        }
+
+    def try_acquire_or_renew(self) -> bool:
+        try:
+            lease = self.client.get("coordination.k8s.io/v1", "Lease",
+                                    self.lease_name, self.namespace)
+        except NotFoundError:
+            try:
+                self.client.create(self._lease_obj())
+                return True
+            except ApiError:
+                return False
+        spec = lease.get("spec", {})
+        holder = spec.get("holderIdentity")
+        if holder == self.identity:
+            spec["renewTime"] = _now()
+        else:
+            expiry = _parse(spec.get("renewTime", "")) + spec.get(
+                "leaseDurationSeconds", self.lease_duration)
+            if time.time() < expiry:
+                return False  # someone else holds a live lease
+            spec["holderIdentity"] = self.identity
+            spec["acquireTime"] = _now()
+            spec["renewTime"] = _now()
+            spec["leaseTransitions"] = spec.get("leaseTransitions", 0) + 1
+        lease["spec"] = spec
+        try:
+            self.client.update(lease)
+            return True
+        except (ConflictError, NotFoundError):
+            return False  # lost the write race
+
+    # -- loop -----------------------------------------------------------------
+    def run(self, on_started: Callable[[], None],
+            on_stopped: Callable[[], None]) -> None:
+        self._thread = threading.Thread(target=self._loop,
+                                        args=(on_started, on_stopped),
+                                        daemon=True, name="leader-elector")
+        self._thread.start()
+
+    def _loop(self, on_started, on_stopped) -> None:
+        while not self._stop.is_set():
+            if self.try_acquire_or_renew():
+                if not self.is_leader.is_set():
+                    log.info("leader election: %s acquired leadership", self.identity)
+                    self.is_leader.set()
+                    on_started()
+                self._stop.wait(self.renew_period)
+            else:
+                if self.is_leader.is_set():
+                    log.warning("leader election: %s LOST leadership", self.identity)
+                    self.is_leader.clear()
+                    on_stopped()
+                self._stop.wait(self.retry_period)
+
+    def release(self) -> None:
+        """Voluntary hand-off on clean shutdown (fast failover)."""
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+        if not self.is_leader.is_set():
+            return
+        try:
+            lease = self.client.get("coordination.k8s.io/v1", "Lease",
+                                    self.lease_name, self.namespace)
+            if lease.get("spec", {}).get("holderIdentity") == self.identity:
+                lease["spec"]["holderIdentity"] = ""
+                lease["spec"]["renewTime"] = "1970-01-01T00:00:00.000000Z"
+                self.client.update(lease)
+        except ApiError:
+            pass
+        self.is_leader.clear()

@@ -115,15 +115,33 @@ def run_operator(args) -> int:
                       metrics_port=args.metrics_port, health_port=args.health_port)
 
     stop = threading.Event()
+    exit_code = [0]
     for sig in (signal.SIGINT, signal.SIGTERM):
         try:
             signal.signal(sig, lambda *_: stop.set())
         except ValueError:
             pass  # not the main thread (tests)
 
-    app.start()
+    elector = None
+    if getattr(args, "leader_elect", False):
+        from .leader import LeaderElector
+
+        def on_lost():
+            # standard operator behavior: exit rather than risk split brain
+            log.error("leadership lost; exiting for clean restart")
+            exit_code[0] = 1
+            stop.set()
+
+        elector = LeaderElector(client, app.clusterpolicy_reconciler.namespace)
+        elector.run(on_started=app.start, on_stopped=on_lost)
+        log.info("leader election enabled; waiting for leadership as %s", elector.identity)
+    else:
+        app.start()
+
     log.info("controllers running; metrics :%s health :%s", args.metrics_port, args.health_port)
     stop.wait()
     log.info("shutting down")
+    if elector is not None:
+        elector.release()
     app.stop()
-    return 0
+    return exit_code[0]

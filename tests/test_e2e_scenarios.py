@@ -133,6 +133,28 @@ def test_install_verify_update_restart_uninstall(cluster):
              message="operand GC on uninstall")
 
 
+def test_manual_operand_deletion_self_heals(cluster):
+    """Drift repair: deleting an operand DS by hand must recreate it (the DS
+    DELETED watch event re-triggers the level-driven sweep)."""
+    client, app = cluster["client"], cluster["app"]
+    client.create({"apiVersion": "v1", "kind": "Node",
+                   "metadata": {"name": "tpu-0", "labels": dict(TPU_LABELS)},
+                   "status": {}})
+    client.create(new_cluster_policy())
+    app.start()
+    wait_for(lambda: policy_state(client) == "ready", message="install ready")
+    client.delete("apps/v1", "DaemonSet", "tpu-device-plugin", "tpu-operator")
+
+    def recreated():
+        try:
+            ds = client.get("apps/v1", "DaemonSet", "tpu-device-plugin", "tpu-operator")
+        except NotFoundError:
+            return False
+        return ds.get("status", {}).get("numberAvailable", 0) == 1
+    wait_for(recreated, message="device-plugin DS self-healed")
+    wait_for(lambda: policy_state(client) == "ready", message="ready again")
+
+
 def test_tpudriver_e2e_over_wire(cluster):
     """tests/cases/nvidia-driver.sh analog: drive the TPUDriver CRD path."""
     client, app = cluster["client"], cluster["app"]

@@ -1,0 +1,57 @@
+import time
+
+from tpu_operator.controllers.leader import LeaderElector
+
+
+def elector(fake_client, ident, **kw):
+    defaults = dict(lease_duration=2.0, renew_period=0.1, retry_period=0.05)
+    defaults.update(kw)
+    return LeaderElector(fake_client, "tpu-operator", identity=ident, **defaults)
+
+
+def test_single_elector_acquires(fake_client):
+    e = elector(fake_client, "a")
+    assert e.try_acquire_or_renew()
+    lease = fake_client.get("coordination.k8s.io/v1", "Lease",
+                            "tpu-operator-leader", "tpu-operator")
+    assert lease["spec"]["holderIdentity"] == "a"
+    # renew keeps it
+    assert e.try_acquire_or_renew()
+
+
+def test_second_elector_blocked_while_lease_live(fake_client):
+    a, b = elector(fake_client, "a"), elector(fake_client, "b")
+    assert a.try_acquire_or_renew()
+    assert not b.try_acquire_or_renew()
+
+
+def test_takeover_after_expiry(fake_client):
+    a = elector(fake_client, "a", lease_duration=1.0)
+    b = elector(fake_client, "b", lease_duration=1.0)
+    assert a.try_acquire_or_renew()
+    time.sleep(2.1)  # a stops renewing (crashed); lease expires
+    assert b.try_acquire_or_renew()
+    lease = fake_client.get("coordination.k8s.io/v1", "Lease",
+                            "tpu-operator-leader", "tpu-operator")
+    assert lease["spec"]["holderIdentity"] == "b"
+    assert lease["spec"]["leaseTransitions"] == 1
+
+
+def test_run_loop_and_voluntary_release(fake_client):
+    events = []
+    a = elector(fake_client, "a")
+    a.run(on_started=lambda: events.append("a-start"),
+          on_stopped=lambda: events.append("a-stop"))
+    assert a.is_leader.wait(timeout=2)
+    assert events == ["a-start"]
+
+    b = elector(fake_client, "b")
+    b.run(on_started=lambda: events.append("b-start"),
+          on_stopped=lambda: events.append("b-stop"))
+    time.sleep(0.2)
+    assert not b.is_leader.is_set()  # blocked while a renews
+
+    a.release()  # clean shutdown: immediate hand-off
+    assert b.is_leader.wait(timeout=3)
+    assert "b-start" in events
+    b.release()
