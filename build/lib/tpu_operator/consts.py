@@ -25,6 +25,11 @@ TPU_CHIP_COUNT_LABEL = "tpu.ai/tpu.chip-count"
 TPU_TOPOLOGY_LABEL = "tpu.ai/tpu.topology"
 TPU_SLICE_CONFIG_LABEL = "tpu.ai/slice.config"
 TPU_SLICE_STATE_LABEL = "tpu.ai/slice.config.state"
+#: nodes carrying the same value form one multi-host slice (set by the admin
+#: or mirrored from the platform's nodepool label by feature discovery)
+TPU_SLICE_ID_LABEL = "tpu.ai/slice.id"
+#: slice-level validation stamp (value = hash of the validated config)
+MULTIHOST_VALIDATED_ANNOTATION = "tpu.ai/multihost-validated"
 #: upgrade state machine's per-node persistent state
 UPGRADE_STATE_LABEL = "tpu.ai/tpu-driver-upgrade-state"
 UPGRADE_SKIP_DRAIN_LABEL = "tpu.ai/tpu-driver-upgrade-drain.skip"

@@ -166,8 +166,17 @@ def setup_clusterpolicy_controller(client: Client,
         # driver state (hand-over/hand-back), so the policy must re-reconcile
         return _all_policy_requests(client)
 
+    def map_validation_pod(event: WatchEvent) -> List[Request]:
+        # multihost rendezvous pods completing must re-trigger promptly
+        # rather than waiting out the 5s NotReady requeue
+        app = deep_get(event.object, "metadata", "labels", "app")
+        if app == "tpu-multihost-validation":
+            return _all_policy_requests(client)
+        return []
+
     controller.watches("tpu.ai/v1", "ClusterPolicy", map_policy)
     controller.watches("v1", "Node", map_node)
     controller.watches("apps/v1", "DaemonSet", map_owned)
     controller.watches("tpu.ai/v1alpha1", "TPUDriver", map_tpudriver)
+    controller.watches("v1", "Pod", map_validation_pod)
     return controller

@@ -21,6 +21,7 @@ from ..api.common import ComponentSpec
 from ..client.interface import Client
 from ..render import Renderer
 from .driver import MANIFEST_DIR, StateDriver
+from .multihost import MultihostValidationState
 from .manager import (
     INFO_CLUSTER_POLICY,
     INFO_NAMESPACE,
@@ -180,6 +181,7 @@ def cluster_policy_states(client: Client) -> List:
         OperandState("state-device-plugin", "device-plugin", client,
                      lambda p: p.spec.device_plugin, extras=device_plugin_extras,
                      app_name="tpu-device-plugin"),
+        MultihostValidationState(client),
         OperandState("state-feature-discovery", "feature-discovery", client,
                      lambda p: p.spec.feature_discovery,
                      app_name="tpu-feature-discovery"),

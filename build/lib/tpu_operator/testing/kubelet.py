@@ -59,6 +59,7 @@ class KubeletSimulator:
     # one scheduling pass; public so tests can drive it deterministically
     def tick(self) -> None:
         nodes = self.client.list("v1", "Node")
+        self._complete_validation_pods()
         for ds in self.client.list("apps/v1", "DaemonSet", self.namespace):
             selector = deep_get(ds, "spec", "template", "spec", "nodeSelector", default={})
             matching = [n for n in nodes if node_matches_selector(n, selector)]
@@ -81,6 +82,17 @@ class KubeletSimulator:
             if available and self._is_device_plugin(ds):
                 for node in matching:
                     self._register_tpus(node)
+
+    def _complete_validation_pods(self) -> None:
+        """Pinned validation pods (workload + multihost rendezvous) run to
+        completion instantly in the simulator."""
+        for pod in self.client.list("v1", "Pod", self.namespace):
+            app = deep_get(pod, "metadata", "labels", "app", default="")
+            if app not in ("tpu-multihost-validation", "tpu-workload-validation"):
+                continue
+            if deep_get(pod, "status", "phase") != "Succeeded":
+                pod["status"] = {"phase": "Succeeded"}
+                self.client.update_status(pod)
 
     @staticmethod
     def _is_device_plugin(ds: dict) -> bool:

@@ -155,6 +155,29 @@ def test_manual_operand_deletion_self_heals(cluster):
     wait_for(lambda: policy_state(client) == "ready", message="ready again")
 
 
+def test_multihost_slice_validation_e2e(cluster):
+    """A 4-VM slice converges: operands up -> rendezvous pods -> all nodes
+    stamped -> ready (the v5e-16 north-star flow on the harness)."""
+    client, app = cluster["client"], cluster["app"]
+    for i in range(4):
+        labels = dict(TPU_LABELS)
+        labels[consts.TPU_SLICE_ID_LABEL] = "v5e-16"
+        client.create({"apiVersion": "v1", "kind": "Node",
+                       "metadata": {"name": f"vm-{i}", "labels": labels},
+                       "status": {}})
+    client.create(new_cluster_policy())
+    app.start()
+    wait_for(lambda: policy_state(client) == "ready", timeout=30,
+             message="slice validated + ready")
+    for i in range(4):
+        node = client.get("v1", "Node", f"vm-{i}")
+        assert deep_get(node, "metadata", "annotations",
+                        consts.MULTIHOST_VALIDATED_ANNOTATION), f"vm-{i} not stamped"
+    # rendezvous pods torn down after success
+    assert client.list("v1", "Pod", "tpu-operator",
+                       label_selector={"app": "tpu-multihost-validation"}) == []
+
+
 def test_tpudriver_e2e_over_wire(cluster):
     """tests/cases/nvidia-driver.sh analog: drive the TPUDriver CRD path."""
     client, app = cluster["client"], cluster["app"]

@@ -1,0 +1,137 @@
+import pytest
+
+from tpu_operator import consts
+from tpu_operator.api.clusterpolicy import ClusterPolicy, new_cluster_policy
+from tpu_operator.state.manager import (
+    INFO_CLUSTER_POLICY,
+    INFO_NAMESPACE,
+    INFO_NODES,
+    InfoCatalog,
+)
+from tpu_operator.state.multihost import MultihostValidationState, slice_groups
+from tpu_operator.state.skel import SyncState
+from tpu_operator.utils import deep_get
+
+NS = "tpu-operator"
+
+
+@pytest.fixture(autouse=True)
+def default_images(monkeypatch):
+    monkeypatch.setenv("VALIDATOR_IMAGE", "gcr.io/tpu/tpu-validator:0.1.0")
+    monkeypatch.setenv("DRIVER_IMAGE", "gcr.io/tpu/tpu-validator:0.1.0")
+
+
+def mk_node(name, slice_id=None, chips="4"):
+    labels = {consts.GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice"}
+    if slice_id:
+        labels[consts.TPU_SLICE_ID_LABEL] = slice_id
+    node = {"apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": name, "labels": labels}, "status": {}}
+    if chips:
+        node["status"]["capacity"] = {consts.TPU_RESOURCE_NAME: chips}
+    return node
+
+
+def catalog(fake_client, policy=None):
+    c = InfoCatalog()
+    c[INFO_CLUSTER_POLICY] = policy or ClusterPolicy.from_obj(
+        fake_client.create(new_cluster_policy()))
+    c[INFO_NAMESPACE] = NS
+    c[INFO_NODES] = fake_client.list("v1", "Node")
+    return c
+
+
+def test_slice_groups_requires_id_capacity_and_two_nodes():
+    nodes = [mk_node("a", "s1"), mk_node("b", "s1"),
+             mk_node("c", "s2"),                 # singleton: excluded
+             mk_node("d", "s3", chips=None),     # not schedulable: excluded
+             mk_node("e")]                       # no slice id
+    groups = slice_groups(nodes)
+    assert set(groups) == {"s1"}
+    assert [n["metadata"]["name"] for n in groups["s1"]] == ["a", "b"]
+
+
+def test_rendezvous_lifecycle(fake_client):
+    for i in range(4):
+        fake_client.create(mk_node(f"vm-{i}", "v5e-16"))
+    state = MultihostValidationState(fake_client)
+    cat = catalog(fake_client)
+
+    # sweep 1: pods + headless service rendered
+    result = state.sync(cat)
+    assert result.status == SyncState.NOT_READY
+    pods = fake_client.list("v1", "Pod", NS, label_selector={"app": "tpu-multihost-validation"})
+    assert len(pods) == 4
+    svc = fake_client.get("v1", "Service", "tpu-mh-validation-v5e-16", NS)
+    assert svc["spec"]["clusterIP"] == "None"
+    worker0 = next(p for p in pods if p["metadata"]["labels"]["tpu.ai/worker-id"] == "0")
+    env = {e["name"]: e.get("value") for e in worker0["spec"]["containers"][0]["env"]}
+    assert env["TPU_NUM_PROCESSES"] == "4"
+    assert env["TPU_WORKER_ID"] == "0"
+    assert env["TPU_COORDINATOR_ADDRESS"].startswith("tpu-mh-validation-v5e-16-0.")
+    assert len(env["TPU_WORKER_HOSTNAMES"].split(",")) == 4
+    assert worker0["spec"]["containers"][0]["resources"]["limits"] == {
+        consts.TPU_RESOURCE_NAME: "4"}
+    assert worker0["spec"]["nodeName"] == "vm-0"
+
+    # sweep 2: pods still running -> not ready
+    assert state.sync(cat).status == SyncState.NOT_READY
+
+    # all pods succeed -> nodes stamped, pods torn down, ready
+    for pod in fake_client.list("v1", "Pod", NS):
+        pod["status"] = {"phase": "Succeeded"}
+        fake_client.update_status(pod)
+    result = state.sync(cat)
+    assert result.status == SyncState.READY
+    assert fake_client.list("v1", "Pod", NS) == []
+    for i in range(4):
+        node = fake_client.get("v1", "Node", f"vm-{i}")
+        assert deep_get(node, "metadata", "annotations",
+                        consts.MULTIHOST_VALIDATED_ANNOTATION)
+
+    # stamped: subsequent sweeps are no-op ready
+    cat[INFO_NODES] = fake_client.list("v1", "Node")
+    assert state.sync(cat).status == SyncState.READY
+    assert fake_client.list("v1", "Pod", NS) == []
+
+
+def test_failed_worker_retries(fake_client):
+    for i in range(2):
+        fake_client.create(mk_node(f"vm-{i}", "s"))
+    state = MultihostValidationState(fake_client)
+    cat = catalog(fake_client)
+    state.sync(cat)
+    pods = fake_client.list("v1", "Pod", NS)
+    pods[0]["status"] = {"phase": "Failed"}
+    fake_client.update_status(pods[0])
+    assert state.sync(cat).status == SyncState.NOT_READY
+    assert fake_client.list("v1", "Pod", NS) == []  # torn down for clean retry
+    # next sweep relaunches
+    state.sync(cat)
+    assert len(fake_client.list("v1", "Pod", NS)) == 2
+
+
+def test_config_change_invalidates_stamp(fake_client):
+    for i in range(2):
+        fake_client.create(mk_node(f"vm-{i}", "s"))
+    state = MultihostValidationState(fake_client)
+    cat = catalog(fake_client)
+    state.sync(cat)
+    for pod in fake_client.list("v1", "Pod", NS):
+        pod["status"] = {"phase": "Succeeded"}
+        fake_client.update_status(pod)
+    assert state.sync(cat).status == SyncState.READY
+
+    # driver version bump -> new config hash -> revalidation
+    policy = cat[INFO_CLUSTER_POLICY]
+    policy.spec.driver.libtpu_version = "2026.1.0"
+    cat[INFO_NODES] = fake_client.list("v1", "Node")
+    result = state.sync(cat)
+    assert result.status == SyncState.NOT_READY
+    assert len(fake_client.list("v1", "Pod", NS)) == 2
+
+
+def test_no_multihost_slices_is_ready(fake_client):
+    fake_client.create(mk_node("single"))
+    state = MultihostValidationState(fake_client)
+    assert state.sync(catalog(fake_client)).status == SyncState.READY
