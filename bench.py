@@ -76,11 +76,28 @@ def bench_control_plane(n_nodes: int = 4, timeout: float = 115.0) -> float:
         srv.stop()
 
 
-def bench_validation() -> dict:
-    from tpu_operator.validator.workload import ici_health_check
+def bench_validation(timeout: float = 240.0) -> dict:
+    """Run the hardware sweep in a subprocess with a hard timeout: a wedged
+    accelerator tunnel must produce a failed line, not a hung benchmark."""
+    import subprocess
 
-    report = ici_health_check(matrix_dim=512)
-    return report.to_dict()
+    script = (
+        "import json\n"
+        "from tpu_operator.validator.workload import ici_health_check\n"
+        "print(json.dumps(ici_health_check(matrix_dim=512).to_dict()))\n"
+    )
+    try:
+        result = subprocess.run(
+            [sys.executable, "-c", script], capture_output=True, text=True,
+            timeout=timeout, cwd=os.path.dirname(os.path.abspath(__file__)))
+        for line in reversed(result.stdout.splitlines()):
+            if line.startswith("{"):
+                return json.loads(line)
+        raise RuntimeError(result.stderr[-500:])
+    except (subprocess.TimeoutExpired, RuntimeError, json.JSONDecodeError) as e:
+        return {"passed": False, "n_devices": 0, "platform": "unavailable",
+                "elapsed_s": float(timeout), "compile_s": 0.0,
+                "details": {"error": str(e)[:300]}}
 
 
 def main() -> int:

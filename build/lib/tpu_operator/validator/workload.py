@@ -29,6 +29,27 @@ import time
 from typing import Optional
 
 
+def enable_compilation_cache() -> Optional[str]:
+    """Point XLA's persistent compilation cache at a host-path dir so repeat
+    validations (pod restarts, upgrade re-validation, node reboots) skip the
+    multi-second TPU compile. The dir is mounted from the host
+    (state-operator-validation template) and survives pod churn — same
+    lifetime model as the status-file barriers.
+    """
+    cache_dir = os.environ.get("TPU_COMPILATION_CACHE_DIR")
+    if not cache_dir:
+        return None
+    try:
+        import jax
+
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+        return cache_dir
+    except Exception:  # cache is an optimisation, never a failure
+        return None
+
+
 @dataclasses.dataclass
 class IciCheckReport:
     passed: bool
@@ -57,6 +78,7 @@ def ici_health_check(matrix_dim: int = 512, devices=None) -> IciCheckReport:
 
     shard_map = jax.shard_map
 
+    enable_compilation_cache()
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
     mesh = Mesh(devices, ("chips",))
