@@ -70,7 +70,11 @@ class StateDriver:
             "node_affinity": o.node_affinity,
             "extra_labels": o.extra_labels or {},
             "daemonsets": {
-                "update_strategy": policy.spec.daemonsets.update_strategy,
+                # autoUpgrade hands rollout ordering to the upgrade state
+                # machine: the DS must not replace pods on its own (OnDelete),
+                # matching the reference's driver-manager contract
+                "update_strategy": ("OnDelete" if driver.upgrade_policy.auto_upgrade
+                                    else policy.spec.daemonsets.update_strategy),
                 "rolling_update": policy.spec.daemonsets.rolling_update,
                 "priority_class_name": policy.spec.daemonsets.priority_class_name,
                 "tolerations": policy.spec.daemonsets.tolerations,

@@ -13,6 +13,7 @@ Plays the role a real cluster's kubelets play against the operator
 
 from __future__ import annotations
 
+import copy
 import logging
 import threading
 from typing import Optional
@@ -29,12 +30,16 @@ log = logging.getLogger(__name__)
 class KubeletSimulator:
     def __init__(self, client: Client, namespace: str = consts.DEFAULT_NAMESPACE,
                  chips_per_node: int = 4, interval: float = 0.05,
-                 rollout_ticks: int = 0):
+                 rollout_ticks: int = 0, create_pods: bool = False):
         self.client = client
         self.namespace = namespace
         self.chips_per_node = chips_per_node
         self.interval = interval
         self.rollout_ticks = rollout_ticks  # ticks a DS stays unavailable first
+        #: create one pod per (DS, node) with real DS-controller semantics:
+        #: RollingUpdate replaces outdated pods automatically, OnDelete only
+        #: recreates after someone (e.g. the upgrade machine) deletes them
+        self.create_pods = create_pods
         self._seen: dict = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -67,14 +72,18 @@ class KubeletSimulator:
             key = (ds["metadata"]["name"], ds["metadata"].get("generation"))
             ticks = self._seen.get(key, 0)
             self._seen[key] = ticks + 1
-            available = desired if ticks >= self.rollout_ticks else 0
+            if self.create_pods:
+                available, updated = self._reconcile_ds_pods(ds, matching)
+            else:
+                available = desired if ticks >= self.rollout_ticks else 0
+                updated = desired if ticks >= self.rollout_ticks else available
             status = {
                 "observedGeneration": ds["metadata"].get("generation", 1),
                 "desiredNumberScheduled": desired,
                 "currentNumberScheduled": available,
                 "numberReady": available,
                 "numberAvailable": available,
-                "updatedNumberScheduled": desired if ticks >= self.rollout_ticks else available,
+                "updatedNumberScheduled": updated,
             }
             if ds.get("status") != status:
                 ds["status"] = status
@@ -82,6 +91,74 @@ class KubeletSimulator:
             if available and self._is_device_plugin(ds):
                 for node in matching:
                     self._register_tpus(node)
+
+    def _reconcile_ds_pods(self, ds: dict, matching_nodes: list) -> tuple:
+        """DS-controller + kubelet roles for one DaemonSet; returns
+        (available, updated) counts derived from actual pods."""
+        from ..client.errors import AlreadyExistsError, NotFoundError
+
+        ds_name = ds["metadata"]["name"]
+        template = deep_get(ds, "spec", "template", default={})
+        strategy = deep_get(ds, "spec", "updateStrategy", "type", default="RollingUpdate")
+        want_containers = deep_get(template, "spec", "containers", default=[])
+        existing = {deep_get(p, "spec", "nodeName"): p
+                    for p in self.client.list(
+                        "v1", "Pod", self.namespace,
+                        label_selector={"tpu.ai/kubelet-sim-ds": ds_name})}
+        node_names = {n["metadata"]["name"] for n in matching_nodes}
+
+        # scale down: pods on nodes no longer matching
+        for node_name, pod in list(existing.items()):
+            if node_name not in node_names:
+                try:
+                    self.client.delete("v1", "Pod", pod["metadata"]["name"], self.namespace)
+                except NotFoundError:
+                    pass
+                del existing[node_name]
+
+        available = updated = 0
+        for node_name in sorted(node_names):
+            pod = existing.get(node_name)
+            if pod is not None:
+                pod_containers = deep_get(pod, "spec", "containers", default=[])
+                is_current = [
+                    {"image": c.get("image"), "args": c.get("args")} for c in pod_containers
+                ] == [
+                    {"image": c.get("image"), "args": c.get("args")} for c in want_containers
+                ]
+                if not is_current and strategy == "RollingUpdate":
+                    try:
+                        self.client.delete("v1", "Pod", pod["metadata"]["name"], self.namespace)
+                    except NotFoundError:
+                        pass
+                    pod = None
+                else:
+                    available += 1
+                    if is_current:
+                        updated += 1
+            if pod is None:
+                labels = dict(deep_get(template, "metadata", "labels", default={}) or {})
+                labels["tpu.ai/kubelet-sim-ds"] = ds_name
+                new_pod = {
+                    "apiVersion": "v1", "kind": "Pod",
+                    "metadata": {
+                        "name": f"{ds_name}-{node_name}"[:63].rstrip("-"),
+                        "namespace": self.namespace,
+                        "labels": labels,
+                        "ownerReferences": [{
+                            "apiVersion": "apps/v1", "kind": "DaemonSet",
+                            "name": ds_name, "uid": ds["metadata"].get("uid", "")}],
+                    },
+                    "spec": {"nodeName": node_name,
+                             "containers": copy.deepcopy(want_containers)},
+                    "status": {"phase": "Running",
+                               "conditions": [{"type": "Ready", "status": "True"}]},
+                }
+                try:
+                    self.client.create(new_pod)
+                except AlreadyExistsError:
+                    pass
+        return available, updated
 
     def _complete_validation_pods(self) -> None:
         """Pinned validation pods (workload + multihost rendezvous) run to

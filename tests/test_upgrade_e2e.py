@@ -1,0 +1,102 @@
+"""Rolling driver upgrade end-to-end: operator + upgrade controller + a
+pod-creating kubelet simulator. A ClusterPolicy driver-version bump rolls
+every node through cordon -> pod restart -> validation -> uncordon with the
+OnDelete DS strategy (the upgrade machine, not the DS controller, orders the
+rollout)."""
+
+import time
+
+import pytest
+
+from tpu_operator import consts
+from tpu_operator.api.clusterpolicy import new_cluster_policy
+from tpu_operator.client import FakeClient
+from tpu_operator.controllers.clusterpolicy_controller import (
+    ClusterPolicyReconciler,
+    setup_clusterpolicy_controller,
+)
+from tpu_operator.controllers.upgrade_controller import (
+    UpgradeReconciler,
+    setup_upgrade_controller,
+)
+from tpu_operator.testing.kubelet import KubeletSimulator
+from tpu_operator.upgrade.machine import DONE, UNKNOWN
+from tpu_operator.upgrade import node_upgrade_state
+from tpu_operator.utils import deep_get
+
+TPU_LABELS = {consts.GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice"}
+
+
+@pytest.fixture(autouse=True)
+def default_images(monkeypatch):
+    for env in ("DRIVER_IMAGE", "VALIDATOR_IMAGE", "FEATURE_DISCOVERY_IMAGE",
+                "TELEMETRY_EXPORTER_IMAGE", "SLICE_PARTITIONER_IMAGE"):
+        monkeypatch.setenv(env, "gcr.io/tpu/tpu-validator:0.1.0")
+    monkeypatch.setenv("DEVICE_PLUGIN_IMAGE", "gcr.io/tpu/device-plugin:0.1.0")
+
+
+def wait_for(predicate, timeout=30.0, interval=0.05, message="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {message}")
+
+
+def driver_pod_images(client):
+    return {deep_get(p, "spec", "nodeName"): p["spec"]["containers"][0]["image"]
+            for p in client.list(
+                "v1", "Pod", "tpu-operator",
+                label_selector={"app.kubernetes.io/component": "tpu-driver"})}
+
+
+def test_rolling_upgrade_end_to_end():
+    client = FakeClient()
+    for i in range(2):
+        client.create({"apiVersion": "v1", "kind": "Node",
+                       "metadata": {"name": f"tpu-{i}", "labels": dict(TPU_LABELS)},
+                       "spec": {}, "status": {}})
+    client.create(new_cluster_policy(spec={
+        "driver": {"repository": "gcr.io/tpu", "image": "tpu-validator",
+                   "version": "1.0",
+                   "upgradePolicy": {"autoUpgrade": True, "maxParallelUpgrades": 1}},
+    }))
+
+    cp = setup_clusterpolicy_controller(
+        client, ClusterPolicyReconciler(client, requeue_after=0.1))
+    up = setup_upgrade_controller(
+        client, UpgradeReconciler(client, requeue_after=0.1))
+    kubelet = KubeletSimulator(client, interval=0.03, create_pods=True).start()
+    cp.start(client)
+    up.start(client)
+    from tpu_operator.controllers.runtime import Request
+    cp.queue.add(Request(name="cluster-policy"))
+    try:
+        wait_for(lambda: deep_get(
+            client.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy"),
+            "status", "state") == "ready", message="initial install ready")
+        assert set(driver_pod_images(client).values()) == {"gcr.io/tpu/tpu-validator:1.0"}
+        ds = client.get("apps/v1", "DaemonSet", "libtpu-driver", "tpu-operator")
+        assert ds["spec"]["updateStrategy"]["type"] == "OnDelete"
+
+        # bump the driver version -> upgrade machine takes over
+        live = client.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy")
+        live["spec"]["driver"]["version"] = "2.0"
+        client.update(live)
+
+        wait_for(lambda: set(driver_pod_images(client).values())
+                 == {"gcr.io/tpu/tpu-validator:2.0"},
+                 timeout=60, message="all driver pods rolled to 2.0")
+        # upgrade completed cleanly: labels cleared, nodes schedulable
+        wait_for(lambda: all(
+            node_upgrade_state(n) in (UNKNOWN, DONE) and not n["spec"].get("unschedulable")
+            for n in client.list("v1", "Node")),
+            timeout=60, message="nodes uncordoned + labels settled")
+        wait_for(lambda: deep_get(
+            client.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy"),
+            "status", "state") == "ready", message="ready after upgrade")
+    finally:
+        cp.stop()
+        up.stop()
+        kubelet.stop()
