@@ -140,6 +140,7 @@ class TPUDevicePlugin:
 
     def Allocate(self, request, context):
         responses = []
+        use_cdi = os.environ.get("TPU_USE_CDI") == "1"
         for creq in request.container_requests:
             units = []
             with self._lock:
@@ -150,6 +151,14 @@ class TPUDevicePlugin:
                                       f"unknown device {device_id}")
                     units.append(unit)
             chips = sorted(c for u in units for c in u.chips)
+            if use_cdi:
+                # CDI mode: the runtime injects devices/mounts from the spec
+                # written by the driver state (validator/cdi.py)
+                from ..validator.cdi import qualified_name
+
+                responses.append(pb.ContainerAllocateResponse(cdi_devices=[
+                    pb.CDIDevice(name=qualified_name(c)) for c in chips]))
+                continue
             dev_nodes = discover_devices()
             devices = [pb.DeviceSpec(container_path=d, host_path=d, permissions="rw")
                        for d in dev_nodes]

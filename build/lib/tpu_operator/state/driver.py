@@ -69,6 +69,7 @@ class StateDriver:
             "node_selector": o.node_selector or {},
             "node_affinity": o.node_affinity,
             "extra_labels": o.extra_labels or {},
+            "cdi_enabled": policy.spec.cdi.enabled,
             "daemonsets": {
                 # autoUpgrade hands rollout ordering to the upgrade state
                 # machine: the DS must not replace pods on its own (OnDelete),

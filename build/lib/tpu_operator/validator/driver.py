@@ -155,6 +155,10 @@ def daemon(install_dir: str, libtpu_version: Optional[str] = None,
     status = status or StatusFiles()
     if not install(install_dir, libtpu_version, status):
         return 1
+    if os.environ.get("TPU_CDI_ENABLED") == "1":
+        from . import cdi
+
+        cdi.run(install_dir, os.environ.get("TPU_CDI_DIR", cdi.DEFAULT_CDI_DIR))
     beats = 0
     while max_beats is None or beats < max_beats:
         time.sleep(heartbeat_interval)

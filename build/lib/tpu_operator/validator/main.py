@@ -41,7 +41,8 @@ def build_parser() -> argparse.ArgumentParser:
                             "workload", "workload-local", "workload-multihost",
                             "wait", "sleep", "metrics", "telemetry",
                             "feature-discovery", "slice-partitioner",
-                            "device-plugin"])
+                            "device-plugin", "cdi"])
+    p.add_argument("--cdi-dir", default="/etc/cdi")
     p.add_argument("--install-dir", default=consts.DEFAULT_LIBTPU_DIR)
     p.add_argument("--libtpu-version", default=None)
     p.add_argument("--status-dir", default=os.environ.get("STATUS_DIR", consts.VALIDATION_STATUS_DIR))
@@ -166,6 +167,11 @@ def run(argv=None, client=None) -> int:
 
         client = client or make_client()
         return feature_discovery.run(client, sleep_interval=args.sleep_interval)
+
+    if component == "cdi":
+        from . import cdi
+
+        return cdi.run(install_dir=args.install_dir, cdi_dir=args.cdi_dir)
 
     if component == "device-plugin":
         from ..deviceplugin import TPUDevicePlugin

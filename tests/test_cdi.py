@@ -1,0 +1,86 @@
+import json
+
+import grpc
+import pytest
+
+from tpu_operator.validator import cdi
+from tpu_operator.validator.main import run as validator_run
+
+
+@pytest.fixture
+def fake_devs(tmp_path, monkeypatch):
+    devdir = tmp_path / "dev"
+    devdir.mkdir()
+    for i in range(4):
+        (devdir / f"accel{i}").touch()
+    monkeypatch.setenv("TPU_DEV_GLOBS", str(devdir / "accel*"))
+    return devdir
+
+
+def test_generate_spec(tmp_path, fake_devs):
+    install = tmp_path / "libtpu"
+    install.mkdir()
+    (install / "libtpu.so").write_bytes(b"\x7fELF" + b"\x00" * 8)
+    spec = cdi.generate_spec(str(install))
+    assert spec["cdiVersion"] == "0.6.0"
+    assert spec["kind"] == "google.com/tpu"
+    assert spec["containerEdits"]["mounts"][0]["hostPath"] == str(install)
+    names = [d["name"] for d in spec["devices"]]
+    assert names == ["tpu0", "tpu1", "tpu2", "tpu3", "all"]
+    assert spec["devices"][0]["containerEdits"]["env"] == ["TPU_VISIBLE_CHIPS=0"]
+    all_dev = spec["devices"][-1]
+    assert len(all_dev["containerEdits"]["deviceNodes"]) == 4
+    assert all_dev["containerEdits"]["env"] == ["TPU_VISIBLE_CHIPS=0,1,2,3"]
+
+
+def test_cli_writes_spec(tmp_path, fake_devs):
+    install = tmp_path / "libtpu"
+    install.mkdir()
+    cdi_dir = tmp_path / "cdi"
+    rc = validator_run(["-c", "cdi", f"--install-dir={install}", f"--cdi-dir={cdi_dir}"])
+    assert rc == 0
+    with open(cdi_dir / "google.com-tpu.json") as f:
+        spec = json.load(f)
+    assert len(spec["devices"]) == 5
+
+
+def test_cli_fails_without_devices(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPU_DEV_GLOBS", str(tmp_path / "none*"))
+    assert validator_run(["-c", "cdi", f"--cdi-dir={tmp_path / 'cdi'}"]) == 1
+
+
+def test_driver_state_renders_cdi_wiring(fake_client, monkeypatch):
+    monkeypatch.setenv("DRIVER_IMAGE", "img:1")
+    monkeypatch.setenv("VALIDATOR_IMAGE", "img:1")
+    from tpu_operator.api.clusterpolicy import ClusterPolicy, new_cluster_policy
+    from tpu_operator.state.driver import StateDriver
+
+    policy = ClusterPolicy.from_obj(new_cluster_policy(spec={"cdi": {"enabled": True}}))
+    objs = StateDriver(fake_client).render_objects(policy, "tpu-operator")
+    ds = [o for o in objs if o["kind"] == "DaemonSet"][0]
+    ctr = ds["spec"]["template"]["spec"]["containers"][0]
+    assert {"name": "TPU_CDI_ENABLED", "value": "1"} in ctr["env"]
+    assert any(m["mountPath"] == "/etc/cdi" for m in ctr["volumeMounts"])
+    assert any(v.get("hostPath", {}).get("path") == "/etc/cdi"
+               for v in ds["spec"]["template"]["spec"]["volumes"])
+
+
+def test_device_plugin_cdi_allocate(tmp_path, fake_devs, monkeypatch):
+    from tpu_operator.deviceplugin import TPUDevicePlugin, grpc_api
+    from tpu_operator.deviceplugin.proto import deviceplugin_pb2 as pb
+
+    monkeypatch.setenv("TPU_USE_CDI", "1")
+    plugin = TPUDevicePlugin(plugin_dir=str(tmp_path / "kubelet"),
+                             handoff_dir=str(tmp_path / "handoff"))
+    socket_path = plugin.start()
+    try:
+        with grpc.insecure_channel(f"unix://{socket_path}") as ch:
+            stub = grpc_api.DevicePluginStub(ch)
+            resp = stub.Allocate(pb.AllocateRequest(container_requests=[
+                pb.ContainerAllocateRequest(devicesIDs=["tpu-0", "tpu-2"])]))
+        c = resp.container_responses[0]
+        assert [d.name for d in c.cdi_devices] == ["google.com/tpu=tpu0",
+                                                   "google.com/tpu=tpu2"]
+        assert list(c.devices) == []  # runtime injects via CDI, not raw specs
+    finally:
+        plugin.stop()

@@ -1,0 +1,92 @@
+"""CDI (Container Device Interface) spec generation for TPU devices.
+
+The reference's CDI mode has nvidia-container-toolkit generate specs for GPU
+devices; on TPU the spec is simple enough to generate directly: every chip's
+device node plus the libtpu mount and visibility env. Runtimes with CDI
+support (containerd >= 1.7, cri-o) can then inject TPUs without any device
+plugin involvement, and the device plugin's Allocate can reference CDI device
+names instead of raw device specs (ClusterPolicy spec.cdi).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import List, Optional
+
+from .. import consts
+from .driver import discover_devices, libtpu_path
+
+log = logging.getLogger(__name__)
+
+CDI_VERSION = "0.6.0"
+CDI_KIND = "google.com/tpu"
+DEFAULT_CDI_DIR = "/etc/cdi"
+SPEC_FILENAME = "google.com-tpu.json"
+
+
+def device_name(index: int) -> str:
+    return f"tpu{index}"
+
+
+def qualified_name(index: int) -> str:
+    return f"{CDI_KIND}={device_name(index)}"
+
+
+def generate_spec(install_dir: str = consts.DEFAULT_LIBTPU_DIR,
+                  dev_nodes: Optional[List[str]] = None) -> dict:
+    nodes = dev_nodes if dev_nodes is not None else discover_devices()
+    libtpu = libtpu_path(install_dir)
+    common_edits: dict = {}
+    if os.path.exists(libtpu):
+        common_edits["mounts"] = [{
+            "hostPath": install_dir,
+            "containerPath": install_dir,
+            "options": ["ro", "rbind"],
+        }]
+    devices = []
+    for i, node in enumerate(nodes):
+        devices.append({
+            "name": device_name(i),
+            "containerEdits": {
+                "deviceNodes": [{"path": node, "permissions": "rw"}],
+                "env": [f"TPU_VISIBLE_CHIPS={i}"],
+            },
+        })
+    # composite device: every chip on the host in one grant
+    if devices:
+        devices.append({
+            "name": "all",
+            "containerEdits": {
+                "deviceNodes": [{"path": n, "permissions": "rw"} for n in nodes],
+                "env": ["TPU_VISIBLE_CHIPS=" + ",".join(str(i) for i in range(len(nodes)))],
+            },
+        })
+    return {
+        "cdiVersion": CDI_VERSION,
+        "kind": CDI_KIND,
+        "containerEdits": common_edits,
+        "devices": devices,
+    }
+
+
+def write_spec(spec: dict, cdi_dir: str = DEFAULT_CDI_DIR) -> str:
+    os.makedirs(cdi_dir, exist_ok=True)
+    path = os.path.join(cdi_dir, SPEC_FILENAME)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(spec, f, indent=2)
+    os.replace(tmp, path)  # runtimes re-scan /etc/cdi; never expose torn JSON
+    return path
+
+
+def run(install_dir: str = consts.DEFAULT_LIBTPU_DIR,
+        cdi_dir: str = DEFAULT_CDI_DIR) -> int:
+    spec = generate_spec(install_dir)
+    if not spec["devices"]:
+        log.error("cdi: no TPU device nodes found; not writing a spec")
+        return 1
+    path = write_spec(spec, cdi_dir)
+    log.info("cdi: wrote %s with %d device(s)", path, len(spec["devices"]) - 1)
+    return 0
