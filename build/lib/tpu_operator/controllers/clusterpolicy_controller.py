@@ -15,7 +15,7 @@ import os
 import time
 from typing import List, Optional
 
-from .. import consts
+from .. import consts, events
 from ..api.clusterpolicy import ClusterPolicy, State
 from ..client.errors import ConflictError, NotFoundError
 from ..client.interface import Client, WatchEvent
@@ -111,8 +111,12 @@ class ClusterPolicyReconciler(Reconciler):
         catalog[INFO_NODES] = label_result.nodes
 
         results = self.state_manager.sync_state(catalog)
+        previous_state = deep_get(policy.obj, "status", "state")
 
         if results.ready:
+            if previous_state != State.READY:
+                events.record(self.client, self.namespace, policy.obj,
+                              events.NORMAL, "Ready", "all operand states are ready")
             policy.set_state(State.READY, self.namespace)
             mark_ready(policy.obj)
             self._write_status(policy.obj)  # state + conditions atomically
@@ -129,6 +133,9 @@ class ClusterPolicyReconciler(Reconciler):
         message = f"state {blocker.state_name} is {blocker.status.value}" if blocker else "not ready"
         if blocker and blocker.message:
             message += f": {blocker.message}"
+        if blocker and blocker.status.value == "error":
+            events.record(self.client, self.namespace, policy.obj,
+                          events.WARNING, reason, message)
         mark_error(policy.obj, reason, message)
         self._write_status(policy.obj)  # state + conditions atomically
         self.metrics.reconciliation_status.set(0)

@@ -47,8 +47,28 @@ def serve_health_and_metrics(metrics: OperatorMetrics, metrics_port: int,
             pass
 
         def do_GET(self):
+            path = self.path.rstrip("/")
+            if path == "/debug/threads":
+                # pprof-style goroutine-dump analog for the threaded runtime
+                import sys
+                import traceback
+
+                frames = sys._current_frames()
+                lines = []
+                for thread in threading.enumerate():
+                    frame = frames.get(thread.ident)
+                    lines.append(f"--- {thread.name} (daemon={thread.daemon}) ---")
+                    if frame is not None:
+                        lines.extend(l.rstrip() for l in traceback.format_stack(frame))
+                body = "\n".join(lines).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
             body = json.dumps({"status": "ok", "version": __version__}).encode()
-            code = 200 if self.path.rstrip("/") in ("/healthz", "/readyz") else 404
+            code = 200 if path in ("/healthz", "/readyz") else 404
             self.send_response(code)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()

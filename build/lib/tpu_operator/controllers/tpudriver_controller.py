@@ -15,7 +15,7 @@ import logging
 import os
 from typing import Dict, List, Optional
 
-from .. import consts
+from .. import consts, events
 from ..api.clusterpolicy import ClusterPolicy, State
 from ..api.tpudriver import TPUDriver
 from ..client.errors import ConflictError, NotFoundError
@@ -112,8 +112,10 @@ class TPUDriverReconciler(Reconciler):
         mine_conflicted = {n for n, owners in conflicts.items() if driver.name in owners}
         if mine_conflicted:
             driver.status["state"] = State.NOT_READY
-            mark_error(driver.obj, REASON_CONFLICTING_NODE_SELECTOR,
-                       f"nodes claimed by multiple TPUDrivers: {sorted(mine_conflicted)}")
+            message = f"nodes claimed by multiple TPUDrivers: {sorted(mine_conflicted)}"
+            events.record(self.client, self.namespace, driver.obj,
+                          events.WARNING, REASON_CONFLICTING_NODE_SELECTOR, message)
+            mark_error(driver.obj, REASON_CONFLICTING_NODE_SELECTOR, message)
             self._write_status(driver.obj)
             return Result(requeue_after=self.requeue_after)
 

@@ -1,0 +1,55 @@
+"""Kubernetes Event recording (client-go EventRecorder analog).
+
+Events give ``kubectl describe clusterpolicy`` the operational story
+(operand failures, upgrade failures, selector conflicts) without log
+spelunking. Best-effort: event write failures never break a reconcile.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+import uuid
+from typing import Optional
+
+from .client.errors import ApiError
+from .client.interface import Client
+
+log = logging.getLogger(__name__)
+
+NORMAL = "Normal"
+WARNING = "Warning"
+
+
+def record(client: Client, namespace: str, involved: dict,
+           type_: str, reason: str, message: str,
+           component: str = "tpu-operator") -> Optional[dict]:
+    meta = involved.get("metadata", {})
+    now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    event = {
+        "apiVersion": "v1",
+        "kind": "Event",
+        "metadata": {
+            "name": f"{meta.get('name', 'unknown')}.{uuid.uuid4().hex[:12]}"[:63],
+            "namespace": namespace,
+        },
+        "involvedObject": {
+            "apiVersion": involved.get("apiVersion"),
+            "kind": involved.get("kind"),
+            "name": meta.get("name"),
+            "namespace": meta.get("namespace", ""),
+            "uid": meta.get("uid", ""),
+        },
+        "type": type_,
+        "reason": reason,
+        "message": message[:1024],
+        "source": {"component": component},
+        "firstTimestamp": now,
+        "lastTimestamp": now,
+        "count": 1,
+    }
+    try:
+        return client.create(event)
+    except ApiError as e:
+        log.debug("event write failed (%s %s): %s", reason, meta.get("name"), e)
+        return None

@@ -240,6 +240,11 @@ class UpgradeStateMachine:
             if not fresh:
                 return state  # restart pending
             if any(deep_get(p, "status", "phase") == "Failed" for p in fresh):
+                from .. import events
+
+                events.record(self.client, self.namespace, node, events.WARNING,
+                              "DriverUpgradeFailed",
+                              f"driver pod entered Failed during upgrade on {name}")
                 self._set_state(node, FAILED)
                 return FAILED
             from ..state.skel import is_pod_ready

@@ -1,0 +1,53 @@
+from tpu_operator import consts, events
+from tpu_operator.api.clusterpolicy import new_cluster_policy
+from tpu_operator.api.tpudriver import new_tpu_driver
+from tpu_operator.controllers.runtime import Request
+from tpu_operator.controllers.tpudriver_controller import TPUDriverReconciler
+from tpu_operator.testing.kubelet import KubeletSimulator
+
+
+def test_record_event(fake_client):
+    cp = fake_client.create(new_cluster_policy())
+    ev = events.record(fake_client, "tpu-operator", cp,
+                       events.WARNING, "TestReason", "something happened")
+    assert ev is not None
+    stored = fake_client.list("v1", "Event", "tpu-operator")
+    assert len(stored) == 1
+    assert stored[0]["reason"] == "TestReason"
+    assert stored[0]["involvedObject"]["kind"] == "ClusterPolicy"
+    assert stored[0]["involvedObject"]["uid"] == cp["metadata"]["uid"]
+
+
+def test_ready_transition_emits_single_event(fake_client, monkeypatch):
+    for env in ("DRIVER_IMAGE", "VALIDATOR_IMAGE", "FEATURE_DISCOVERY_IMAGE",
+                "TELEMETRY_EXPORTER_IMAGE", "SLICE_PARTITIONER_IMAGE"):
+        monkeypatch.setenv(env, "img:1")
+    monkeypatch.setenv("DEVICE_PLUGIN_IMAGE", "img:1")
+    from tpu_operator.controllers.clusterpolicy_controller import ClusterPolicyReconciler
+
+    fake_client.create(new_cluster_policy())
+    fake_client.create({"apiVersion": "v1", "kind": "Node",
+                        "metadata": {"name": "tpu-1", "labels": {
+                            consts.GKE_TPU_ACCELERATOR_LABEL: "x"}}, "status": {}})
+    r = ClusterPolicyReconciler(fake_client)
+    r.reconcile(Request("cluster-policy"))        # notReady: no event
+    KubeletSimulator(fake_client).tick()
+    r.reconcile(Request("cluster-policy"))        # -> ready: one event
+    r.reconcile(Request("cluster-policy"))        # still ready: no new event
+    ready_events = [e for e in fake_client.list("v1", "Event", "tpu-operator")
+                    if e["reason"] == "Ready"]
+    assert len(ready_events) == 1
+
+
+def test_conflict_emits_warning_event(fake_client, monkeypatch):
+    monkeypatch.setenv("DRIVER_IMAGE", "img:1")
+    fake_client.create(new_cluster_policy())
+    fake_client.create({"apiVersion": "v1", "kind": "Node",
+                        "metadata": {"name": "n1", "labels": {
+                            consts.TPU_PRESENT_LABEL: "true"}}, "status": {}})
+    fake_client.create(new_tpu_driver("one", {"image": "img"}))
+    fake_client.create(new_tpu_driver("two", {"image": "img"}))
+    TPUDriverReconciler(fake_client).reconcile(Request("one"))
+    warnings = [e for e in fake_client.list("v1", "Event", "tpu-operator")
+                if e["type"] == "Warning"]
+    assert warnings and warnings[0]["reason"] == "ConflictingNodeSelector"
