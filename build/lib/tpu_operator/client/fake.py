@@ -13,11 +13,10 @@ from __future__ import annotations
 import copy
 import queue
 import threading
-import time
 import uuid
 from typing import Callable, Dict, List, Optional, Tuple
 
-from ..utils.objects import deep_get, json_merge_patch
+from ..utils.objects import deep_get, json_merge_patch, rfc3339_now
 from .errors import AlreadyExistsError, ConflictError, NotFoundError
 from .interface import Client, WatchEvent, WatchHandle
 from .scheme import Scheme, default_scheme
@@ -146,7 +145,7 @@ class FakeClient(Client):
             if key in self._store:
                 raise AlreadyExistsError(f"{obj['kind']} {meta['name']} already exists")
             meta.setdefault("uid", str(uuid.uuid4()))
-            meta.setdefault("creationTimestamp", time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
+            meta.setdefault("creationTimestamp", rfc3339_now())
             meta["resourceVersion"] = self._next_rv()
             meta.setdefault("generation", 1)
             self._store[key] = obj

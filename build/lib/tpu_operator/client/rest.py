@@ -224,6 +224,10 @@ class _RestWatch(WatchHandle):
                         obj.setdefault("apiVersion", self._api_version)
                         obj.setdefault("kind", self._kind)
                         self._emit(WatchEvent(type=etype, object=obj))
+                # clean stream end: the server may not support resuming from
+                # our resourceVersion, and anything changed in the reconnect
+                # gap would be lost — re-LIST so consumers see current state
+                rv = ""
             except (requests.RequestException, json.JSONDecodeError, ValueError):
                 self._stopped.wait(2.0)
                 rv = ""

@@ -7,11 +7,11 @@ additions.
 
 from __future__ import annotations
 
-import time
 from typing import List, Optional
 
 from ..client.errors import ConflictError, NotFoundError
 from ..client.interface import Client
+from ..utils import deep_get, rfc3339_now
 
 READY = "Ready"
 ERROR = "Error"
@@ -26,18 +26,24 @@ REASON_CONFLICTING_NODE_SELECTOR = "ConflictingNodeSelector"
 REASON_DRIVER_NOT_READY = "DriverNotReady"
 
 
-def _now() -> str:
-    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
-
-
 def make_condition(type_: str, status: str, reason: str, message: str = "") -> dict:
     return {
         "type": type_,
         "status": status,
         "reason": reason,
         "message": message,
-        "lastTransitionTime": _now(),
+        "lastTransitionTime": rfc3339_now(),
     }
+
+
+def is_new_error(obj: dict, reason: str, message: str) -> bool:
+    """True when (reason, message) differs from the object's current
+    Error=True condition — the gate for emitting a Warning Event exactly once
+    per distinct failure instead of on every requeue/resync sweep."""
+    for c in deep_get(obj, "status", "conditions", default=[]) or []:
+        if c.get("type") == ERROR and c.get("status") == "True":
+            return c.get("reason") != reason or c.get("message") != message
+    return True
 
 
 def set_condition(conditions: List[dict], new: dict) -> List[dict]:

@@ -22,6 +22,7 @@ from ..client.interface import Client, WatchEvent
 from ..conditions import (
     REASON_OPERAND_NOT_READY,
     REASON_RECONCILE_FAILED,
+    is_new_error,
     mark_error,
     mark_ready,
 )
@@ -133,7 +134,10 @@ class ClusterPolicyReconciler(Reconciler):
         message = f"state {blocker.state_name} is {blocker.status.value}" if blocker else "not ready"
         if blocker and blocker.message:
             message += f": {blocker.message}"
-        if blocker and blocker.status.value == "error":
+        if (blocker and blocker.status.value == "error"
+                and is_new_error(policy.obj, reason, message)):
+            # gate on transition: the 5s requeue + resync would otherwise
+            # mint a fresh Event object for the same failure every sweep
             events.record(self.client, self.namespace, policy.obj,
                           events.WARNING, reason, message)
         mark_error(policy.obj, reason, message)
@@ -186,4 +190,5 @@ def setup_clusterpolicy_controller(client: Client,
     controller.watches("apps/v1", "DaemonSet", map_owned)
     controller.watches("tpu.ai/v1alpha1", "TPUDriver", map_tpudriver)
     controller.watches("v1", "Pod", map_validation_pod)
+    controller.resyncs(lambda: _all_policy_requests(client), period=10.0)
     return controller

@@ -122,6 +122,9 @@ class Controller:
         self.watch_specs: List[_WatchSpec] = []
         self._handles: list = []
         self._thread: Optional[threading.Thread] = None
+        self._resync_fn: Optional[Callable[[], List[Request]]] = None
+        self._resync_period: float = 0.0
+        self._stop_event = threading.Event()
 
     def watches(self, api_version: str, kind: str,
                 mapper: Callable[[WatchEvent], List[Request]],
@@ -129,7 +132,17 @@ class Controller:
         self.watch_specs.append(_WatchSpec(api_version, kind, namespace, mapper))
         return self
 
+    def resyncs(self, fn: Callable[[], List[Request]],
+                period: float = 30.0) -> "Controller":
+        """Informer-style periodic resync: a level-driven controller must
+        converge even if a watch event is lost (stream reconnect gap, mapper
+        error), so re-enqueue everything every ``period`` seconds."""
+        self._resync_fn = fn
+        self._resync_period = period
+        return self
+
     def start(self, client: Client) -> None:
+        self._stop_event.clear()
         for spec in self.watch_specs:
             def handler(event: WatchEvent, _spec=spec) -> None:
                 try:
@@ -141,6 +154,17 @@ class Controller:
         self._thread = threading.Thread(target=self._worker, daemon=True,
                                         name=f"{self.reconciler.name}-worker")
         self._thread.start()
+        if self._resync_fn is not None and self._resync_period > 0:
+            threading.Thread(target=self._resync_loop, daemon=True,
+                             name=f"{self.reconciler.name}-resync").start()
+
+    def _resync_loop(self) -> None:
+        while not self._stop_event.wait(self._resync_period):
+            try:
+                for request in self._resync_fn():
+                    self.queue.add(request)
+            except Exception:
+                log.exception("%s: resync failed", self.reconciler.name)
 
     def _worker(self) -> None:
         while True:
@@ -158,6 +182,7 @@ class Controller:
                 self.queue.add(request, result.requeue_after)
 
     def stop(self) -> None:
+        self._stop_event.set()
         for h in self._handles:
             h.stop()
         self.queue.shutdown()

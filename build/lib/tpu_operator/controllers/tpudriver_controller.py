@@ -23,6 +23,7 @@ from ..client.interface import Client, WatchEvent
 from ..conditions import (
     REASON_CONFLICTING_NODE_SELECTOR,
     REASON_RECONCILE_FAILED,
+    is_new_error,
     mark_error,
     mark_ready,
 )
@@ -113,8 +114,10 @@ class TPUDriverReconciler(Reconciler):
         if mine_conflicted:
             driver.status["state"] = State.NOT_READY
             message = f"nodes claimed by multiple TPUDrivers: {sorted(mine_conflicted)}"
-            events.record(self.client, self.namespace, driver.obj,
-                          events.WARNING, REASON_CONFLICTING_NODE_SELECTOR, message)
+            if is_new_error(driver.obj, REASON_CONFLICTING_NODE_SELECTOR, message):
+                # once per distinct conflict, not per requeue/resync sweep
+                events.record(self.client, self.namespace, driver.obj,
+                              events.WARNING, REASON_CONFLICTING_NODE_SELECTOR, message)
             mark_error(driver.obj, REASON_CONFLICTING_NODE_SELECTOR, message)
             self._write_status(driver.obj)
             return Result(requeue_after=self.requeue_after)
@@ -187,4 +190,5 @@ def setup_tpudriver_controller(client: Client, reconciler: TPUDriverReconciler) 
     controller.watches("tpu.ai/v1alpha1", "TPUDriver", map_instance)
     controller.watches("v1", "Node", all_instances)
     controller.watches("apps/v1", "DaemonSet", map_owned)
+    controller.resyncs(lambda: all_instances(None), period=10.0)
     return controller

@@ -86,4 +86,5 @@ def setup_upgrade_controller(client: Client, reconciler: UpgradeReconciler) -> C
     controller.watches("tpu.ai/v1", "ClusterPolicy", singleton)
     controller.watches("v1", "Node", singleton)
     controller.watches("v1", "Pod", map_pod)
+    controller.resyncs(lambda: [SINGLETON_REQUEST], period=30.0)
     return controller

@@ -8,12 +8,11 @@ spelunking. Best-effort: event write failures never break a reconcile.
 from __future__ import annotations
 
 import logging
-import time
 import uuid
 from typing import Optional
 
-from .client.errors import ApiError
 from .client.interface import Client
+from .utils import rfc3339_now
 
 log = logging.getLogger(__name__)
 
@@ -25,12 +24,14 @@ def record(client: Client, namespace: str, involved: dict,
            type_: str, reason: str, message: str,
            component: str = "tpu-operator") -> Optional[dict]:
     meta = involved.get("metadata", {})
-    now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    now = rfc3339_now()
+    # truncate the object-name part, never the uniquifying suffix
+    name = f"{meta.get('name', 'unknown')[:50]}.{uuid.uuid4().hex[:12]}"
     event = {
         "apiVersion": "v1",
         "kind": "Event",
         "metadata": {
-            "name": f"{meta.get('name', 'unknown')}.{uuid.uuid4().hex[:12]}"[:63],
+            "name": name,
             "namespace": namespace,
         },
         "involvedObject": {
@@ -50,6 +51,6 @@ def record(client: Client, namespace: str, involved: dict,
     }
     try:
         return client.create(event)
-    except ApiError as e:
+    except Exception as e:  # ApiError or transport failure — both best-effort
         log.debug("event write failed (%s %s): %s", reason, meta.get("name"), e)
         return None

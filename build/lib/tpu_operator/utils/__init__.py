@@ -6,6 +6,7 @@ from .objects import (
     json_merge_patch,
     obj_key,
     parse_quantity,
+    rfc3339_now,
     same_object,
 )
 
@@ -18,5 +19,6 @@ __all__ = [
     "json_merge_patch",
     "obj_key",
     "parse_quantity",
+    "rfc3339_now",
     "same_object",
 ]

@@ -3,7 +3,14 @@
 from __future__ import annotations
 
 import re
+import time
 from typing import Any, Iterable, Mapping, Optional, Tuple
+
+
+def rfc3339_now() -> str:
+    """Current UTC time in the RFC3339 second-precision form k8s uses for
+    metav1.Time fields (Lease MicroTime is a different type — see leader.py)."""
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
 
 
 def deep_get(obj: Optional[Mapping], *path: str, default: Any = None) -> Any:

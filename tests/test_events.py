@@ -51,3 +51,46 @@ def test_conflict_emits_warning_event(fake_client, monkeypatch):
     warnings = [e for e in fake_client.list("v1", "Event", "tpu-operator")
                 if e["type"] == "Warning"]
     assert warnings and warnings[0]["reason"] == "ConflictingNodeSelector"
+
+
+def test_long_object_name_keeps_unique_suffix(fake_client):
+    """Event names must truncate the object-name part, never the uniquifying
+    suffix — otherwise every event for a long-named node collides."""
+    long_name = "gke-prod-cluster-tpu-v5e-pool-1-1a2b3c4d-" + "x" * 30
+    node = fake_client.create({"apiVersion": "v1", "kind": "Node",
+                               "metadata": {"name": long_name}, "status": {}})
+    ev1 = events.record(fake_client, "tpu-operator", node,
+                        events.WARNING, "DriverUpgradeFailed", "boom")
+    ev2 = events.record(fake_client, "tpu-operator", node,
+                        events.WARNING, "DriverUpgradeFailed", "boom again")
+    assert ev1 is not None and ev2 is not None
+    assert ev1["metadata"]["name"] != ev2["metadata"]["name"]
+    assert len(ev1["metadata"]["name"]) <= 63
+    assert len(fake_client.list("v1", "Event", "tpu-operator")) == 2
+
+
+def test_record_never_raises(fake_client):
+    """Best-effort contract: any failure (ApiError or transport) is swallowed."""
+    class ExplodingClient:
+        def create(self, obj):
+            raise ConnectionError("api server unreachable")
+
+    assert events.record(ExplodingClient(), "ns", {"metadata": {"name": "x"}},
+                         events.NORMAL, "R", "m") is None
+
+
+def test_persistent_conflict_emits_one_event_across_sweeps(fake_client, monkeypatch):
+    """A standing failure must not mint a new Event every requeue/resync."""
+    monkeypatch.setenv("DRIVER_IMAGE", "img:1")
+    fake_client.create(new_cluster_policy())
+    fake_client.create({"apiVersion": "v1", "kind": "Node",
+                        "metadata": {"name": "n1", "labels": {
+                            consts.TPU_PRESENT_LABEL: "true"}}, "status": {}})
+    fake_client.create(new_tpu_driver("one", {"image": "img"}))
+    fake_client.create(new_tpu_driver("two", {"image": "img"}))
+    r = TPUDriverReconciler(fake_client)
+    for _ in range(5):  # simulate requeue + resync sweeps
+        r.reconcile(Request("one"))
+    warnings = [e for e in fake_client.list("v1", "Event", "tpu-operator")
+                if e["reason"] == "ConflictingNodeSelector"]
+    assert len(warnings) == 1
