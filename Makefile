@@ -6,6 +6,10 @@ PYTHON ?= python3
 test:
 	$(PYTHON) -m pytest tests/ -q
 
+.PHONY: e2e
+e2e:
+	bash tests/scripts/end-to-end.sh
+
 .PHONY: bench
 bench:
 	$(PYTHON) bench.py

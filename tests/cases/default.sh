@@ -1,0 +1,25 @@
+# Case: default install sanity (reference tests/cases/defaults.sh analog).
+# Everything verify-operator.sh checked, plus object-ownership invariants:
+# operand DaemonSets carry the operator state label and an ownerReference to
+# the ClusterPolicy, and node state labels are in place.
+
+set -eu
+
+for ds in libtpu-driver tpu-device-plugin; do
+    kget "apis/apps/v1/namespaces/${NS}/daemonsets/${ds}" > /tmp/ds.json
+    state_label="$(jsonq 'obj["metadata"]["labels"].get("tpu.ai/operator.state", "")' < /tmp/ds.json)"
+    [ -n "${state_label}" ] || { echo "missing state label on ${ds}" >&2; exit 1; }
+    owner="$(jsonq 'obj["metadata"].get("ownerReferences", [{}])[0].get("kind", "")' < /tmp/ds.json)"
+    [ "${owner}" = "ClusterPolicy" ] || { echo "missing ClusterPolicy ownerRef on ${ds}" >&2; exit 1; }
+done
+echo "ok: state labels + ownerReferences"
+
+# every TPU node carries tpu.present + per-operand deploy state labels
+kget "api/v1/nodes" > /tmp/nodes.json
+n_present="$(jsonq 'sum(1 for n in obj["items"]
+    if n["metadata"].get("labels", {}).get("tpu.ai/tpu.present") == "true")' < /tmp/nodes.json)"
+[ "${n_present}" = "4" ] || { echo "expected 4 tpu.present nodes, got ${n_present}" >&2; exit 1; }
+n_deploy="$(jsonq 'sum(1 for n in obj["items"]
+    if n["metadata"].get("labels", {}).get("tpu.ai/tpu.deploy.device-plugin") == "true")' < /tmp/nodes.json)"
+[ "${n_deploy}" = "4" ] || { echo "expected 4 deploy-labeled nodes, got ${n_deploy}" >&2; exit 1; }
+echo "ok: node discovery labels"

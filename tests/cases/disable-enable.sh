@@ -1,0 +1,12 @@
+# Case: disabling an operand deletes its DaemonSet; re-enabling restores it
+# (reference tests/cases flow: disable/enable operands mid-run).
+
+set -eu
+
+kpatch "${CP_PATH}" '{"spec": {"telemetry": {"enabled": false}}}' >/dev/null
+wait_for "telemetry DS deleted when disabled" 30 ds_absent tpu-telemetry-exporter
+wait_for "ClusterPolicy ready with operand disabled" 60 cp_state_is ready
+
+kpatch "${CP_PATH}" '{"spec": {"telemetry": {"enabled": true}}}' >/dev/null
+wait_for "telemetry DS restored" 30 ds_ready tpu-telemetry-exporter
+wait_for "ClusterPolicy ready again" 60 cp_state_is ready

@@ -1,0 +1,16 @@
+# Case: operator restart resumes cleanly from cluster state
+# (reference tests flow: operator-restart test; SURVEY §5.4 checkpoint model —
+# all durable state lives in the API server, so a restart must reconcile
+# mutations that happened during downtime).
+
+set -eu
+
+stop_operator
+
+# mutate the cluster behind the operator's back: nuke an operand DS
+kdel "apis/apps/v1/namespaces/${NS}/daemonsets/tpu-feature-discovery" >/dev/null
+ds_absent tpu-feature-discovery || { echo "DS still present after delete" >&2; exit 1; }
+
+start_operator
+wait_for "feature-discovery DS recreated after restart" 60 ds_ready tpu-feature-discovery
+wait_for "ClusterPolicy ready after restart" 60 cp_state_is ready

@@ -1,0 +1,32 @@
+# Case: TPUDriver CRD path (reference tests/cases/nvidia-driver.sh analog):
+# creating a TPUDriver instance hands driver ownership over from the
+# ClusterPolicy state-driver to per-pool DaemonSets; deleting it hands back.
+
+set -eu
+REPO_ROOT="$(cd "$(dirname "$0")/../.." && pwd)"
+
+kpost "apis/tpu.ai/v1alpha1/tpudrivers" \
+    "$(yaml2json "${REPO_ROOT}/config/samples/v1alpha1_tpudriver.yaml")" >/dev/null
+
+pool_ds_name() {
+    kget "apis/apps/v1/namespaces/${NS}/daemonsets" | jsonq '
+next(d["metadata"]["name"] for d in obj["items"]
+     if d["metadata"]["name"].startswith("libtpu-driver-v5e-pool-"))'
+}
+wait_for "per-pool driver DS created" 30 pool_ds_name
+POOL_DS="$(pool_ds_name)"
+wait_for "per-pool driver DS ready" 60 ds_ready "${POOL_DS}"
+wait_for "ClusterPolicy driver DS handed over (deleted)" 30 ds_absent libtpu-driver
+
+tpudriver_ready() {
+    [ "$(kget "apis/tpu.ai/v1alpha1/tpudrivers/v5e-pool" \
+        | jsonq 'obj.get("status", {}).get("state")')" = "ready" ]
+}
+wait_for "TPUDriver status ready" 60 tpudriver_ready
+wait_for "ClusterPolicy still ready" 60 cp_state_is ready
+
+# hand back: delete the instance, ClusterPolicy driver DS returns
+kdel "apis/tpu.ai/v1alpha1/tpudrivers/v5e-pool" >/dev/null
+wait_for "per-pool DS cleaned up" 30 ds_absent "${POOL_DS}"
+wait_for "ClusterPolicy driver DS restored" 60 ds_ready libtpu-driver
+wait_for "ClusterPolicy ready after hand-back" 60 cp_state_is ready

@@ -1,0 +1,101 @@
+#!/usr/bin/env bash
+# Shell e2e orchestrator (reference tests/scripts/end-to-end.sh analog):
+# launch cluster harness -> launch the real operator binary -> install the
+# sample ClusterPolicy -> run every case under tests/cases/ -> uninstall.
+#
+# Usage: tests/scripts/end-to-end.sh [case ...]   (default: all cases)
+
+set -eu
+
+REPO_ROOT="$(cd "$(dirname "$0")/../.." && pwd)"
+SCRIPTS_DIR="${REPO_ROOT}/tests/scripts"
+CASES_DIR="${REPO_ROOT}/tests/cases"
+WORK_DIR="$(mktemp -d)"
+export PYTHONPATH="${REPO_ROOT}${PYTHONPATH:+:${PYTHONPATH}}"
+# Keep JAX off real accelerators: nothing here touches the data plane.
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+# Operand default images (env layer of the config system, SURVEY §5.6).
+export DRIVER_IMAGE="${DRIVER_IMAGE:-gcr.io/tpu/tpu-validator:0.1.0}"
+export VALIDATOR_IMAGE="${VALIDATOR_IMAGE:-gcr.io/tpu/tpu-validator:0.1.0}"
+export FEATURE_DISCOVERY_IMAGE="${FEATURE_DISCOVERY_IMAGE:-gcr.io/tpu/tpu-validator:0.1.0}"
+export TELEMETRY_EXPORTER_IMAGE="${TELEMETRY_EXPORTER_IMAGE:-gcr.io/tpu/tpu-validator:0.1.0}"
+export SLICE_PARTITIONER_IMAGE="${SLICE_PARTITIONER_IMAGE:-gcr.io/tpu/tpu-validator:0.1.0}"
+export DEVICE_PLUGIN_IMAGE="${DEVICE_PLUGIN_IMAGE:-gcr.io/tpu/device-plugin:0.1.0}"
+# free ephemeral ports so concurrent runs (or stray processes) never collide
+pick_port() { python3 -c 'import socket; s = socket.socket(); s.bind(("127.0.0.1", 0)); print(s.getsockname()[1]); s.close()'; }
+export METRICS_PORT="${METRICS_PORT:-$(pick_port)}"
+export HEALTH_PORT="${HEALTH_PORT:-$(pick_port)}"
+
+export WORK_DIR
+CLUSTER_PID=""
+
+cleanup() {
+    [ -f "${WORK_DIR}/operator.pid" ] && kill "$(cat "${WORK_DIR}/operator.pid")" 2>/dev/null || true
+    [ -n "${CLUSTER_PID}" ] && kill "${CLUSTER_PID}" 2>/dev/null || true
+    wait 2>/dev/null || true
+    rm -rf "${WORK_DIR}"
+}
+trap cleanup EXIT
+
+echo "=== launch cluster harness (4-node v5e pool simulator) ==="
+python3 -m tpu_operator.testing.cluster \
+    --url-file "${WORK_DIR}/cluster.url" --nodes 4 --create-pods \
+    >"${WORK_DIR}/cluster.log" 2>&1 &
+CLUSTER_PID=$!
+for _ in $(seq 1 100); do
+    [ -s "${WORK_DIR}/cluster.url" ] && break
+    sleep 0.1
+done
+[ -s "${WORK_DIR}/cluster.url" ] || { echo "cluster harness failed to start" >&2; exit 1; }
+export BASE="$(cat "${WORK_DIR}/cluster.url")"
+echo "cluster at ${BASE}"
+
+. "${SCRIPTS_DIR}/common.sh"
+
+# pidfile-based so cases (run in subshells) can restart the operator too
+start_operator() {
+    python3 -m tpu_operator.cmd.operator \
+        --api-server "${BASE}" --namespace "${NS}" \
+        --metrics-port "${METRICS_PORT}" --health-port "${HEALTH_PORT}" \
+        --log-level info >>"${WORK_DIR}/operator.log" 2>&1 &
+    echo $! > "${WORK_DIR}/operator.pid"
+}
+stop_operator() {
+    if [ -f "${WORK_DIR}/operator.pid" ]; then
+        kill "$(cat "${WORK_DIR}/operator.pid")" 2>/dev/null || true
+        while kill -0 "$(cat "${WORK_DIR}/operator.pid")" 2>/dev/null; do sleep 0.1; done
+        rm -f "${WORK_DIR}/operator.pid"
+    fi
+}
+export -f start_operator stop_operator
+
+echo "=== install operator ==="
+"${SCRIPTS_DIR}/install-operator.sh"
+start_operator
+
+echo "=== verify install ==="
+"${SCRIPTS_DIR}/verify-operator.sh"
+
+STATUS=0
+CASES="${*:-$(cd "${CASES_DIR}" && ls *.sh)}"
+for case_sh in ${CASES}; do
+    echo "=== case: ${case_sh} ==="
+    if ( . "${SCRIPTS_DIR}/common.sh"; . "${CASES_DIR}/${case_sh}" ); then
+        echo "=== PASS: ${case_sh} ==="
+    else
+        echo "=== FAIL: ${case_sh} ===" >&2
+        STATUS=1
+        break
+    fi
+done
+
+echo "=== uninstall ==="
+kdel "${CP_PATH}" >/dev/null || true
+stop_operator
+
+if [ "${STATUS}" -ne 0 ]; then
+    echo "--- operator log tail ---" >&2
+    tail -50 "${WORK_DIR}/operator.log" >&2 || true
+fi
+exit "${STATUS}"

@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Install step (reference tests/scripts/install-operator.sh analog): apply the
+# sample ClusterPolicy CR — the helm chart's clusterpolicy.yaml render — to
+# the cluster. The operator binary itself is launched by the orchestrator
+# (no real kubelet exists to run the Deployment from deploy/operator.yaml).
+
+set -eu
+REPO_ROOT="$(cd "$(dirname "$0")/../.." && pwd)"
+. "$(dirname "$0")/common.sh"
+
+kpost "apis/tpu.ai/v1/clusterpolicies" \
+    "$(yaml2json "${REPO_ROOT}/config/samples/v1_clusterpolicy.yaml")" >/dev/null
+echo "applied config/samples/v1_clusterpolicy.yaml"

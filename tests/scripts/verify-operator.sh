@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# Verify step (reference tests/scripts/verify-operator.sh:15-25 analog):
+# every enabled operand DaemonSet Ready, ClusterPolicy ready, all nodes
+# advertising google.com/tpu, operator metrics live.
+
+set -eu
+. "$(dirname "$0")/common.sh"
+
+for ds in libtpu-driver tpu-operator-validator tpu-device-plugin \
+          tpu-feature-discovery tpu-telemetry-exporter tpu-node-status-exporter; do
+    wait_for "daemonset ${ds} ready" 60 ds_ready "${ds}"
+done
+wait_for "ClusterPolicy state=ready" 60 cp_state_is ready
+wait_for "4 nodes schedulable (google.com/tpu capacity)" 60 nodes_schedulable 4
+wait_for "operator reconciliation metric" 30 \
+    operator_metric_nonzero tpu_operator_reconciliation_total
+curl -sf "http://127.0.0.1:${HEALTH_PORT}/healthz" >/dev/null && echo "ok: healthz"

@@ -1,0 +1,30 @@
+"""Run the shell e2e harness (tests/scripts/end-to-end.sh) under pytest.
+
+This is the in-CI hook for the reference's shell e2e layer
+(reference tests/ci-run-e2e.sh -> tests/scripts/end-to-end.sh, SURVEY.md
+section 4.2): real operator process, real HTTP API server, curl-driven cases.
+"""
+
+import pathlib
+import shutil
+import subprocess
+
+import pytest
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+SCRIPT = REPO_ROOT / "tests" / "scripts" / "end-to-end.sh"
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(shutil.which("curl") is None, reason="curl not available")
+def test_shell_end_to_end():
+    proc = subprocess.run(
+        ["bash", str(SCRIPT)], cwd=REPO_ROOT,
+        capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, (
+        f"shell e2e failed\n--- stdout ---\n{proc.stdout[-8000:]}"
+        f"\n--- stderr ---\n{proc.stderr[-4000:]}"
+    )
+    for case in sorted((REPO_ROOT / "tests" / "cases").glob("*.sh")):
+        assert f"PASS: {case.name}" in proc.stdout, f"case {case.name} did not pass"

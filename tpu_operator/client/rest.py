@@ -226,7 +226,10 @@ class _RestWatch(WatchHandle):
                         self._emit(WatchEvent(type=etype, object=obj))
                 # clean stream end: the server may not support resuming from
                 # our resourceVersion, and anything changed in the reconnect
-                # gap would be lost — re-LIST so consumers see current state
+                # gap would be lost — re-LIST so consumers see current state.
+                # Brief pause so a server that closes watches immediately
+                # doesn't get hammered with a full LIST per iteration.
+                self._stopped.wait(1.0)
                 rv = ""
             except (requests.RequestException, json.JSONDecodeError, ValueError):
                 self._stopped.wait(2.0)

@@ -142,7 +142,11 @@ class Controller:
         return self
 
     def start(self, client: Client) -> None:
-        self._stop_event.clear()
+        # fresh Event per start: a stop() immediately followed by start()
+        # must not let a prior resync thread (still blocked in wait()) miss
+        # the set flag and keep running alongside the new one
+        self._stop_event = threading.Event()
+        stop_event = self._stop_event
         for spec in self.watch_specs:
             def handler(event: WatchEvent, _spec=spec) -> None:
                 try:
@@ -155,11 +159,12 @@ class Controller:
                                         name=f"{self.reconciler.name}-worker")
         self._thread.start()
         if self._resync_fn is not None and self._resync_period > 0:
-            threading.Thread(target=self._resync_loop, daemon=True,
+            threading.Thread(target=self._resync_loop, args=(stop_event,),
+                             daemon=True,
                              name=f"{self.reconciler.name}-resync").start()
 
-    def _resync_loop(self) -> None:
-        while not self._stop_event.wait(self._resync_period):
+    def _resync_loop(self, stop_event: threading.Event) -> None:
+        while not stop_event.wait(self._resync_period):
             try:
                 for request in self._resync_fn():
                     self.queue.add(request)

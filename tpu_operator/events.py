@@ -25,8 +25,10 @@ def record(client: Client, namespace: str, involved: dict,
            component: str = "tpu-operator") -> Optional[dict]:
     meta = involved.get("metadata", {})
     now = rfc3339_now()
-    # truncate the object-name part, never the uniquifying suffix
-    name = f"{meta.get('name', 'unknown')[:50]}.{uuid.uuid4().hex[:12]}"
+    # truncate the object-name part, never the uniquifying suffix; the slice
+    # may leave a trailing '-'/'.', which DNS-1123 validation rejects
+    stem = meta.get("name", "unknown")[:50].rstrip("-.") or "unknown"
+    name = f"{stem}.{uuid.uuid4().hex[:12]}"
     event = {
         "apiVersion": "v1",
         "kind": "Event",

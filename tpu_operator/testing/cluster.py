@@ -1,0 +1,85 @@
+"""Standalone cluster harness process for the shell e2e layer.
+
+Runs the :class:`MiniApiServer` plus the :class:`KubeletSimulator` as a real
+OS process so shell scripts (``tests/scripts/``, ``tests/cases/``) can drive
+the operator binary over genuine HTTP with curl — the analog of the
+reference's shell e2e harness against a holodeck cluster
+(reference tests/scripts/end-to-end.sh, SURVEY.md §4.2/§4.3).
+
+Usage::
+
+    python -m tpu_operator.testing.cluster --url-file /tmp/cluster.url \
+        --nodes 4 --topology 4x4 --create-pods
+
+Writes the API base URL to ``--url-file`` once the server is listening and
+the seed nodes exist, then serves until SIGTERM/SIGINT.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import threading
+
+from .. import consts
+from .apiserver import MiniApiServer
+from .kubelet import KubeletSimulator
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="tpu-cluster-harness")
+    p.add_argument("--url-file", required=True,
+                   help="file to write the API server base URL to once ready")
+    p.add_argument("--port", type=int, default=0, help="listen port (0 = ephemeral)")
+    p.add_argument("--nodes", type=int, default=4, help="TPU nodes to seed")
+    p.add_argument("--accelerator", default="tpu-v5-lite-podslice",
+                   help="GKE accelerator label value for seeded nodes")
+    p.add_argument("--topology", default="4x4",
+                   help="GKE topology label value for seeded nodes")
+    p.add_argument("--chips-per-node", type=int, default=4)
+    p.add_argument("--interval", type=float, default=0.05,
+                   help="kubelet simulator tick interval (s)")
+    p.add_argument("--create-pods", action="store_true",
+                   help="simulate real per-(DS,node) pods with DS-controller semantics")
+    return p
+
+
+def seed_nodes(client, n: int, accelerator: str, topology: str) -> None:
+    for i in range(n):
+        client.create({
+            "apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": f"tpu-node-{i}", "labels": {
+                consts.GKE_TPU_ACCELERATOR_LABEL: accelerator,
+                consts.GKE_TPU_TOPOLOGY_LABEL: topology,
+            }},
+            "status": {},
+        })
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    srv = MiniApiServer()
+    base = srv.start(port=args.port)
+    seed_nodes(srv.backend, args.nodes, args.accelerator, args.topology)
+    kubelet = KubeletSimulator(srv.backend, chips_per_node=args.chips_per_node,
+                               interval=args.interval, create_pods=args.create_pods)
+    kubelet.start()
+
+    tmp = args.url_file + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(base)
+    os.replace(tmp, args.url_file)
+
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+    stop.wait()
+    kubelet.stop()
+    srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
