@@ -1,0 +1,66 @@
+"""Byte-exact golden renders for every operand state.
+
+Extends the driver golden tests (tests/test_render.py) to the whole manifest
+tree, the reference's highest-leverage test pattern
+(internal/state/driver_test.go:43-90 + internal/state/testdata/golden/):
+any template or render-data drift shows up as a reviewable diff.
+Regenerate with UPDATE_GOLDEN=1.
+"""
+
+import os
+
+import pytest
+import yaml
+
+from tpu_operator.api.clusterpolicy import ClusterPolicy, new_cluster_policy
+from tpu_operator.state.operands import cluster_policy_states
+
+GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "golden", "states")
+
+SPEC = {
+    "driver": {"repository": "gcr.io/tpu", "image": "tpu-validator",
+               "version": "0.1.0", "libtpuVersion": "2025.1.0"},
+    "devicePlugin": {"repository": "gcr.io/tpu", "image": "tpu-device-plugin",
+                     "version": "0.1.0"},
+    "featureDiscovery": {"repository": "gcr.io/tpu", "image": "tpu-validator",
+                         "version": "0.1.0"},
+    "telemetry": {"repository": "gcr.io/tpu", "image": "tpu-validator",
+                  "version": "0.1.0", "metricsPort": 9400},
+    "nodeStatusExporter": {"repository": "gcr.io/tpu", "image": "tpu-validator",
+                           "version": "0.1.0"},
+    "validator": {"repository": "gcr.io/tpu", "image": "tpu-validator",
+                  "version": "0.1.0"},
+    "slicePartitioner": {"enabled": True, "repository": "gcr.io/tpu",
+                         "image": "tpu-validator", "version": "0.1.0"},
+}
+
+
+def _states():
+    # client=None: rendering never touches the API
+    return [s for s in cluster_policy_states(client=None)
+            if hasattr(s, "render_data")]
+
+
+@pytest.mark.parametrize("state", _states(), ids=lambda s: s.name)
+def test_golden_state_render(state):
+    policy = ClusterPolicy.from_obj(new_cluster_policy(spec=SPEC))
+    if state.name == "pre-requisites":
+        objs = state.renderer.render_objects({"namespace": "tpu-operator"})
+    else:
+        objs = state.render_objects(policy, "tpu-operator")
+    text = yaml.safe_dump_all(objs, sort_keys=True)
+    golden_path = os.path.join(GOLDEN_DIR, f"{state.name}.yaml")
+    if os.environ.get("UPDATE_GOLDEN"):
+        os.makedirs(GOLDEN_DIR, exist_ok=True)
+        with open(golden_path, "w") as f:
+            f.write(text)
+    with open(golden_path) as f:
+        assert text == f.read(), (
+            f"golden mismatch for {state.name}; UPDATE_GOLDEN=1 to regenerate")
+
+
+def test_all_states_have_goldens():
+    """Every state with a manifest dir is locked by a golden file."""
+    want = {f"{s.name}.yaml" for s in _states()}
+    have = set(os.listdir(GOLDEN_DIR))
+    assert want <= have, f"missing goldens: {want - have}"

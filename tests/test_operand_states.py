@@ -156,3 +156,31 @@ def test_state_error_is_contained(fake_client):
     assert by_name["state-boom"].status == SyncState.ERROR
     assert not results.ready
     assert len(results.results) == len(states) + 1
+
+
+def test_monitoring_objects_optional_without_crds():
+    """Clusters without prometheus-operator: ServiceMonitor/PrometheusRule
+    manifests are skipped (and disable-cleanup stays silent) instead of
+    erroring the state — the monitoring API group is an optional add-on."""
+    from tpu_operator.client import FakeClient
+    from tpu_operator.client.scheme import Scheme, default_scheme
+
+    bare = Scheme()
+    for (api_version, kind), info in default_scheme()._kinds.items():
+        if not api_version.startswith("monitoring.coreos.com"):
+            bare.register(api_version, kind, info.plural, info.namespaced)
+    client = FakeClient(bare)
+
+    manager = Manager(cluster_policy_states(client))
+    results = manager.sync_state(catalog(policy()))
+    by_name = {r.state_name: r for r in results.results}
+    for name in ("state-operator-metrics", "state-node-status-exporter",
+                 "state-telemetry"):
+        assert by_name[name].status != SyncState.ERROR, by_name[name]
+    # DaemonSets and Services still applied
+    assert client.get("apps/v1", "DaemonSet", "tpu-node-status-exporter", "tpu-operator")
+    assert client.get("v1", "Service", "tpu-node-status-exporter", "tpu-operator")
+    # disabling the operand must not error on the unserved monitoring kinds
+    results = manager.sync_state(catalog(policy({"nodeStatusExporter": {"enabled": False}})))
+    by_name = {r.state_name: r for r in results.results}
+    assert by_name["state-node-status-exporter"].status == SyncState.IGNORE

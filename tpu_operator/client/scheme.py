@@ -11,7 +11,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Dict, Tuple
 
-from .errors import ApiError
+from .errors import NotFoundError
 
 
 @dataclass(frozen=True)
@@ -31,7 +31,9 @@ class Scheme:
         try:
             return self._kinds[(api_version, kind)]
         except KeyError:
-            raise ApiError(f"kind not registered in scheme: {api_version}/{kind}", 422)
+            # a real apiserver answers 404 for an unserved group/kind (e.g.
+            # optional CRDs like monitoring.coreos.com not installed)
+            raise NotFoundError(f"kind not registered in scheme: {api_version}/{kind}")
 
     def is_namespaced(self, api_version: str, kind: str) -> bool:
         return self.info(api_version, kind).namespaced

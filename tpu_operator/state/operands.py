@@ -95,7 +95,9 @@ class OperandState:
         policy: ClusterPolicy = catalog.require(INFO_CLUSTER_POLICY)
         namespace: str = catalog.require(INFO_NAMESPACE)
         if not self.spec_getter(policy).is_enabled(self.default_enabled):
-            for kind_av in (("apps/v1", "DaemonSet"), ("v1", "Service")):
+            for kind_av in (("apps/v1", "DaemonSet"), ("v1", "Service"),
+                            ("monitoring.coreos.com/v1", "ServiceMonitor"),
+                            ("monitoring.coreos.com/v1", "PrometheusRule")):
                 self.skel.delete_objs(self.skel.list_owned(*kind_av, namespace))
             return StateResult(self.name, SyncState.IGNORE, f"{self.operand} disabled")
         objs = self.render_objects(policy, namespace)

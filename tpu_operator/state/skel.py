@@ -105,6 +105,16 @@ _PRESERVE_ON_UPDATE = {
 }
 
 
+# API groups that may legitimately be unserved (their CRDs are optional
+# add-ons): objects in them are applied best-effort and skipped when the
+# cluster has no such resource, rather than failing the whole state.
+OPTIONAL_API_GROUPS = ("monitoring.coreos.com",)
+
+
+def _is_optional_group(api_version: str) -> bool:
+    return api_version.split("/")[0] in OPTIONAL_API_GROUPS
+
+
 class StateSkel:
     """Create-or-update a batch of unstructured objects and report readiness."""
 
@@ -116,7 +126,17 @@ class StateSkel:
     def create_or_update_objs(self, objs: List[dict], owner: Optional[dict] = None) -> List[dict]:
         applied = []
         for obj in objs:
-            applied.append(self._apply_one(copy.deepcopy(obj), owner))
+            try:
+                applied.append(self._apply_one(copy.deepcopy(obj), owner))
+            except NotFoundError:
+                # a create bouncing 404 means the resource kind itself is not
+                # served (e.g. no prometheus-operator CRDs) — tolerable only
+                # for optional groups
+                if not _is_optional_group(obj.get("apiVersion", "")):
+                    raise
+                log.info("state %s: skipping %s/%s (API group not served)",
+                         self.name, obj.get("kind"),
+                         deep_get(obj, "metadata", "name"))
         return applied
 
     def _apply_one(self, desired: dict, owner: Optional[dict]) -> dict:
@@ -194,5 +214,10 @@ class StateSkel:
                 pass
 
     def list_owned(self, api_version: str, kind: str, namespace: Optional[str] = None) -> List[dict]:
-        return self.client.list(api_version, kind, namespace,
-                                label_selector={consts.STATE_LABEL: self.name})
+        try:
+            return self.client.list(api_version, kind, namespace,
+                                    label_selector={consts.STATE_LABEL: self.name})
+        except NotFoundError:
+            if _is_optional_group(api_version):
+                return []  # resource kind not served: nothing owned
+            raise
