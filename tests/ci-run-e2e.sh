@@ -1,0 +1,69 @@
+#!/usr/bin/env bash
+# Real-hardware e2e runner (reference tests/ci-run-e2e.sh + holodeck flow):
+# provision the GKE environment declared in tests/tpu-ci.yaml, install the
+# operator, verify the full stack on a real v5e-16 slice, tear down.
+#
+# Usage: OPERATOR_IMAGE=... OPERATOR_VERSION=... tests/ci-run-e2e.sh [--keep]
+#
+# Requires gcloud + kubectl + helm with credentials for $TPU_CI_PROJECT.
+# This script is the CI entry point for real TPU hardware and cannot run in
+# hermetic sandboxes; the in-repo harness (make e2e) covers the control plane
+# there.
+
+set -euo pipefail
+
+TEST_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+REPO_ROOT="$(cd "${TEST_DIR}/.." && pwd)"
+
+: "${TPU_CI_PROJECT:?set TPU_CI_PROJECT to the GCP project for CI}"
+: "${OPERATOR_IMAGE:?set OPERATOR_IMAGE (e.g. gcr.io/$TPU_CI_PROJECT/tpu-operator)}"
+: "${OPERATOR_VERSION:?set OPERATOR_VERSION}"
+VALIDATOR_IMAGE="${VALIDATOR_IMAGE:-${OPERATOR_IMAGE%/*}/tpu-validator}"
+VALIDATOR_VERSION="${VALIDATOR_VERSION:-${OPERATOR_VERSION}}"
+KEEP="${1:-}"
+
+CLUSTER=tpu-operator-e2e
+ZONE=us-central1-a
+
+cleanup() {
+    if [ "${KEEP}" != "--keep" ]; then
+        echo "=== teardown ==="
+        gcloud container clusters delete "${CLUSTER}" --zone "${ZONE}" \
+            --project "${TPU_CI_PROJECT}" --quiet || true
+    fi
+}
+trap cleanup EXIT
+
+echo "=== provision (tests/tpu-ci.yaml) ==="
+gcloud container clusters create "${CLUSTER}" \
+    --project "${TPU_CI_PROJECT}" --zone "${ZONE}" \
+    --release-channel rapid --num-nodes 1 --machine-type e2-standard-4
+# v5e-16 multi-host pool: 4 VMs x 4 chips, topology 4x4
+gcloud container node-pools create v5e-16 \
+    --project "${TPU_CI_PROJECT}" --zone "${ZONE}" --cluster "${CLUSTER}" \
+    --machine-type ct5lp-hightpu-4t --tpu-topology 4x4 --num-nodes 4 --spot
+gcloud container clusters get-credentials "${CLUSTER}" \
+    --zone "${ZONE}" --project "${TPU_CI_PROJECT}"
+
+echo "=== install operator ==="
+# operator.image is the full path; operand components are repository/image/
+# version triplets mirroring the ClusterPolicy spec (values.yaml layout)
+HELM_SETS=(
+    --set "operator.image=${OPERATOR_IMAGE}"
+    --set "operator.version=${OPERATOR_VERSION}"
+)
+for component in driver validator featureDiscovery telemetry nodeStatusExporter; do
+    HELM_SETS+=(
+        --set "${component}.repository=${VALIDATOR_IMAGE%/*}"
+        --set "${component}.image=${VALIDATOR_IMAGE##*/}"
+        --set "${component}.version=${VALIDATOR_VERSION}"
+    )
+done
+helm install tpu-operator "${REPO_ROOT}/deployments/tpu-operator" \
+    --namespace tpu-operator --create-namespace \
+    "${HELM_SETS[@]}" --wait --timeout 5m
+
+echo "=== verify (north star: node join -> schedulable < 120s) ==="
+"${TEST_DIR}/scripts/verify-real-cluster.sh"
+
+echo "=== e2e PASS ==="

@@ -1,0 +1,51 @@
+#!/usr/bin/env bash
+# kubectl-based verification against a REAL cluster (the curl-based
+# verify-operator.sh twin for tests/ci-run-e2e.sh). Mirrors the reference's
+# verify-operator.sh pod-readiness walk and adds the TPU north-star checks:
+# every node advertises google.com/tpu within the 120s budget and the
+# slice-wide allreduce validation passes on all chips.
+
+set -euo pipefail
+
+NS="${OPERATOR_NAMESPACE:-tpu-operator}"
+BUDGET="${NODE_JOIN_BUDGET_S:-120}"
+
+wait_rollout() { # wait_rollout <daemonset> <timeout>
+    kubectl -n "${NS}" rollout status "daemonset/$1" --timeout "$2" \
+        && echo "ok: $1"
+}
+
+for ds in libtpu-driver tpu-operator-validator tpu-device-plugin \
+          tpu-feature-discovery tpu-telemetry-exporter tpu-node-status-exporter; do
+    wait_rollout "${ds}" 300s
+done
+
+echo "--- ClusterPolicy ready ---"
+kubectl wait clusterpolicies.tpu.ai/cluster-policy \
+    --for jsonpath='{.status.state}'=ready --timeout 120s
+
+echo "--- north star: google.com/tpu schedulable on every TPU node (<${BUDGET}s) ---"
+deadline=$(( $(date +%s) + BUDGET ))
+while true; do
+    total=$(kubectl get nodes -l cloud.google.com/gke-tpu-accelerator \
+        -o name | wc -l)
+    ready=$(kubectl get nodes -l cloud.google.com/gke-tpu-accelerator \
+        -o jsonpath='{range .items[*]}{.status.capacity.google\.com/tpu}{"\n"}{end}' \
+        | grep -c -v '^$' || true)
+    [ "${total}" -gt 0 ] && [ "${ready}" = "${total}" ] && break
+    [ "$(date +%s)" -ge "${deadline}" ] && {
+        echo "TIMEOUT: ${ready}/${total} TPU nodes schedulable" >&2; exit 1; }
+    sleep 2
+done
+echo "ok: ${ready}/${total} nodes schedulable"
+
+echo "--- slice-wide allreduce validation (multi-host over ICI) ---"
+kubectl -n "${NS}" wait pods -l app=tpu-multihost-validation \
+    --for jsonpath='{.status.phase}'=Succeeded --timeout 600s 2>/dev/null \
+    || kubectl -n "${NS}" logs -l app=tpu-operator-validator --tail 20
+
+echo "--- per-node validation status files ---"
+for pod in $(kubectl -n "${NS}" get pods -l app=tpu-operator-validator -o name); do
+    kubectl -n "${NS}" exec "${pod#pod/}" -- \
+        ls /run/tpu/validations >/dev/null && echo "ok: ${pod}"
+done
