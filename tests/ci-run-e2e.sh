@@ -48,9 +48,19 @@ gcloud container clusters get-credentials "${CLUSTER}" \
 echo "=== install operator ==="
 # operator.image is the full path; operand components are repository/image/
 # version triplets mirroring the ClusterPolicy spec (values.yaml layout)
+DEVICE_PLUGIN_IMAGE="${DEVICE_PLUGIN_IMAGE:-${OPERATOR_IMAGE%/*}/tpu-device-plugin}"
+# GKE TPU pools ship Google's built-in device plugin already advertising
+# google.com/tpu; the operator-managed plugin under test serves a distinct
+# resource name so the two never contend and the verification below proves
+# OUR stack end-to-end, not GKE's.
+OPERATOR_RESOURCE="${OPERATOR_RESOURCE:-tpu.ai/tpu}"
 HELM_SETS=(
     --set "operator.image=${OPERATOR_IMAGE}"
     --set "operator.version=${OPERATOR_VERSION}"
+    --set "devicePlugin.repository=${DEVICE_PLUGIN_IMAGE%/*}"
+    --set "devicePlugin.image=${DEVICE_PLUGIN_IMAGE##*/}"
+    --set "devicePlugin.version=${OPERATOR_VERSION}"
+    --set "devicePlugin.resourceName=${OPERATOR_RESOURCE}"
 )
 for component in driver validator featureDiscovery telemetry nodeStatusExporter; do
     HELM_SETS+=(
@@ -64,6 +74,6 @@ helm install tpu-operator "${REPO_ROOT}/deployments/tpu-operator" \
     "${HELM_SETS[@]}" --wait --timeout 5m
 
 echo "=== verify (north star: node join -> schedulable < 120s) ==="
-"${TEST_DIR}/scripts/verify-real-cluster.sh"
+TPU_RESOURCE_NAME="${OPERATOR_RESOURCE}" "${TEST_DIR}/scripts/verify-real-cluster.sh"
 
 echo "=== e2e PASS ==="

@@ -9,6 +9,10 @@ set -euo pipefail
 
 NS="${OPERATOR_NAMESPACE:-tpu-operator}"
 BUDGET="${NODE_JOIN_BUDGET_S:-120}"
+# the operator-managed extended resource under test (ci-run-e2e.sh passes a
+# name distinct from GKE's built-in google.com/tpu to avoid contention)
+RESOURCE="${TPU_RESOURCE_NAME:-google.com/tpu}"
+RESOURCE_JSONPATH="${RESOURCE//./\\.}"
 
 wait_rollout() { # wait_rollout <daemonset> <timeout>
     kubectl -n "${NS}" rollout status "daemonset/$1" --timeout "$2" \
@@ -24,13 +28,13 @@ echo "--- ClusterPolicy ready ---"
 kubectl wait clusterpolicies.tpu.ai/cluster-policy \
     --for jsonpath='{.status.state}'=ready --timeout 120s
 
-echo "--- north star: google.com/tpu schedulable on every TPU node (<${BUDGET}s) ---"
+echo "--- north star: ${RESOURCE} schedulable on every TPU node (<${BUDGET}s) ---"
 deadline=$(( $(date +%s) + BUDGET ))
 while true; do
     total=$(kubectl get nodes -l cloud.google.com/gke-tpu-accelerator \
         -o name | wc -l)
     ready=$(kubectl get nodes -l cloud.google.com/gke-tpu-accelerator \
-        -o jsonpath='{range .items[*]}{.status.capacity.google\.com/tpu}{"\n"}{end}' \
+        -o jsonpath="{range .items[*]}{.status.capacity.${RESOURCE_JSONPATH}}{\"\n\"}{end}" \
         | grep -c -v '^$' || true)
     [ "${total}" -gt 0 ] && [ "${ready}" = "${total}" ] && break
     [ "$(date +%s)" -ge "${deadline}" ] && {
@@ -40,12 +44,26 @@ done
 echo "ok: ${ready}/${total} nodes schedulable"
 
 echo "--- slice-wide allreduce validation (multi-host over ICI) ---"
-kubectl -n "${NS}" wait pods -l app=tpu-multihost-validation \
-    --for jsonpath='{.status.phase}'=Succeeded --timeout 600s 2>/dev/null \
-    || kubectl -n "${NS}" logs -l app=tpu-operator-validator --tail 20
+if ! kubectl -n "${NS}" get pods -l app=tpu-multihost-validation -o name | grep -q pod/; then
+    echo "FAIL: no multihost validation pods found" >&2
+    exit 1
+fi
+if ! kubectl -n "${NS}" wait pods -l app=tpu-multihost-validation \
+    --for jsonpath='{.status.phase}'=Succeeded --timeout 600s; then
+    echo "FAIL: slice-wide allreduce validation did not succeed" >&2
+    kubectl -n "${NS}" logs -l app=tpu-multihost-validation --tail 40 >&2 || true
+    exit 1
+fi
+echo "ok: slice-wide allreduce"
 
 echo "--- per-node validation status files ---"
-for pod in $(kubectl -n "${NS}" get pods -l app=tpu-operator-validator -o name); do
-    kubectl -n "${NS}" exec "${pod#pod/}" -- \
-        ls /run/tpu/validations >/dev/null && echo "ok: ${pod}"
+pods=$(kubectl -n "${NS}" get pods -l app=tpu-operator-validator -o name)
+[ -n "${pods}" ] || { echo "FAIL: no validator pods found" >&2; exit 1; }
+for pod in ${pods}; do
+    if ! kubectl -n "${NS}" exec "${pod#pod/}" -- \
+        ls /run/tpu/validations >/dev/null; then
+        echo "FAIL: ${pod} has no validation status files" >&2
+        exit 1
+    fi
+    echo "ok: ${pod}"
 done

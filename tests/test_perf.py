@@ -1,0 +1,52 @@
+"""Perf validation component (tpu_operator/validator/perf.py)."""
+
+import json
+
+from tpu_operator.validator.perf import run_perf
+from tpu_operator.validator import main as vmain
+
+
+TINY = dict(matrix_dim=128, hbm_mib=4, ici_mib=1, iters=2)
+
+
+def test_perf_report_structure():
+    report = run_perf(**TINY)
+    assert report.passed, report.failures
+    assert report.n_devices >= 1
+    assert report.mxu_tflops > 0
+    assert report.hbm_gbps > 0
+    # conftest forces an 8-device CPU mesh, so ICI (its virtual stand-in)
+    # is measurable
+    assert report.ici_allreduce_gbps > 0
+    assert report.elapsed_s > 0
+
+
+def test_perf_thresholds_gate():
+    report = run_perf(thresholds={"mxu_tflops": 1e9}, **TINY)
+    assert not report.passed
+    assert any("mxu_tflops" in f for f in report.failures)
+    # informational floors at 0 never gate
+    report = run_perf(thresholds={"mxu_tflops": 0.0}, **TINY)
+    assert report.passed
+
+
+def test_perf_cli(tmp_path, capsys):
+    rc = vmain.run([
+        "-c", "perf", "--status-dir", str(tmp_path),
+        "--perf-matrix-dim", "128", "--perf-hbm-mib", "4",
+        "--perf-ici-mib", "1",
+    ])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["passed"] is True
+    assert (tmp_path / "perf-ready").exists()
+
+
+def test_perf_cli_floor_fails(tmp_path, capsys):
+    rc = vmain.run([
+        "-c", "perf", "--status-dir", str(tmp_path),
+        "--perf-matrix-dim", "128", "--perf-hbm-mib", "4",
+        "--perf-ici-mib", "1", "--min-mxu-tflops", "999999",
+    ])
+    assert rc == 1
+    assert not (tmp_path / "perf-ready").exists()

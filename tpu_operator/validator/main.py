@@ -11,6 +11,9 @@ plugin              wait for the TPU extended resource on this node
 workload            spawn allreduce pod via device plugin; write barrier
 workload-local      run the ICI health sweep in-process (inside the pod)
 workload-multihost  slice-wide sweep after jax.distributed rendezvous
+perf                measured MXU TFLOP/s, HBM GB/s, ICI allreduce GB/s;
+                    optional floors turn it into a gate (no reference
+                    analog — DCGM diag is functional-only)
 wait                block on another component's barrier (--for)
 sleep               validator DS main container: idle heartbeat
 metrics             node-status exporter (status files -> Prometheus)
@@ -39,7 +42,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-c", "--component", required=True,
                    choices=["driver", "driver-daemon", "driver-probe", "plugin",
                             "workload", "workload-local", "workload-multihost",
-                            "wait", "sleep", "metrics", "telemetry",
+                            "perf", "wait", "sleep", "metrics", "telemetry",
                             "feature-discovery", "slice-partitioner",
                             "device-plugin", "cdi"])
     p.add_argument("--cdi-dir", default="/etc/cdi")
@@ -52,6 +55,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--port", type=int, default=8000)
     p.add_argument("--sleep-interval", type=float, default=60.0)
     p.add_argument("--matrix-dim", type=int, default=512)
+    p.add_argument("--perf-matrix-dim", type=int, default=4096)
+    p.add_argument("--perf-hbm-mib", type=int, default=512)
+    p.add_argument("--perf-ici-mib", type=int, default=64)
+    p.add_argument("--min-mxu-tflops", type=float,
+                   default=float(os.environ.get("MIN_MXU_TFLOPS", "0")))
+    p.add_argument("--min-hbm-gbps", type=float,
+                   default=float(os.environ.get("MIN_HBM_GBPS", "0")))
+    p.add_argument("--min-ici-gbps", type=float,
+                   default=float(os.environ.get("MIN_ICI_GBPS", "0")))
     p.add_argument("--coordinator", default=os.environ.get("TPU_COORDINATOR_ADDRESS", ""))
     p.add_argument("--num-processes", type=int,
                    default=int(os.environ.get("TPU_NUM_PROCESSES", "1")))
@@ -137,6 +149,22 @@ def run(argv=None, client=None) -> int:
         print(json.dumps(report.to_dict()))
         if report.passed:
             status.write("workload", report.to_dict())
+        return 0 if report.passed else 1
+
+    if component == "perf":
+        from .perf import run_perf
+        from .workload import enable_compilation_cache
+
+        enable_compilation_cache()
+        report = run_perf(
+            matrix_dim=args.perf_matrix_dim, hbm_mib=args.perf_hbm_mib,
+            ici_mib=args.perf_ici_mib,
+            thresholds={"mxu_tflops": args.min_mxu_tflops,
+                        "hbm_gbps": args.min_hbm_gbps,
+                        "ici_allreduce_gbps": args.min_ici_gbps})
+        print(json.dumps(report.to_dict()))
+        if report.passed:
+            status.write("perf", report.to_dict())
         return 0 if report.passed else 1
 
     if component == "wait":
