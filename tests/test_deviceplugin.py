@@ -146,3 +146,33 @@ def test_health_loop_detects_chip_loss(plugin, fake_devs):
         if len(update.devices) == 3:
             break
     assert update is not None and len(update.devices) == 3
+
+
+def test_preferred_allocation_topology_aware(plugin):
+    """On the 2x2 host grid, diagonal pairs cost an extra ICI hop: requesting
+    2 with tpu-0 pinned must pick an adjacent chip (tpu-1 or tpu-2), never
+    the diagonal tpu-3."""
+    _, stub, _ = plugin
+    resp = stub.GetPreferredAllocation(pb.PreferredAllocationRequest(
+        container_requests=[pb.ContainerPreferredAllocationRequest(
+            available_deviceIDs=["tpu-0", "tpu-3", "tpu-1"],
+            must_include_deviceIDs=["tpu-0"],
+            allocation_size=2)]))
+    assert list(resp.container_responses[0].deviceIDs) == ["tpu-0", "tpu-1"]
+
+
+def test_prefer_compact_function():
+    from tpu_operator.deviceplugin.plugin import prefer_compact
+
+    chips_of = {f"tpu-{i}": [i] for i in range(4)}
+    # full host: order keeps must first then fills
+    assert prefer_compact(["tpu-0", "tpu-1", "tpu-2", "tpu-3"], [], 4, chips_of) == [
+        "tpu-0", "tpu-1", "tpu-2", "tpu-3"]
+    # diagonal avoided: 1 and 2 are both adjacent to nothing pinned; pair
+    # (1,0)/(2,3)... choose the most compact 2-subset overall
+    picked = prefer_compact(["tpu-0", "tpu-3"], [], 2, chips_of)
+    assert picked == ["tpu-0", "tpu-3"]  # only option
+    picked = prefer_compact(["tpu-1", "tpu-2", "tpu-3"], [], 2, chips_of)
+    # (2,3) adjacent (dist 1) beats (1,2) diagonal (dist 2); (1,3) dist 1 ties
+    # (2,3) -> lexical tie-break picks ("tpu-1","tpu-3")
+    assert picked == ["tpu-1", "tpu-3"]

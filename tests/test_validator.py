@@ -227,3 +227,44 @@ def test_cli_workload_local(tmp_path, capsys):
     report = json.loads(capsys.readouterr().out.strip())
     assert report["passed"] and report["n_devices"] == 8
     assert StatusFiles(sd).is_ready("workload")
+
+
+def test_feature_discovery_version_and_memory_labels(fake_client, fake_devs, monkeypatch, tmp_path):
+    monkeypatch.setenv("TPU_FD_SKIP_JAX", "1")
+    # isolate from any real /run/tpu/validations on the host
+    monkeypatch.setenv("STATUS_DIR", str(tmp_path))
+    monkeypatch.setenv("LIBTPU_VERSION", "2025.1.0")
+    fake_client.create({"apiVersion": "v1", "kind": "Node",
+                        "metadata": {"name": "n2", "labels": {}}, "status": {}})
+    feature_discovery.sync_node_labels(fake_client, "n2")
+    labels = fake_client.get("v1", "Node", "n2")["metadata"]["labels"]
+    assert labels[consts.TPU_LIBTPU_VERSION_LABEL] == "2025.1.0"
+    # "bundled" (no explicit pin) must not become a label
+    monkeypatch.setenv("LIBTPU_VERSION", "bundled")
+    assert consts.TPU_LIBTPU_VERSION_LABEL not in feature_discovery.discover(use_jax=False)
+
+
+def test_hbm_gib_rounding():
+    class Dev:
+        def memory_stats(self):
+            return {"bytes_limit": 16 * (1 << 30) - 1}
+
+    assert feature_discovery._hbm_gib(Dev()) == 16
+
+    class NoStats:
+        def memory_stats(self):
+            raise RuntimeError("unsupported")
+
+    assert feature_discovery._hbm_gib(NoStats()) == 0
+
+
+def test_feature_discovery_prefers_driver_record(fake_devs, monkeypatch, tmp_path):
+    """The driver daemon's install record beats the env fallback."""
+    from tpu_operator.validator.status import StatusFiles
+
+    monkeypatch.setenv("TPU_FD_SKIP_JAX", "1")
+    monkeypatch.setenv("STATUS_DIR", str(tmp_path))
+    monkeypatch.setenv("LIBTPU_VERSION", "env-version")
+    StatusFiles(str(tmp_path)).write("driver", {"libtpu_version": "2025.2.0"})
+    labels = feature_discovery.discover(use_jax=False)
+    assert labels[consts.TPU_LIBTPU_VERSION_LABEL] == "2025.2.0"

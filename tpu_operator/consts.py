@@ -23,6 +23,8 @@ DEPLOY_LABEL_PREFIX = "tpu.ai/tpu.deploy."
 TPU_CHIP_TYPE_LABEL = "tpu.ai/tpu.chip-type"
 TPU_CHIP_COUNT_LABEL = "tpu.ai/tpu.chip-count"
 TPU_TOPOLOGY_LABEL = "tpu.ai/tpu.topology"
+TPU_MEMORY_LABEL = "tpu.ai/tpu.memory"          # HBM per chip, GiB
+TPU_LIBTPU_VERSION_LABEL = "tpu.ai/libtpu.version"
 TPU_SLICE_CONFIG_LABEL = "tpu.ai/slice.config"
 TPU_SLICE_STATE_LABEL = "tpu.ai/slice.config.state"
 #: nodes carrying the same value form one multi-host slice (set by the admin

@@ -44,7 +44,8 @@ APP_LABEL = "tpu-multihost-validation"
 COORDINATOR_PORT = 8476
 
 
-def slice_groups(nodes: List[dict]) -> Dict[str, List[dict]]:
+def slice_groups(nodes: List[dict],
+                 resource: str = consts.TPU_RESOURCE_NAME) -> Dict[str, List[dict]]:
     """Group schedulable TPU nodes by slice id; sorted stable worker order."""
     groups: Dict[str, List[dict]] = {}
     for node in nodes:
@@ -52,7 +53,7 @@ def slice_groups(nodes: List[dict]) -> Dict[str, List[dict]]:
         slice_id = labels.get(consts.TPU_SLICE_ID_LABEL)
         if not slice_id:
             continue
-        if not deep_get(node, "status", "capacity", consts.TPU_RESOURCE_NAME):
+        if not deep_get(node, "status", "capacity", resource):
             continue  # not schedulable yet; validated once the plugin is up
         groups.setdefault(slice_id, []).append(node)
     for members in groups.values():
@@ -89,10 +90,11 @@ class MultihostValidationState:
         return f"tpu-mh-validation-{slice_id}-{worker}"[:63].rstrip("-")
 
     def _pod(self, slice_id: str, worker: int, node: dict, n: int,
-             namespace: str, image: str, config_hash: str) -> dict:
+             namespace: str, image: str, config_hash: str,
+             resource: str = consts.TPU_RESOURCE_NAME) -> dict:
         coordinator = (f"{self._pod_name(slice_id, 0)}."
                        f"{self._svc_name(slice_id)}.{namespace}.svc:{COORDINATOR_PORT}")
-        chips = deep_get(node, "status", "capacity", consts.TPU_RESOURCE_NAME, default="4")
+        chips = deep_get(node, "status", "capacity", resource, default="4")
         return {
             "apiVersion": "v1",
             "kind": "Pod",
@@ -108,7 +110,7 @@ class MultihostValidationState:
                 "nodeName": node["metadata"]["name"],
                 "hostname": self._pod_name(slice_id, worker),
                 "subdomain": self._svc_name(slice_id),
-                "tolerations": [{"key": consts.TPU_RESOURCE_NAME,
+                "tolerations": [{"key": resource,
                                  "operator": "Exists", "effect": "NoSchedule"}],
                 "containers": [{
                     "name": "workload",
@@ -125,7 +127,7 @@ class MultihostValidationState:
                         {"name": "NODE_NAME", "valueFrom": {
                             "fieldRef": {"fieldPath": "spec.nodeName"}}},
                     ],
-                    "resources": {"limits": {consts.TPU_RESOURCE_NAME: str(chips)}},
+                    "resources": {"limits": {resource: str(chips)}},
                 }],
             },
         }
@@ -170,6 +172,7 @@ class MultihostValidationState:
 
         n = len(members)
         image = policy.spec.validator.image_path()
+        resource = policy.spec.device_plugin.resource_name
         pods = self.client.list("v1", "Pod", namespace,
                                 label_selector={"app": APP_LABEL,
                                                 "tpu.ai/slice": slice_id})
@@ -186,7 +189,8 @@ class MultihostValidationState:
             self.skel.create_or_update_objs(
                 [self._service(slice_id, namespace)], owner=policy.obj)
             for worker, node in enumerate(members):
-                pod = self._pod(slice_id, worker, node, n, namespace, image, config_hash)
+                pod = self._pod(slice_id, worker, node, n, namespace, image,
+                                config_hash, resource)
                 self.skel.create_or_update_objs([pod], owner=policy.obj)
             return SyncState.NOT_READY
 
@@ -210,7 +214,7 @@ class MultihostValidationState:
         if not policy.spec.validator.is_enabled():
             return StateResult(self.name, SyncState.IGNORE, "validator disabled")
         nodes = catalog.get(INFO_NODES) or self.client.list("v1", "Node")
-        groups = slice_groups(nodes)
+        groups = slice_groups(nodes, policy.spec.device_plugin.resource_name)
         if not groups:
             return StateResult(self.name, SyncState.READY, "no multi-host slices")
         worst = SyncState.READY

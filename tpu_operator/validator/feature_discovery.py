@@ -51,13 +51,45 @@ def discover(use_jax: bool = True) -> Dict[str, str]:
             if devices:
                 chip_count = len(devices)
                 labels[consts.TPU_CHIP_TYPE_LABEL] = chip_type_from_kind(devices[0].device_kind)
+                hbm = _hbm_gib(devices[0])
+                if hbm:
+                    labels[consts.TPU_MEMORY_LABEL] = f"{hbm}Gi"
         except Exception as e:  # no TPU runtime in this container
             log.debug("feature discovery: jax enumeration unavailable: %s", e)
     if chip_count == 0:
         chip_count = len(discover_devices())
     if chip_count:
         labels[consts.TPU_CHIP_COUNT_LABEL] = str(chip_count)
+    libtpu = _libtpu_version()
+    if libtpu:
+        labels[consts.TPU_LIBTPU_VERSION_LABEL] = libtpu
     return labels
+
+
+def _hbm_gib(device) -> int:
+    """Per-chip HBM capacity in whole GiB (0 if the runtime can't say)."""
+    try:
+        stats = device.memory_stats() or {}
+        limit = stats.get("bytes_limit") or stats.get("bytes_reservable_limit") or 0
+        return round(limit / (1 << 30))
+    except Exception:
+        return 0
+
+
+def _libtpu_version() -> str:
+    """The installed libtpu version, from the driver-daemon's install record
+    (the FD DaemonSet mounts the validation-status hostPath read-only; the
+    $STATUS_DIR env overrides the location) or the pod env — "" if unknown."""
+    from .status import StatusFiles
+
+    try:
+        status_dir = os.environ.get("STATUS_DIR", consts.VALIDATION_STATUS_DIR)
+        record = StatusFiles(status_dir).read("driver") or {}
+        version = record.get("libtpu_version", "")
+    except Exception:
+        version = ""
+    version = version or os.environ.get("LIBTPU_VERSION", "")
+    return version if version and version != "bundled" else ""
 
 
 def sync_node_labels(client, node_name: str, use_jax: bool = True) -> Dict[str, str]:
