@@ -25,6 +25,7 @@ validate-manifests:
 .PHONY: native
 native:
 	$(MAKE) -C native/tpu-probe
+	$(MAKE) -C native/tpu-exporter
 
 .PHONY: graft-check
 graft-check:

@@ -23,11 +23,14 @@ COPY pyproject.toml ./
 COPY tpu_operator/ tpu_operator/
 RUN pip install --no-cache-dir .
 
-# native probe for ~1ms kubelet exec probes
+# native binaries: tpu-probe (~1ms kubelet exec probes) and tpu-exporter
+# (compiled node metrics server, DCGM-hostengine analog)
 COPY native/ native/
 RUN apt-get update && apt-get install -y --no-install-recommends g++ make \
     && make -C native/tpu-probe \
+    && make -C native/tpu-exporter \
     && install -m 0755 native/tpu-probe/build/tpu-probe /usr/local/bin/tpu-probe \
+    && install -m 0755 native/tpu-exporter/build/tpu-exporter /usr/local/bin/tpu-exporter \
     && apt-get purge -y g++ make && apt-get autoremove -y && rm -rf /var/lib/apt/lists/*
 
 ENV LIBTPU_VERSION=${LIBTPU_VERSION}

@@ -1,0 +1,230 @@
+// tpu-exporter: native per-node status/metrics exporter.
+//
+// The native tier of the telemetry stack — the analog of the reference
+// ecosystem's DCGM hostengine (C++) feeding dcgm-exporter: a dependency-free
+// compiled binary that turns the node's validation barriers
+// (/run/tpu/validations/*-ready), TPU device nodes and the perf-validation
+// record into Prometheus gauges. The Python validator (-c metrics) execs
+// this binary when present and falls back to its in-process server
+// otherwise — same delegation pattern as tpu-probe.
+//
+// Metric names match tpu_operator/validator/metrics.py exactly so dashboards
+// and the shipped PrometheusRules work against either implementation.
+//
+// Usage:
+//   tpu-exporter [--port N] [--status-dir DIR] [--oneshot]
+//
+// --oneshot prints the metrics payload to stdout and exits (probe/test mode).
+
+#include <arpa/inet.h>
+#include <csignal>
+#include <glob.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/time.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+#include <fstream>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr const char* kDefaultStatusDir = "/run/tpu/validations";
+constexpr const char* kDevGlobs[] = {"/dev/accel*", "/dev/vfio/*"};
+constexpr const char* kComponents[] = {"driver", "plugin", "workload"};
+
+int CountDevices(const char* extra_globs_env) {
+  std::vector<std::string> patterns;
+  if (extra_globs_env != nullptr && extra_globs_env[0] != '\0') {
+    std::string raw(extra_globs_env);
+    size_t start = 0;
+    while (start <= raw.size()) {
+      size_t comma = raw.find(',', start);
+      if (comma == std::string::npos) comma = raw.size();
+      if (comma > start) patterns.emplace_back(raw.substr(start, comma - start));
+      start = comma + 1;
+    }
+  } else {
+    for (const char* pattern : kDevGlobs) patterns.emplace_back(pattern);
+  }
+  int count = 0;
+  for (const auto& pattern : patterns) {
+    glob_t results;
+    if (glob(pattern.c_str(), 0, nullptr, &results) == 0) {
+      count += static_cast<int>(results.gl_pathc);
+      globfree(&results);
+    }
+  }
+  return count;
+}
+
+bool FileExists(const std::string& path) {
+  struct stat st;
+  return stat(path.c_str(), &st) == 0 && S_ISREG(st.st_mode);
+}
+
+std::string ReadFile(const std::string& path) {
+  std::ifstream in(path);
+  if (!in) return "";
+  std::stringstream buffer;
+  buffer << in.rdbuf();
+  return buffer.str();
+}
+
+// Extract a numeric field from the flat JSON our status writer produces.
+// Returns false when the key is absent or not a number.
+bool JsonNumber(const std::string& json, const std::string& key, double* out) {
+  const std::string needle = "\"" + key + "\":";
+  size_t pos = json.find(needle);
+  if (pos == std::string::npos) return false;
+  pos += needle.size();
+  while (pos < json.size() && (json[pos] == ' ' || json[pos] == '\t')) ++pos;
+  char* end = nullptr;
+  double value = strtod(json.c_str() + pos, &end);
+  if (end == json.c_str() + pos) return false;
+  *out = value;
+  return true;
+}
+
+void Gauge(std::string* out, const char* name, const char* help, double value) {
+  char line[256];
+  snprintf(line, sizeof(line), "# HELP %s %s\n# TYPE %s gauge\n%s %.17g\n",
+           name, help, name, name, value);
+  out->append(line);
+}
+
+std::string RenderMetrics(const std::string& status_dir) {
+  std::string out;
+  for (const char* component : kComponents) {
+    const std::string path = status_dir + "/" + component + "-ready";
+    char name[128];
+    snprintf(name, sizeof(name), "tpu_operator_node_%s_ready", component);
+    char help[160];
+    snprintf(help, sizeof(help),
+             "1 when the %s validation barrier is present on this node", component);
+    Gauge(&out, name, help, FileExists(path) ? 1 : 0);
+  }
+  Gauge(&out, "tpu_operator_node_tpu_device_nodes",
+        "TPU device nodes visible on this node",
+        CountDevices(getenv("TPU_DEV_GLOBS")));
+
+  // measured throughput from the perf validation barrier; 0 until perf has
+  // run — always emitted so the series set matches the Python exporter
+  const std::string perf = ReadFile(status_dir + "/perf-ready");
+  const struct { const char* key; const char* metric; const char* help; } kPerf[] = {
+      {"mxu_tflops", "tpu_operator_node_mxu_tflops",
+       "Measured MXU throughput (bf16 TFLOP/s) from perf validation"},
+      {"hbm_gbps", "tpu_operator_node_hbm_gbps",
+       "Measured HBM bandwidth (GB/s) from perf validation"},
+      {"ici_allreduce_gbps", "tpu_operator_node_ici_allreduce_gbps",
+       "Measured ICI allreduce bus bandwidth (GB/s) from perf validation"},
+  };
+  for (const auto& entry : kPerf) {
+    double value = 0;
+    if (!perf.empty()) JsonNumber(perf, entry.key, &value);
+    Gauge(&out, entry.metric, entry.help, value);
+  }
+  Gauge(&out, "tpu_operator_node_metrics_last_refresh_ts_seconds",
+        "Timestamp of the last metrics refresh",
+        static_cast<double>(time(nullptr)));
+  return out;
+}
+
+int Serve(int port, const std::string& status_dir) {
+  // a scraper closing mid-write must not kill the process
+  signal(SIGPIPE, SIG_IGN);
+  int server_fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (server_fd < 0) {
+    perror("socket");
+    return 1;
+  }
+  int opt = 1;
+  setsockopt(server_fd, SOL_SOCKET, SO_REUSEADDR, &opt, sizeof(opt));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (bind(server_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
+      listen(server_fd, 16) < 0) {
+    perror("bind/listen");
+    close(server_fd);
+    return 1;
+  }
+  fprintf(stderr, "tpu-exporter serving on :%d (status dir %s)\n", port,
+          status_dir.c_str());
+  for (;;) {
+    int client = accept(server_fd, nullptr, nullptr);
+    if (client < 0) continue;
+    // bound the blocking read: an idle client (TCP connect probe, scanner)
+    // must not wedge the single-threaded accept loop
+    timeval timeout{};
+    timeout.tv_sec = 5;
+    setsockopt(client, SOL_SOCKET, SO_RCVTIMEO, &timeout, sizeof(timeout));
+    char request[2048];
+    ssize_t got = read(client, request, sizeof(request) - 1);
+    if (got <= 0) {
+      close(client);
+      continue;
+    }
+    request[got] = '\0';
+    const bool is_metrics = strncmp(request, "GET /metrics", 12) == 0;
+    const bool is_health = strncmp(request, "GET /healthz", 12) == 0;
+    std::string body, header;
+    if (is_metrics) {
+      body = RenderMetrics(status_dir);
+      header = "HTTP/1.1 200 OK\r\nContent-Type: text/plain; version=0.0.4\r\n";
+    } else if (is_health) {
+      body = "ok\n";
+      header = "HTTP/1.1 200 OK\r\nContent-Type: text/plain\r\n";
+    } else {
+      body = "not found\n";
+      header = "HTTP/1.1 404 Not Found\r\nContent-Type: text/plain\r\n";
+    }
+    header += "Content-Length: " + std::to_string(body.size()) +
+              "\r\nConnection: close\r\n\r\n";
+    (void)write(client, header.c_str(), header.size());
+    (void)write(client, body.c_str(), body.size());
+    close(client);
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  int port = 8000;
+  std::string status_dir = kDefaultStatusDir;
+  bool oneshot = false;
+  for (int i = 1; i < argc; ++i) {
+    const std::string arg = argv[i];
+    if (arg.rfind("--port=", 0) == 0) {
+      port = atoi(arg.c_str() + 7);
+    } else if (arg == "--port" && i + 1 < argc) {
+      port = atoi(argv[++i]);
+    } else if (arg.rfind("--status-dir=", 0) == 0) {
+      status_dir = arg.substr(13);
+    } else if (arg == "--status-dir" && i + 1 < argc) {
+      status_dir = argv[++i];
+    } else if (arg == "--oneshot") {
+      oneshot = true;
+    } else {
+      fprintf(stderr,
+              "usage: tpu-exporter [--port N] [--status-dir DIR] [--oneshot]\n");
+      return 2;
+    }
+  }
+  if (const char* env_dir = getenv("STATUS_DIR")) {
+    if (status_dir == kDefaultStatusDir && env_dir[0] != '\0') status_dir = env_dir;
+  }
+  if (oneshot) {
+    fputs(RenderMetrics(status_dir).c_str(), stdout);
+    return 0;
+  }
+  return Serve(port, status_dir);
+}

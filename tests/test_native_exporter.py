@@ -1,0 +1,132 @@
+"""Build and drive the native tpu-exporter binary (native/tpu-exporter)."""
+
+import json
+import os
+import shutil
+import socket
+import subprocess
+import time
+import urllib.request
+
+import pytest
+
+from tpu_operator.validator.metrics import NodeMetrics, find_exporter_binary
+from tpu_operator.validator.status import StatusFiles
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SRC_DIR = os.path.join(REPO, "native", "tpu-exporter")
+
+pytestmark = pytest.mark.skipif(shutil.which("g++") is None, reason="no C++ toolchain")
+
+
+@pytest.fixture(scope="session")
+def exporter_bin(tmp_path_factory):
+    build = tmp_path_factory.mktemp("tpu-exporter-build")
+    subprocess.run(["make", "-C", SRC_DIR, f"BUILD={build}"], check=True,
+                   capture_output=True)
+    return str(build / "tpu-exporter")
+
+
+@pytest.fixture
+def status_dir(tmp_path, monkeypatch):
+    d = tmp_path / "validations"
+    monkeypatch.setenv("TPU_DEV_GLOBS", str(tmp_path / "none*"))
+    status = StatusFiles(str(d))
+    status.write("driver", {"libtpu_version": "2025.1.0"})
+    status.write("perf", {"mxu_tflops": 200.5, "hbm_gbps": 700.25,
+                          "ici_allreduce_gbps": 0.0, "passed": True})
+    return str(d)
+
+
+def parse_metrics(text: str) -> dict:
+    out = {}
+    for line in text.splitlines():
+        if line and not line.startswith("#"):
+            name, _, value = line.partition(" ")
+            out[name] = float(value)
+    return out
+
+
+def test_oneshot_gauges(exporter_bin, status_dir):
+    env = dict(os.environ)
+    proc = subprocess.run(
+        [exporter_bin, "--oneshot", f"--status-dir={status_dir}"],
+        capture_output=True, text=True, env=env)
+    assert proc.returncode == 0
+    gauges = parse_metrics(proc.stdout)
+    assert gauges["tpu_operator_node_driver_ready"] == 1
+    assert gauges["tpu_operator_node_plugin_ready"] == 0
+    assert gauges["tpu_operator_node_workload_ready"] == 0
+    assert gauges["tpu_operator_node_mxu_tflops"] == 200.5
+    assert gauges["tpu_operator_node_hbm_gbps"] == 700.25
+    assert gauges["tpu_operator_node_tpu_device_nodes"] == 0
+    assert gauges["tpu_operator_node_metrics_last_refresh_ts_seconds"] > 0
+
+
+def test_http_server(exporter_bin, status_dir):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    proc = subprocess.Popen(
+        [exporter_bin, f"--port={port}", f"--status-dir={status_dir}"],
+        env=dict(os.environ), stderr=subprocess.PIPE)
+    try:
+        payload = None
+        for _ in range(50):
+            try:
+                payload = urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=1).read().decode()
+                break
+            except OSError:
+                time.sleep(0.1)
+        assert payload, "exporter never came up"
+        gauges = parse_metrics(payload)
+        assert gauges["tpu_operator_node_driver_ready"] == 1
+        assert gauges["tpu_operator_node_mxu_tflops"] == 200.5
+        health = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=1).read()
+        assert health == b"ok\n"
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/other", timeout=1)
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+
+
+def test_metric_name_parity_with_python(exporter_bin, status_dir):
+    """Native and Python exporters must emit the same metric names so the
+    shipped PrometheusRules work against either."""
+    proc = subprocess.run(
+        [exporter_bin, "--oneshot", f"--status-dir={status_dir}"],
+        capture_output=True, text=True, env=dict(os.environ))
+    native_names = set(parse_metrics(proc.stdout))
+
+    m = NodeMetrics(status=StatusFiles(status_dir))
+    m.refresh()
+    python_names = {line.split(" ")[0] for line in m.scrape().decode().splitlines()
+                    if line and not line.startswith("#")}
+    assert native_names == python_names
+
+
+def test_find_exporter_binary_env_toggle(monkeypatch, exporter_bin):
+    monkeypatch.setenv("TPU_EXPORTER_BIN", exporter_bin)
+    assert find_exporter_binary() == exporter_bin
+    monkeypatch.setenv("TPU_NATIVE_EXPORTER", "0")
+    assert find_exporter_binary() is None
+
+
+def test_metric_name_parity_without_perf(exporter_bin, tmp_path, monkeypatch):
+    """Parity must hold in the common case too: perf validation never ran."""
+    monkeypatch.setenv("TPU_DEV_GLOBS", str(tmp_path / "none*"))
+    d = str(tmp_path / "validations")
+    proc = subprocess.run(
+        [exporter_bin, "--oneshot", f"--status-dir={d}"],
+        capture_output=True, text=True, env=dict(os.environ))
+    native = parse_metrics(proc.stdout)
+    assert native["tpu_operator_node_mxu_tflops"] == 0
+
+    m = NodeMetrics(status=StatusFiles(d))
+    m.refresh()
+    python_names = {line.split(" ")[0] for line in m.scrape().decode().splitlines()
+                    if line and not line.startswith("#")}
+    assert set(native) == python_names

@@ -268,3 +268,16 @@ def test_feature_discovery_prefers_driver_record(fake_devs, monkeypatch, tmp_pat
     StatusFiles(str(tmp_path)).write("driver", {"libtpu_version": "2025.2.0"})
     labels = feature_discovery.discover(use_jax=False)
     assert labels[consts.TPU_LIBTPU_VERSION_LABEL] == "2025.2.0"
+
+
+def test_driver_validate_preserves_libtpu_version(tmp_path, status, fake_devs, monkeypatch):
+    """Re-validation (the -c driver init container) must not clobber the
+    installer daemon's pinned-version record — feature discovery labels
+    nodes from it."""
+    src = tmp_path / "src-libtpu.so"
+    src.write_bytes(b"\x7fELF bundled")
+    monkeypatch.setenv("LIBTPU_SRC", str(src))
+    install = tmp_path / "install"
+    assert driver_mod.install(str(install), "2025.3.0", status)
+    assert driver_mod.validate(str(install), status)
+    assert status.read("driver")["libtpu_version"] == "2025.3.0"

@@ -78,7 +78,13 @@ def validate(install_dir: str, status: Optional[StatusFiles] = None,
     if require_devices and not devices:
         log.error("driver validation failed: no TPU device nodes")
         return False
-    status.write("driver", {"libtpu": so, "devices": devices})
+    record = {"libtpu": so, "devices": devices}
+    # the installer daemon recorded the pinned libtpu version here; preserve
+    # it across re-validation (feature discovery labels nodes from it)
+    previous = status.read("driver") or {}
+    if "libtpu_version" in previous:
+        record["libtpu_version"] = previous["libtpu_version"]
+    status.write("driver", record)
     log.info("driver validation ok: %s, %d device nodes", so, len(devices))
     return True
 
@@ -87,17 +93,9 @@ def find_probe_binary() -> Optional[str]:
     """Locate the native tpu-probe binary (native/tpu-probe): ~1 ms per exec
     vs ~1 s of Python startup — the difference matters for kubelet exec
     probes firing every few seconds across a fleet."""
-    explicit = os.environ.get("TPU_PROBE_BIN")
-    if explicit and os.access(explicit, os.X_OK):
-        return explicit
-    found = shutil.which("tpu-probe")
-    if found:
-        return found
-    repo_local = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__)))), "native", "tpu-probe", "build", "tpu-probe")
-    if os.access(repo_local, os.X_OK):
-        return repo_local
-    return None
+    from .native import find_native_binary
+
+    return find_native_binary("tpu-probe", "TPU_PROBE_BIN")
 
 
 def probe(install_dir: str, require_devices: bool = True) -> bool:

@@ -183,7 +183,8 @@ def run(argv=None, client=None) -> int:
     if component == "metrics":
         from . import metrics
 
-        return metrics.serve(args.port, refresh_interval=min(args.sleep_interval, 60.0))
+        return metrics.serve(args.port, refresh_interval=min(args.sleep_interval, 60.0),
+                             status_dir=args.status_dir)
 
     if component == "telemetry":
         from . import telemetry

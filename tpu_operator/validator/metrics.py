@@ -4,6 +4,7 @@ node-local status files into Prometheus gauges, refreshed periodically."""
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -37,22 +38,61 @@ class NodeMetrics:
         self.last_refresh = Gauge("tpu_operator_node_metrics_last_refresh_ts_seconds",
                                   "Timestamp of the last metrics refresh",
                                   registry=self.registry)
+        # measured throughput from the perf validation barrier (0 until run)
+        self.perf = {
+            key: Gauge(f"tpu_operator_node_{key}", help_text,
+                       registry=self.registry)
+            for key, help_text in (
+                ("mxu_tflops",
+                 "Measured MXU throughput (bf16 TFLOP/s) from perf validation"),
+                ("hbm_gbps",
+                 "Measured HBM bandwidth (GB/s) from perf validation"),
+                ("ici_allreduce_gbps",
+                 "Measured ICI allreduce bus bandwidth (GB/s) from perf validation"),
+            )
+        }
 
     def refresh(self) -> None:
         for component, gauge in self.ready.items():
             gauge.set(1 if self.status.is_ready(component) else 0)
         self.device_nodes.set(len(discover_devices()))
+        perf = self.status.read("perf") or {}
+        for key, gauge in self.perf.items():
+            value = perf.get(key)
+            # reset to 0 when the barrier is cleared (e.g. during an
+            # upgrade re-validation) so stale throughput never looks current
+            gauge.set(value if isinstance(value, (int, float)) else 0)
         self.last_refresh.set(time.time())
 
     def scrape(self) -> bytes:
         return generate_latest(self.registry)
 
 
+def find_exporter_binary() -> Optional[str]:
+    """Locate the native tpu-exporter (native/tpu-exporter) — the compiled
+    implementation of this server (DCGM-hostengine analog). Same delegation
+    pattern as tpu-probe; TPU_NATIVE_EXPORTER=0 disables."""
+    from .native import find_native_binary
+
+    return find_native_binary("tpu-exporter", "TPU_EXPORTER_BIN",
+                              disable_env="TPU_NATIVE_EXPORTER")
+
+
 def serve(port: int, metrics: Optional[NodeMetrics] = None,
           refresh_interval: float = REFRESH_INTERVAL,
           ready_event: Optional[threading.Event] = None,
-          stop_event: Optional[threading.Event] = None) -> int:
-    metrics = metrics or NodeMetrics()
+          stop_event: Optional[threading.Event] = None,
+          status_dir: Optional[str] = None) -> int:
+    if metrics is None and ready_event is None and stop_event is None:
+        binary = find_exporter_binary()
+        if binary:
+            log.info("delegating to native exporter %s", binary)
+            args = [binary, f"--port={port}"]
+            if status_dir:
+                args.append(f"--status-dir={status_dir}")
+            os.execv(binary, args)
+    metrics = metrics or NodeMetrics(
+        status=StatusFiles(status_dir) if status_dir else None)
     metrics.refresh()
     stop = stop_event or threading.Event()
 

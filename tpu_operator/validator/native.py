@@ -1,0 +1,30 @@
+"""Locating the in-repo native binaries (tpu-probe, tpu-exporter).
+
+Resolution order: explicit env override > $PATH > repo-local build dir —
+shared by every delegation site so the policy can't drift per binary.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Optional
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def find_native_binary(name: str, env_override: str,
+                       disable_env: Optional[str] = None) -> Optional[str]:
+    if disable_env and os.environ.get(disable_env) == "0":
+        return None
+    explicit = os.environ.get(env_override)
+    if explicit and os.access(explicit, os.X_OK):
+        return explicit
+    found = shutil.which(name)
+    if found:
+        return found
+    repo_local = os.path.join(_REPO_ROOT, "native", name, "build", name)
+    if os.access(repo_local, os.X_OK):
+        return repo_local
+    return None
