@@ -199,3 +199,84 @@ def test_upgrade_reconciler_enabled_progresses_and_requeues(fake_client):
     assert node_upgrade_state(fake_client.get("v1", "Node", "tpu-0")) == m.UPGRADE_REQUIRED
     scraped = r.metrics.scrape().decode()
     assert "tpu_operator_nodes_upgrades_pending 1.0" in scraped
+
+
+def mk_tpudriver(name, selector, auto_upgrade):
+    return {"apiVersion": "tpu.ai/v1alpha1", "kind": "TPUDriver",
+            "metadata": {"name": name},
+            "spec": {"nodeSelector": selector,
+                     "upgradePolicy": {"autoUpgrade": auto_upgrade}}}
+
+
+def test_tpudriver_upgrade_policy_governs_its_pool(fake_client):
+    """A TPUDriver instance's upgradePolicy applies to the nodes it selects,
+    independent of the ClusterPolicy's (reference only supports the global
+    policy; per-pool policies bound blast radius per hardware generation)."""
+    setup(fake_client, n_nodes=2)
+    # tpu-1 belongs to a TPUDriver pool with autoUpgrade on; ClusterPolicy off
+    node = fake_client.get("v1", "Node", "tpu-1")
+    node["metadata"]["labels"]["pool"] = "v5e"
+    fake_client.update(node)
+    fake_client.create(new_cluster_policy())  # autoUpgrade defaults false
+    fake_client.create(mk_tpudriver("v5e", {"pool": "v5e"}, True))
+
+    r = UpgradeReconciler(fake_client, requeue_after=60.0)
+    result = r.reconcile(SINGLETON_REQUEST)
+    assert result.requeue_after == 60.0
+    # pool node progresses, ClusterPolicy-governed node stays clear
+    assert node_upgrade_state(fake_client.get("v1", "Node", "tpu-1")) == m.UPGRADE_REQUIRED
+    assert node_upgrade_state(fake_client.get("v1", "Node", "tpu-0")) == m.UNKNOWN
+
+
+def test_tpudriver_upgrade_policy_off_clears_its_pool(fake_client):
+    """Inverse split: ClusterPolicy rolls its nodes while a TPUDriver pool
+    with autoUpgrade off stays untouched (and stale labels get cleared)."""
+    setup(fake_client, n_nodes=2)
+    node = fake_client.get("v1", "Node", "tpu-1")
+    node["metadata"]["labels"]["pool"] = "frozen"
+    node["metadata"]["labels"][consts.UPGRADE_STATE_LABEL] = m.UPGRADE_REQUIRED
+    fake_client.update(node)
+    fake_client.create(new_cluster_policy(spec={
+        "driver": {"upgradePolicy": {"autoUpgrade": True}}}))
+    fake_client.create(mk_tpudriver("frozen", {"pool": "frozen"}, False))
+
+    r = UpgradeReconciler(fake_client)
+    r.reconcile(SINGLETON_REQUEST)
+    assert node_upgrade_state(fake_client.get("v1", "Node", "tpu-0")) == m.UPGRADE_REQUIRED
+    assert node_upgrade_state(fake_client.get("v1", "Node", "tpu-1")) == m.UNKNOWN
+
+
+def test_conflicted_tpudriver_does_not_capture_nodes(fake_client):
+    """An instance the TPUDriver controller rejects (selector conflict) must
+    not pull nodes out of ClusterPolicy governance — otherwise creating a
+    bad CR would cancel in-flight upgrades."""
+    setup(fake_client, n_nodes=1)
+    fake_client.create(new_cluster_policy(spec={
+        "driver": {"upgradePolicy": {"autoUpgrade": True}}}))
+    # two instances claim the same node: both are conflict-rejected
+    sel = {consts.GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice"}
+    fake_client.create(mk_tpudriver("a", sel, False))
+    fake_client.create(mk_tpudriver("b", sel, False))
+
+    r = UpgradeReconciler(fake_client)
+    r.reconcile(SINGLETON_REQUEST)
+    # node stays under the ClusterPolicy policy and starts the upgrade
+    assert node_upgrade_state(fake_client.get("v1", "Node", "tpu-0")) == m.UPGRADE_REQUIRED
+
+
+def test_frozen_pool_counts_as_available(fake_client):
+    setup(fake_client, n_nodes=3)
+    for name in ("tpu-1", "tpu-2"):
+        node = fake_client.get("v1", "Node", name)
+        node["metadata"]["labels"]["pool"] = "frozen"
+        fake_client.update(node)
+    fake_client.create(new_cluster_policy(spec={
+        "driver": {"upgradePolicy": {"autoUpgrade": True}}}))
+    fake_client.create(mk_tpudriver("frozen", {"pool": "frozen"}, False))
+
+    r = UpgradeReconciler(fake_client)
+    r.reconcile(SINGLETON_REQUEST)
+    scraped = r.metrics.scrape().decode()
+    # 1 pending (ClusterPolicy node) + 2 frozen-but-healthy = available
+    assert "tpu_operator_nodes_upgrades_pending 1.0" in scraped
+    assert "tpu_operator_nodes_upgrades_available 2.0" in scraped

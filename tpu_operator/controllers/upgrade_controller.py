@@ -48,25 +48,68 @@ class UpgradeReconciler(Reconciler):
     def _tpu_nodes(self) -> List[dict]:
         return [n for n in self.client.list("v1", "Node") if is_tpu_node(n)]
 
+    def _group_nodes(self, nodes: List[dict]):
+        """Partition nodes by the upgrade policy that governs them: nodes
+        selected by a TPUDriver instance follow that instance's
+        spec.upgradePolicy (blast radius bounded per pool); the rest follow
+        the ClusterPolicy's driver.upgradePolicy. Instances are
+        conflict-validated, so at most one selects any node."""
+        from ..api.tpudriver import TPUDriver
+        from ..state.skel import node_matches_selector
+        from .tpudriver_controller import find_selector_conflicts
+
+        instances = [TPUDriver.from_obj(d)
+                     for d in self.client.list("tpu.ai/v1alpha1", "TPUDriver")]
+        # mirror the TPUDriver controller's admission rules: instances with
+        # invalid specs or conflicting selectors render nothing there, so
+        # they must not capture nodes away from ClusterPolicy governance here
+        conflicted = {name for names in
+                      find_selector_conflicts(instances, nodes).values()
+                      for name in names}
+        instances = [inst for inst in instances
+                     if inst.name not in conflicted and not inst.spec.validate()]
+        groups = [(inst.spec.upgrade_policy, []) for inst in instances]
+        selectors = [inst.spec.get_node_selector() for inst in instances]
+        rest: List[dict] = []
+        for node in nodes:
+            for (policy, members), selector in zip(groups, selectors):
+                if node_matches_selector(node, selector):
+                    members.append(node)
+                    break
+            else:
+                rest.append(node)
+        return groups, rest
+
     def reconcile(self, request: Request) -> Result:
         policy = self._policy()
         nodes = self._tpu_nodes()
-        machine = UpgradeStateMachine(
-            self.client, self.namespace,
-            policy.spec.driver.upgrade_policy if policy else None)
+        groups, rest = self._group_nodes(nodes)
+        groups.append((policy.spec.driver.upgrade_policy if policy else None, rest))
 
-        if policy is None or not policy.spec.driver.upgrade_policy.auto_upgrade:
-            machine.clear_all(nodes)
+        total = None
+        cleared = 0
+        for group_policy, members in groups:
+            machine = UpgradeStateMachine(self.client, self.namespace, group_policy)
+            if group_policy is None or not group_policy.auto_upgrade:
+                machine.clear_all(members)
+                cleared += len(members)
+                continue
+            counts = machine.process(members)
+            total = counts if total is None else total.merged(counts)
+
+        if total is None:  # no group has autoUpgrade on
             return Result()
-
-        counts = machine.process(nodes)
-        self.metrics.upgrades_pending.set(counts.pending)
-        self.metrics.upgrades_in_progress.set(counts.in_progress)
-        self.metrics.upgrades_done.set(counts.done)
-        self.metrics.upgrades_failed.set(counts.failed)
-        self.metrics.upgrades_available.set(counts.available)
-        if counts.pending or counts.in_progress:
-            log.info("upgrade sweep: %s", counts.as_dict())
+        # frozen-pool nodes are healthy and schedulable; without this the
+        # available gauge undercounts whenever one pool upgrades while
+        # another sits at autoUpgrade=false
+        total.available += cleared
+        self.metrics.upgrades_pending.set(total.pending)
+        self.metrics.upgrades_in_progress.set(total.in_progress)
+        self.metrics.upgrades_done.set(total.done)
+        self.metrics.upgrades_failed.set(total.failed)
+        self.metrics.upgrades_available.set(total.available)
+        if total.pending or total.in_progress:
+            log.info("upgrade sweep: %s", total.as_dict())
         return Result(requeue_after=self.requeue_after)
 
 
@@ -84,6 +127,7 @@ def setup_upgrade_controller(client: Client, reconciler: UpgradeReconciler) -> C
         return []
 
     controller.watches("tpu.ai/v1", "ClusterPolicy", singleton)
+    controller.watches("tpu.ai/v1alpha1", "TPUDriver", singleton)
     controller.watches("v1", "Node", singleton)
     controller.watches("v1", "Pod", map_pod)
     controller.resyncs(lambda: [SINGLETON_REQUEST], period=30.0)

@@ -77,6 +77,11 @@ class UpgradeStateCounts:
     def as_dict(self) -> Dict[str, int]:
         return dataclasses.asdict(self)
 
+    def merged(self, other: "UpgradeStateCounts") -> "UpgradeStateCounts":
+        return UpgradeStateCounts(**{
+            field.name: getattr(self, field.name) + getattr(other, field.name)
+            for field in dataclasses.fields(self)})
+
 
 class UpgradeStateMachine:
     def __init__(self, client: Client, namespace: str,
