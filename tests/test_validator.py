@@ -281,3 +281,42 @@ def test_driver_validate_preserves_libtpu_version(tmp_path, status, fake_devs, m
     assert driver_mod.install(str(install), "2025.3.0", status)
     assert driver_mod.validate(str(install), status)
     assert status.read("driver")["libtpu_version"] == "2025.3.0"
+
+
+# -- info (nvidia-smi analog) -------------------------------------------------
+
+def test_info_reports_stack_state(tmp_path, status, fake_devs, monkeypatch, capsys):
+    from tpu_operator.validator import info as info_mod
+
+    monkeypatch.setenv("TPU_INFO_SKIP_JAX", "1")
+    install = tmp_path / "libtpu"
+    install.mkdir()
+    (install / "libtpu.so").write_bytes(b"\x7fELF x")
+    status.write("driver", {"libtpu_version": "2025.1.0"})
+    status.write("perf", {"mxu_tflops": 200.0, "hbm_gbps": 700.0,
+                          "ici_allreduce_gbps": 0.0})
+
+    data = info_mod.collect(str(install), status=status)
+    assert data["libtpu"]["valid"] is True
+    assert data["libtpu"]["version"] == "2025.1.0"
+    assert data["validations"]["driver"] is True
+    assert data["validations"]["workload"] is False
+    assert data["perf"]["mxu_tflops"] == 200.0
+    assert len(data["device_nodes"]) == 4
+
+    text = info_mod.render(data)
+    assert "2025.1.0" in text and "MXU 200 TFLOP/s" in text
+    assert "driver=ok" in text and "workload=--" in text
+
+
+def test_info_cli_exit_codes(tmp_path, fake_devs, monkeypatch, capsys):
+    monkeypatch.setenv("TPU_INFO_SKIP_JAX", "1")
+    monkeypatch.setenv("STATUS_DIR", str(tmp_path / "v"))
+    install = tmp_path / "libtpu"
+    install.mkdir()
+    # missing libtpu -> unhealthy exit, like nvidia-smi on a broken node
+    assert validator_run(["-c", "info", f"--install-dir={install}"]) == 1
+    (install / "libtpu.so").write_bytes(b"\x7fELF x")
+    assert validator_run(["-c", "info", f"--install-dir={install}", "--json"]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["libtpu"]["valid"] is True

@@ -1,0 +1,107 @@
+"""``tpu-validator -c info``: the node operator's at-a-glance tool.
+
+The TPU stack's answer to ``nvidia-smi`` (which the reference leans on for
+probes and humans alike): one command that shows what this node has and how
+far through validation it is — chips, device nodes, the installed libtpu,
+barrier status, and measured throughput if perf validation has run.
+``--json`` emits the same data machine-readable.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+from .. import consts
+from .driver import discover_devices, is_valid_libtpu, libtpu_path
+from .status import StatusFiles
+
+CHECK = "ok"
+MISS = "--"
+
+
+def collect(install_dir: str = consts.DEFAULT_LIBTPU_DIR,
+            status: Optional[StatusFiles] = None,
+            use_jax: bool = True) -> dict:
+    status = status or StatusFiles(
+        os.environ.get("STATUS_DIR", consts.VALIDATION_STATUS_DIR))
+    info: dict = {
+        "device_nodes": discover_devices(),
+        "libtpu": {"path": libtpu_path(install_dir),
+                   "valid": is_valid_libtpu(libtpu_path(install_dir))},
+        "chips": [],
+        "validations": {c: status.is_ready(c)
+                        for c in ("driver", "plugin", "workload", "perf")},
+    }
+    driver_record = status.read("driver") or {}
+    if driver_record.get("libtpu_version"):
+        info["libtpu"]["version"] = driver_record["libtpu_version"]
+    perf = status.read("perf") or {}
+    if perf:
+        info["perf"] = {k: perf.get(k, 0.0) for k in
+                        ("mxu_tflops", "hbm_gbps", "ici_allreduce_gbps")}
+    if use_jax and os.environ.get("TPU_INFO_SKIP_JAX") != "1":
+        try:
+            import jax
+
+            for d in jax.local_devices():
+                if d.platform != "tpu":
+                    continue
+                chip = {"id": d.id, "kind": d.device_kind}
+                try:
+                    stats = d.memory_stats() or {}
+                    if "bytes_in_use" in stats:
+                        chip["hbm_used_bytes"] = stats["bytes_in_use"]
+                    limit = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
+                    if limit:
+                        chip["hbm_total_bytes"] = limit
+                except Exception:
+                    pass
+                info["chips"].append(chip)
+        except Exception:
+            pass  # no runtime in this container: device nodes still shown
+    return info
+
+
+def _gib(n: float) -> str:
+    return f"{n / (1 << 30):.1f}"
+
+
+def render(info: dict) -> str:
+    lines = ["tpu-info"]
+    chips = info["chips"]
+    if chips:
+        kind = chips[0].get("kind", "tpu")
+        lines.append(f"  chips:        {len(chips)} x {kind}")
+        for chip in chips:
+            if "hbm_total_bytes" in chip:
+                used = chip.get("hbm_used_bytes", 0)
+                lines.append(
+                    f"    chip {chip['id']}: HBM {_gib(used)}/"
+                    f"{_gib(chip['hbm_total_bytes'])} GiB")
+    else:
+        lines.append(f"  chips:        {len(info['device_nodes'])} (device nodes; "
+                     "no libtpu runtime in this process)")
+    lines.append("  device nodes: " + (", ".join(info["device_nodes"]) or "none"))
+    libtpu = info["libtpu"]
+    version = f" ({libtpu['version']})" if libtpu.get("version") else ""
+    state = "ok" if libtpu["valid"] else "MISSING"
+    lines.append(f"  libtpu:       {libtpu['path']}{version} [{state}]")
+    marks = "  ".join(f"{c}={CHECK if ready else MISS}"
+                      for c, ready in info["validations"].items())
+    lines.append(f"  validations:  {marks}")
+    if "perf" in info:
+        p = info["perf"]
+        ici = f"{p['ici_allreduce_gbps']:.0f} GB/s" if p.get("ici_allreduce_gbps") else MISS
+        lines.append(f"  perf:         MXU {p['mxu_tflops']:.0f} TFLOP/s · "
+                     f"HBM {p['hbm_gbps']:.0f} GB/s · ICI {ici}")
+    return "\n".join(lines)
+
+
+def run(install_dir: str, as_json: bool = False) -> int:
+    info = collect(install_dir)
+    print(json.dumps(info) if as_json else render(info))
+    # exit status mirrors nvidia-smi: nonzero when the stack is unhealthy
+    return 0 if info["libtpu"]["valid"] and (
+        info["chips"] or info["device_nodes"]) else 1

@@ -14,6 +14,8 @@ workload-multihost  slice-wide sweep after jax.distributed rendezvous
 perf                measured MXU TFLOP/s, HBM GB/s, ICI allreduce GB/s;
                     optional floors turn it into a gate (no reference
                     analog — DCGM diag is functional-only)
+info                at-a-glance node status (the nvidia-smi analog):
+                    chips, device nodes, libtpu, barriers, perf
 wait                block on another component's barrier (--for)
 sleep               validator DS main container: idle heartbeat
 metrics             node-status exporter (status files -> Prometheus)
@@ -44,7 +46,9 @@ def build_parser() -> argparse.ArgumentParser:
                             "workload", "workload-local", "workload-multihost",
                             "perf", "wait", "sleep", "metrics", "telemetry",
                             "feature-discovery", "slice-partitioner",
-                            "device-plugin", "cdi"])
+                            "device-plugin", "cdi", "info"])
+    p.add_argument("--json", action="store_true",
+                   help="info: machine-readable output")
     p.add_argument("--cdi-dir", default="/etc/cdi")
     p.add_argument("--install-dir", default=consts.DEFAULT_LIBTPU_DIR)
     p.add_argument("--libtpu-version", default=None)
@@ -150,6 +154,11 @@ def run(argv=None, client=None) -> int:
         if report.passed:
             status.write("workload", report.to_dict())
         return 0 if report.passed else 1
+
+    if component == "info":
+        from . import info
+
+        return info.run(args.install_dir, as_json=args.json)
 
     if component == "perf":
         from .perf import run_perf
