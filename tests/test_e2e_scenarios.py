@@ -207,9 +207,16 @@ def test_tpudriver_e2e_over_wire(cluster):
     wait_for(tpudriver_ready, message="TPUDriver ready")
     live = client.get("tpu.ai/v1alpha1", "TPUDriver", "main")
     assert live["status"]["pools"] == {"v5-lite-podslice-2x4": 2, "v5-lite-podslice-4x4": 1}
-    # ClusterPolicy's own driver DS has been handed over + cleaned up
-    with pytest.raises(NotFoundError):
-        client.get("apps/v1", "DaemonSet", "libtpu-driver", "tpu-operator")
+    # ClusterPolicy's own driver DS has been handed over + cleaned up; the
+    # deletion happens in the ClusterPolicy controller's *next* sweep, not
+    # the one that flipped TPUDriver ready, so poll rather than assert
+    def base_ds_gone():
+        try:
+            client.get("apps/v1", "DaemonSet", "libtpu-driver", "tpu-operator")
+        except NotFoundError:
+            return True
+        return False
+    wait_for(base_ds_gone, message="base driver DS handover cleanup")
     # update rolls the per-pool DSes
     live["spec"]["version"] = "2.0"
     client.update(live)

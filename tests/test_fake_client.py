@@ -139,3 +139,16 @@ def test_cluster_scoped_objects(fake_client):
     fake_client.create({"apiVersion": "v1", "kind": "Node", "metadata": {"name": "n1"}})
     got = fake_client.get("v1", "Node", "n1")
     assert "namespace" not in got["metadata"] or not got["metadata"].get("namespace")
+
+
+def test_unregistered_kind_raises_distinct_kind_error(fake_client):
+    """A typo'd kind must surface as KindNotServedError — which the many
+    `except NotFoundError` (= object absent) sites do NOT swallow — so
+    programming errors stay loud (ADVICE r1: scheme.py:36)."""
+    from tpu_operator.client import KindNotServedError
+
+    with pytest.raises(KindNotServedError):
+        fake_client.get("tpu.ai/v1", "ClusterPolcy", "x")  # note the typo
+    assert not issubclass(KindNotServedError, NotFoundError)
+    # ...but it still carries the API-server-compatible 404 code
+    assert KindNotServedError.code == 404

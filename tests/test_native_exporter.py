@@ -130,3 +130,17 @@ def test_metric_name_parity_without_perf(exporter_bin, tmp_path, monkeypatch):
     python_names = {line.split(" ")[0] for line in m.scrape().decode().splitlines()
                     if line and not line.startswith("#")}
     assert set(native) == python_names
+
+
+def test_exec_failure_falls_back_to_python(tmp_path, monkeypatch):
+    """A binary that passes the X_OK check but cannot exec (wrong arch /
+    exec-format error) must not kill the metrics component — serve() falls
+    through to the in-process exporter (ADVICE r1: metrics.py:93)."""
+    from tpu_operator.validator.metrics import _exec_native_exporter
+
+    bogus = tmp_path / "tpu-exporter"
+    bogus.write_bytes(b"\x00not-an-elf\x00")
+    bogus.chmod(0o755)
+    monkeypatch.setenv("TPU_EXPORTER_BIN", str(bogus))
+    # find_exporter_binary() accepts it; execv raises ENOEXEC; we return
+    _exec_native_exporter(port=0)

@@ -135,6 +135,46 @@ def test_failed_driver_pod_marks_failed(fake_client):
     assert node_upgrade_state(fake_client.get("v1", "Node", "tpu-0")) == m.FAILED
 
 
+def _drive_to_failed(fake_client):
+    sm = machine(fake_client)
+    sm.process(fresh_nodes(fake_client))
+    sm.process(fresh_nodes(fake_client))
+    fake_client.create(mk_pod("drv-0-new", "tpu-0", "tpu-driver", "img:2",
+                              phase="Failed", ready=False))
+    sm.process(fresh_nodes(fake_client))
+    assert node_upgrade_state(fake_client.get("v1", "Node", "tpu-0")) == m.FAILED
+    return sm
+
+
+def test_failed_node_recovers_when_driver_pods_healthy(fake_client):
+    """upgrade-failed is not a terminal trap: once the DS controller replaces
+    the crashed pod with a healthy one matching the template, the node
+    re-validates and uncordons through the normal chain."""
+    setup(fake_client)
+    sm = _drive_to_failed(fake_client)
+    fake_client.delete("v1", "Pod", "drv-0-new", NS)
+    fake_client.create(mk_pod("drv-0-fresh", "tpu-0", "tpu-driver", "img:2"))
+    counts = sm.process(fresh_nodes(fake_client))
+    node = fake_client.get("v1", "Node", "tpu-0")
+    assert node_upgrade_state(node) == m.DONE
+    assert not node["spec"].get("unschedulable")
+    assert counts.done == 1
+
+
+def test_failed_node_retries_on_new_rollout(fake_client):
+    """A new driver rollout supersedes a failed attempt: the FAILED node
+    re-enters the upgrade chain instead of ignoring the new version."""
+    setup(fake_client)
+    sm = _drive_to_failed(fake_client)
+    ds = fake_client.get("apps/v1", "DaemonSet", "libtpu-driver", NS)
+    ds["spec"]["template"]["spec"]["containers"][0]["image"] = "img:3"
+    fake_client.update(ds)
+    counts = sm.process(fresh_nodes(fake_client))
+    state = node_upgrade_state(fake_client.get("v1", "Node", "tpu-0"))
+    assert state in m.IN_PROGRESS_STATES
+    assert counts.in_progress == 1 and counts.failed == 0
+
+
 def test_skip_drain_label(fake_client):
     setup(fake_client)
     node = fake_client.get("v1", "Node", "tpu-0")
@@ -262,6 +302,76 @@ def test_conflicted_tpudriver_does_not_capture_nodes(fake_client):
     r.reconcile(SINGLETON_REQUEST)
     # node stays under the ClusterPolicy policy and starts the upgrade
     assert node_upgrade_state(fake_client.get("v1", "Node", "tpu-0")) == m.UPGRADE_REQUIRED
+
+
+def test_no_clusterpolicy_clears_all_nodes_even_tpudriver_pools(fake_client):
+    """Without a ClusterPolicy the TPUDriver controller refuses to render any
+    driver, so instance upgrade policies must not label/cordon nodes — the
+    upgrade controller mirrors that admission rule and clears everything
+    (ADVICE r1: upgrade_controller.py:87)."""
+    setup(fake_client, n_nodes=2)
+    node = fake_client.get("v1", "Node", "tpu-1")
+    node["metadata"]["labels"]["pool"] = "v5e"
+    node["metadata"]["labels"][consts.UPGRADE_STATE_LABEL] = m.CORDON_REQUIRED
+    node["spec"]["unschedulable"] = True
+    fake_client.update(node)
+    fake_client.create(mk_tpudriver("v5e", {"pool": "v5e"}, True))
+    # no ClusterPolicy exists
+
+    r = UpgradeReconciler(fake_client)
+    result = r.reconcile(SINGLETON_REQUEST)
+    assert result.requeue_after is None
+    for name in ("tpu-0", "tpu-1"):
+        node = fake_client.get("v1", "Node", name)
+        assert node_upgrade_state(node) == m.UNKNOWN, name
+        assert not node["spec"].get("unschedulable"), name
+
+
+def test_frozen_pool_unhealthy_node_not_counted_available(fake_client):
+    """A frozen pool (autoUpgrade=false) node whose last recorded state was
+    upgrade-failed is not healthy and must not inflate the availability gauge
+    (ADVICE r1: upgrade_controller.py:105) — and the exclusion must hold on
+    every subsequent sweep, not just the first: freezing a pool preserves the
+    failed label instead of laundering it away."""
+    setup(fake_client, n_nodes=3)
+    for name, state in (("tpu-1", m.FAILED), ("tpu-2", m.UNKNOWN)):
+        node = fake_client.get("v1", "Node", name)
+        node["metadata"]["labels"]["pool"] = "frozen"
+        if state:
+            node["metadata"]["labels"][consts.UPGRADE_STATE_LABEL] = state
+        fake_client.update(node)
+    fake_client.create(new_cluster_policy(spec={
+        "driver": {"upgradePolicy": {"autoUpgrade": True}}}))
+    fake_client.create(mk_tpudriver("frozen", {"pool": "frozen"}, False))
+
+    r = UpgradeReconciler(fake_client)
+    for _ in range(2):  # stable across sweeps, not transiently correct
+        r.reconcile(SINGLETON_REQUEST)
+        scraped = r.metrics.scrape().decode()
+        # only the settled frozen node counts; the failed one stays failed
+        assert "tpu_operator_nodes_upgrades_available 1.0" in scraped
+        assert "tpu_operator_nodes_upgrades_failed 1.0" in scraped
+    node = fake_client.get("v1", "Node", "tpu-1")
+    assert node_upgrade_state(node) == m.FAILED
+
+
+def test_policy_deletion_zeroes_gauges(fake_client):
+    """Deleting the ClusterPolicy mid-upgrade must not leave stale gauge
+    values: the next sweep clears all node state and publishes zeros."""
+    setup(fake_client)
+    fake_client.create(new_cluster_policy(spec={
+        "driver": {"upgradePolicy": {"autoUpgrade": True}}}))
+    r = UpgradeReconciler(fake_client)
+    r.reconcile(SINGLETON_REQUEST)
+    assert "tpu_operator_nodes_upgrades_pending 1.0" in r.metrics.scrape().decode()
+
+    fake_client.delete("tpu.ai/v1", "ClusterPolicy", "cluster-policy")
+    r.reconcile(SINGLETON_REQUEST)
+    scraped = r.metrics.scrape().decode()
+    assert "tpu_operator_nodes_upgrades_pending 0.0" in scraped
+    # the cleared node is schedulable: still counted available, not dropped
+    assert "tpu_operator_nodes_upgrades_available 1.0" in scraped
+    assert node_upgrade_state(fake_client.get("v1", "Node", "tpu-0")) == m.UNKNOWN
 
 
 def test_frozen_pool_counts_as_available(fake_client):

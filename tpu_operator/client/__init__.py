@@ -1,4 +1,4 @@
-from .errors import ApiError, ConflictError, NotFoundError
+from .errors import ApiError, ConflictError, KindNotServedError, NotFoundError
 from .interface import Client, WatchEvent
 from .fake import FakeClient
 from .scheme import Scheme, default_scheme
@@ -6,6 +6,7 @@ from .scheme import Scheme, default_scheme
 __all__ = [
     "ApiError",
     "ConflictError",
+    "KindNotServedError",
     "NotFoundError",
     "Client",
     "WatchEvent",

@@ -16,6 +16,19 @@ class NotFoundError(ApiError):
     code = 404
 
 
+class KindNotServedError(ApiError):
+    """A (apiVersion, kind) pair is not registered in the scheme at all.
+
+    Deliberately NOT a NotFoundError subclass: the many `except NotFoundError`
+    sites mean "this object is absent", and a typo'd kind must stay loud there
+    instead of silently no-oping. Only the optional-API-group paths in
+    state/skel.py treat this as tolerable (alongside a server-side 404 for a
+    registered-but-uninstalled CRD group).
+    """
+
+    code = 404
+
+
 class ConflictError(ApiError):
     code = 409
 

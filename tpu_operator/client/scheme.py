@@ -11,7 +11,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Dict, Tuple
 
-from .errors import NotFoundError
+from .errors import KindNotServedError
 
 
 @dataclass(frozen=True)
@@ -32,8 +32,10 @@ class Scheme:
             return self._kinds[(api_version, kind)]
         except KeyError:
             # a real apiserver answers 404 for an unserved group/kind (e.g.
-            # optional CRDs like monitoring.coreos.com not installed)
-            raise NotFoundError(f"kind not registered in scheme: {api_version}/{kind}")
+            # optional CRDs like monitoring.coreos.com not installed); the
+            # distinct type keeps typo'd kinds loud at `except NotFoundError`
+            # sites that mean "object absent"
+            raise KindNotServedError(f"kind not registered in scheme: {api_version}/{kind}")
 
     def is_namespaced(self, api_version: str, kind: str) -> bool:
         return self.info(api_version, kind).namespaced

@@ -14,6 +14,7 @@ from ..api.clusterpolicy import ClusterPolicy
 from ..client.interface import Client, WatchEvent
 from ..nodeinfo import is_tpu_node
 from ..upgrade import UpgradeStateMachine
+from ..upgrade.machine import UpgradeStateCounts
 from ..utils import deep_get
 from .metrics import OperatorMetrics
 from .runtime import Controller, Reconciler, Request, Result
@@ -83,34 +84,52 @@ class UpgradeReconciler(Reconciler):
     def reconcile(self, request: Request) -> Result:
         policy = self._policy()
         nodes = self._tpu_nodes()
-        groups, rest = self._group_nodes(nodes)
-        groups.append((policy.spec.driver.upgrade_policy if policy else None, rest))
+        if policy is None:
+            # mirror the TPUDriver controller's admission rule fully: without
+            # a ClusterPolicy no driver is ever rendered, so TPUDriver
+            # instance upgrade policies must not label/cordon nodes either —
+            # every node is ungoverned and gets cleared (failed labels too:
+            # they describe upgrades of a driver that no longer exists)
+            machine = UpgradeStateMachine(self.client, self.namespace, None)
+            # every node comes back settled and uncordoned — published as
+            # available so the gauge keeps meaning "schedulable TPU nodes"
+            # whether or not a policy object exists
+            self._publish(machine.clear_all(nodes))
+            return Result()
 
-        total = None
-        cleared = 0
+        groups, rest = self._group_nodes(nodes)
+        groups.append((policy.spec.driver.upgrade_policy, rest))
+
+        total = UpgradeStateCounts()
+        any_governed = False
         for group_policy, members in groups:
             machine = UpgradeStateMachine(self.client, self.namespace, group_policy)
             if group_policy is None or not group_policy.auto_upgrade:
-                machine.clear_all(members)
-                cleared += len(members)
+                # frozen pool: upgrade-failed nodes keep their label and stay
+                # in the failed gauge (freezing must not launder a broken
+                # driver); everything else is cleared + uncordoned =
+                # available. clear_all reports what it did, so the gauges
+                # can't drift from the preservation rule.
+                total = total.merged(machine.clear_all(members, preserve_failed=True))
                 continue
-            counts = machine.process(members)
-            total = counts if total is None else total.merged(counts)
+            any_governed = True
+            total = total.merged(machine.process(members))
 
-        if total is None:  # no group has autoUpgrade on
+        # gauges are published on every sweep, even when nothing is governed,
+        # so a deleted policy or freshly-frozen pool never leaves stale values
+        self._publish(total)
+        if not any_governed:
             return Result()
-        # frozen-pool nodes are healthy and schedulable; without this the
-        # available gauge undercounts whenever one pool upgrades while
-        # another sits at autoUpgrade=false
-        total.available += cleared
+        if total.pending or total.in_progress:
+            log.info("upgrade sweep: %s", total.as_dict())
+        return Result(requeue_after=self.requeue_after)
+
+    def _publish(self, total: UpgradeStateCounts) -> None:
         self.metrics.upgrades_pending.set(total.pending)
         self.metrics.upgrades_in_progress.set(total.in_progress)
         self.metrics.upgrades_done.set(total.done)
         self.metrics.upgrades_failed.set(total.failed)
         self.metrics.upgrades_available.set(total.available)
-        if total.pending or total.in_progress:
-            log.info("upgrade sweep: %s", total.as_dict())
-        return Result(requeue_after=self.requeue_after)
 
 
 def setup_upgrade_controller(client: Client, reconciler: UpgradeReconciler) -> Controller:

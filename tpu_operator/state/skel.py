@@ -15,7 +15,7 @@ import logging
 from typing import Dict, List, Optional
 
 from .. import consts
-from ..client.errors import ConflictError, NotFoundError
+from ..client.errors import ConflictError, KindNotServedError, NotFoundError
 from ..client.interface import Client
 from ..utils import deep_get, object_hash
 
@@ -128,10 +128,11 @@ class StateSkel:
         for obj in objs:
             try:
                 applied.append(self._apply_one(copy.deepcopy(obj), owner))
-            except NotFoundError:
-                # a create bouncing 404 means the resource kind itself is not
-                # served (e.g. no prometheus-operator CRDs) — tolerable only
-                # for optional groups
+            except (NotFoundError, KindNotServedError):
+                # a create bouncing 404 (server-side) or an unregistered kind
+                # (scheme-side) means the resource kind itself is not served
+                # (e.g. no prometheus-operator CRDs) — tolerable only for
+                # optional groups
                 if not _is_optional_group(obj.get("apiVersion", "")):
                     raise
                 log.info("state %s: skipping %s/%s (API group not served)",
@@ -212,12 +213,15 @@ class StateSkel:
                 self.client.delete(obj["apiVersion"], obj["kind"], meta["name"], meta.get("namespace"))
             except NotFoundError:
                 pass
+            except KindNotServedError:
+                if not _is_optional_group(obj.get("apiVersion", "")):
+                    raise
 
     def list_owned(self, api_version: str, kind: str, namespace: Optional[str] = None) -> List[dict]:
         try:
             return self.client.list(api_version, kind, namespace,
                                     label_selector={consts.STATE_LABEL: self.name})
-        except NotFoundError:
+        except (NotFoundError, KindNotServedError):
             if _is_optional_group(api_version):
                 return []  # resource kind not served: nothing owned
             raise

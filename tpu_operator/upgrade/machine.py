@@ -193,6 +193,30 @@ class UpgradeStateMachine:
                 self._set_state(node, UNKNOWN)
             return UNKNOWN
 
+        if state == FAILED:
+            # automated recovery paths out of upgrade-failed (without these
+            # the state is a terminal trap and the only escape is manual
+            # label surgery):
+            #  - the DS template rolled again (new image supersedes the
+            #    failed attempt) -> retry the upgrade from the top
+            #  - the node's driver pods now match the template and are ready
+            #    (DS controller replaced the crashed pod / admin fixed the
+            #    image) -> re-validate, then uncordon via the normal chain
+            if ds and driver_pods and any(self._pod_outdated(p, ds) for p in driver_pods):
+                self._set_state(node, UPGRADE_REQUIRED)
+                state = UPGRADE_REQUIRED  # throttle applies below
+            elif driver_pods and not any(
+                    deep_get(p, "status", "phase") == "Failed" for p in driver_pods):
+                from ..state.skel import is_pod_ready
+
+                if all(is_pod_ready(p) for p in driver_pods):
+                    self._set_state(node, VALIDATION_REQUIRED)
+                    state = VALIDATION_REQUIRED  # falls to the gate below
+                else:
+                    return FAILED
+            else:
+                return FAILED
+
         if state == UPGRADE_REQUIRED:
             if in_progress >= max_parallel:
                 return state  # throttled (reference maxParallelUpgrades)
@@ -275,10 +299,29 @@ class UpgradeStateMachine:
 
         return state
 
-    def clear_all(self, nodes: List[dict]) -> None:
+    def clear_all(self, nodes: List[dict], preserve_failed: bool = False) -> UpgradeStateCounts:
         """Remove upgrade labels (autoUpgrade disabled; reference
-        removeNodeUpgradeStateLabels, upgrade_controller.go:202)."""
+        removeNodeUpgradeStateLabels, upgrade_controller.go:202).
+
+        With ``preserve_failed`` (frozen pools), a node at upgrade-failed
+        keeps its label and cordon: freezing a pool must not launder a broken
+        driver into an available-looking node — the failure stays visible
+        until an admin intervenes or the pool is re-enabled and the FAILED
+        recovery branch in `_process_node` resolves it.
+
+        Returns the counts for what this pass actually did — preserved nodes
+        as ``failed``, everything else (cleared + uncordoned = schedulable)
+        as ``available`` — so callers publish gauges from a single source of
+        truth instead of re-deriving the preservation rule."""
+        counts = UpgradeStateCounts()
         for node in nodes:
-            if node_upgrade_state(node) != UNKNOWN:
-                self._cordon(node, False)
-                self._set_state(node, UNKNOWN)
+            state = node_upgrade_state(node)
+            if preserve_failed and state == FAILED:
+                counts.failed += 1
+                continue
+            counts.available += 1
+            if state == UNKNOWN:
+                continue
+            self._cordon(node, False)
+            self._set_state(node, UNKNOWN)
+        return counts

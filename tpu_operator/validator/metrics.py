@@ -78,19 +78,33 @@ def find_exporter_binary() -> Optional[str]:
                               disable_env="TPU_NATIVE_EXPORTER")
 
 
+def _exec_native_exporter(port: int, status_dir: Optional[str] = None) -> None:
+    """Replace this process with the native exporter if one is usable.
+
+    Returns (instead of exec'ing) when no binary is found or exec fails —
+    e.g. exec-format error on a wrong-arch build that still passed the
+    X_OK check — so the caller keeps serving metrics from Python."""
+    binary = find_exporter_binary()
+    if not binary:
+        return
+    log.info("delegating to native exporter %s", binary)
+    args = [binary, f"--port={port}"]
+    if status_dir:
+        args.append(f"--status-dir={status_dir}")
+    try:
+        os.execv(binary, args)
+    except OSError as e:
+        log.warning("native exporter exec failed (%s); "
+                    "falling back to in-process server", e)
+
+
 def serve(port: int, metrics: Optional[NodeMetrics] = None,
           refresh_interval: float = REFRESH_INTERVAL,
           ready_event: Optional[threading.Event] = None,
           stop_event: Optional[threading.Event] = None,
           status_dir: Optional[str] = None) -> int:
     if metrics is None and ready_event is None and stop_event is None:
-        binary = find_exporter_binary()
-        if binary:
-            log.info("delegating to native exporter %s", binary)
-            args = [binary, f"--port={port}"]
-            if status_dir:
-                args.append(f"--status-dir={status_dir}")
-            os.execv(binary, args)
+        _exec_native_exporter(port, status_dir)
     metrics = metrics or NodeMetrics(
         status=StatusFiles(status_dir) if status_dir else None)
     metrics.refresh()
