@@ -96,8 +96,32 @@ def _encode(value):
     return value
 
 
-def spec_field(default=None, key: str | None = None, **kw):
-    metadata = {"key": key} if key else {}
+def spec_field(default=None, key: str | None = None, doc: str | None = None,
+               enum=None, minimum=None, maximum=None, pattern: str | None = None,
+               schema: Dict[str, Any] | None = None, **kw):
+    """Declare a CRD spec field.
+
+    Beyond serde (``key`` overrides the camelCase name), fields carry their
+    OpenAPI validation facts — description, enum, bounds, pattern, or a raw
+    ``schema`` override — the way the reference carries kubebuilder markers
+    on Go struct tags (api/nvidia/v1/clusterpolicy_types.go:129-130). The
+    schema generator (schema_gen.py) compiles these plus the Python type
+    into the CRD's openAPIV3Schema, so types and schema cannot drift.
+    """
+    metadata: Dict[str, Any] = {"key": key} if key else {}
+    sch: Dict[str, Any] = dict(schema or {})
+    if doc is not None:
+        sch["description"] = doc
+    if enum is not None:
+        sch["enum"] = list(enum)
+    if minimum is not None:
+        sch["minimum"] = minimum
+    if maximum is not None:
+        sch["maximum"] = maximum
+    if pattern is not None:
+        sch["pattern"] = pattern
+    if sch:
+        metadata["schema"] = sch
     if callable(default):
         return dataclasses.field(default_factory=default, metadata=metadata, **kw)
     return dataclasses.field(default=default, metadata=metadata, **kw)
