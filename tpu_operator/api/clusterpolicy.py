@@ -22,6 +22,7 @@ from .common import (
     SpecValidationError,
     UpgradePolicySpec,
 )
+from .k8s_schemas import CONFIGMAP_REF, INIT_CONTAINER, SERVICE_MONITOR
 from .specbase import SpecBase, spec_field
 
 CLUSTER_POLICY_API_VERSION = "tpu.ai/v1"
@@ -38,11 +39,19 @@ class State:
 
 @dataclasses.dataclass
 class OperatorSpec(SpecBase):
-    default_runtime: str = "containerd"
-    runtime_class: str = "tpu"
-    init_container: Optional[Dict[str, Any]] = None
-    labels: Dict[str, str] = spec_field(dict)
-    annotations: Dict[str, str] = spec_field(dict)
+    """Operator-wide settings (reference OperatorSpec)."""
+
+    default_runtime: str = spec_field(
+        "containerd", doc="Container runtime of the cluster nodes.",
+        enum=("containerd", "docker", "crio"))
+    runtime_class: str = spec_field(
+        "tpu", doc="RuntimeClass name stamped on operand pods.")
+    init_container: Optional[Dict[str, Any]] = spec_field(
+        None, schema=INIT_CONTAINER)
+    labels: Dict[str, str] = spec_field(
+        dict, doc="Extra labels for operator-managed objects.")
+    annotations: Dict[str, str] = spec_field(
+        dict, doc="Extra annotations for operator-managed objects.")
     extra: Dict[str, Any] = spec_field(dict)
 
     def validate(self, path: str = "spec.operator") -> List[str]:
@@ -57,8 +66,14 @@ class DriverSpec(ComponentSpec):
 
     DEFAULT_IMAGE_ENV: str = dataclasses.field(default="DRIVER_IMAGE", repr=False)
 
-    libtpu_version: Optional[str] = None
-    install_dir: str = "/home/kubernetes/bin/libtpu"
+    libtpu_version: Optional[str] = spec_field(
+        None, doc="libtpu build to install (defaults to the image's "
+                  "bundled version).",
+        pattern=r"^[a-zA-Z0-9._+-]+$")
+    install_dir: str = spec_field(
+        "/home/kubernetes/bin/libtpu",
+        doc="Host directory the driver installer writes libtpu into.",
+        pattern=r"^/.*$")
     upgrade_policy: UpgradePolicySpec = spec_field(UpgradePolicySpec)
 
     def validate(self, path: str = "spec.driver") -> List[str]:
@@ -67,15 +82,23 @@ class DriverSpec(ComponentSpec):
 
 @dataclasses.dataclass
 class DevicePluginSpec(ComponentSpec):
+    """Kubelet device plugin advertising TPU chips to the scheduler."""
+
     DEFAULT_IMAGE_ENV: str = dataclasses.field(default="DEVICE_PLUGIN_IMAGE", repr=False)
 
     #: extended resource advertised to the scheduler
-    resource_name: str = "google.com/tpu"
+    resource_name: str = spec_field(
+        "google.com/tpu",
+        doc="Extended resource name advertised to the scheduler.",
+        pattern=r"^[a-z0-9.-]+/[a-zA-Z0-9._-]+$")
     #: True (default): run the in-repo plugin (``tpu-validator -c
     #: device-plugin``); False: the image's own entrypoint serves the
     #: kubelet API (external device-plugin images)
-    builtin_plugin: bool = True
-    config: Optional[Dict[str, Any]] = None  # {"name": <ConfigMap>, "default": <key>}
+    builtin_plugin: bool = spec_field(
+        True, doc="Run the operator's built-in kubelet device plugin; "
+                  "false delegates to the image's own entrypoint.")
+    config: Optional[Dict[str, Any]] = spec_field(
+        None, schema=CONFIGMAP_REF)
 
 
 @dataclasses.dataclass
@@ -84,7 +107,8 @@ class FeatureDiscoverySpec(ComponentSpec):
 
     DEFAULT_IMAGE_ENV: str = dataclasses.field(default="FEATURE_DISCOVERY_IMAGE", repr=False)
 
-    sleep_interval: str = "60s"
+    sleep_interval: str = spec_field(
+        "60s", doc="Re-label interval.", pattern=r"^[0-9]+(ms|s|m|h)$")
 
 
 @dataclasses.dataclass
@@ -93,19 +117,28 @@ class TelemetrySpec(ComponentSpec):
 
     DEFAULT_IMAGE_ENV: str = dataclasses.field(default="TELEMETRY_EXPORTER_IMAGE", repr=False)
 
-    service_monitor: Optional[Dict[str, Any]] = None
-    metrics_port: int = 9400
+    service_monitor: Optional[Dict[str, Any]] = spec_field(
+        None, schema=SERVICE_MONITOR)
+    metrics_port: int = spec_field(
+        9400, doc="Port the exporter serves /metrics on.",
+        minimum=1, maximum=65535)
 
 
 @dataclasses.dataclass
 class NodeStatusExporterSpec(ComponentSpec):
+    """Per-node validation-status exporter (node-status-exporter analog)."""
+
     DEFAULT_IMAGE_ENV: str = dataclasses.field(default="VALIDATOR_IMAGE", repr=False)
 
-    metrics_port: int = 8000
+    metrics_port: int = spec_field(
+        8000, doc="Port the node-status exporter serves /metrics on.",
+        minimum=1, maximum=65535)
 
 
 @dataclasses.dataclass
 class ValidatorComponentEnv(SpecBase):
+    """Extra env for one validator sub-component's container."""
+
     env: List[EnvVar] = spec_field(list)
     extra: Dict[str, Any] = spec_field(dict)
 
@@ -128,7 +161,8 @@ class SlicePartitionerSpec(ComponentSpec):
 
     DEFAULT_IMAGE_ENV: str = dataclasses.field(default="SLICE_PARTITIONER_IMAGE", repr=False)
 
-    config: Optional[Dict[str, Any]] = None  # {"name": <ConfigMap>, "default": <key>}
+    config: Optional[Dict[str, Any]] = spec_field(
+        None, schema=CONFIGMAP_REF)
 
     def is_enabled(self, default: bool = False) -> bool:
         # opt-in, like MIG in the reference
@@ -137,13 +171,20 @@ class SlicePartitionerSpec(ComponentSpec):
 
 @dataclasses.dataclass
 class CDISpec(SpecBase):
-    enabled: bool = False
-    default: bool = False
+    """Container Device Interface spec generation (reference CDIConfigSpec)."""
+
+    enabled: bool = spec_field(
+        False, doc="Generate CDI specs for TPU devices.")
+    default: bool = spec_field(
+        False, doc="Use CDI as the default device-injection mechanism.")
     extra: Dict[str, Any] = spec_field(dict)
 
 
 @dataclasses.dataclass
 class ClusterPolicySpec(SpecBase):
+    """Desired state of the cluster's TPU software stack: one sub-spec
+    per operand."""
+
     operator: OperatorSpec = spec_field(OperatorSpec)
     daemonsets: DaemonsetsSpec = spec_field(DaemonsetsSpec)
     driver: DriverSpec = spec_field(DriverSpec)

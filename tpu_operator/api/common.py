@@ -14,6 +14,12 @@ import os
 import re
 from typing import Any, Dict, List, Optional
 
+from .k8s_schemas import (
+    ENV_VALUE_FROM,
+    RESOURCE_REQUIREMENTS,
+    ROLLING_UPDATE,
+    TOLERATIONS,
+)
 from .specbase import SpecBase, spec_field
 
 
@@ -23,25 +29,61 @@ class SpecValidationError(ValueError):
 
 _IMAGE_RE = re.compile(r"^[a-z0-9]+([._/:@-][a-zA-Z0-9._-]+)*$")
 
+#: image name / repository / version validation patterns for the CRD schema
+#: (reference kubebuilder markers on Repository/Image/Version fields,
+#: api/nvidia/v1/clusterpolicy_types.go)
+IMAGE_PATTERN = r"^[a-z0-9]+([._/:@-][a-zA-Z0-9._-]+)*$"
+VERSION_PATTERN = r"^[a-zA-Z0-9._@:+-]+$"
+REPOSITORY_PATTERN = r"^[a-zA-Z0-9._:/-]+$"
+
 
 @dataclasses.dataclass
 class EnvVar(SpecBase):
-    name: str = ""
-    value: Optional[str] = None
+    """Environment variable injected into the operand container."""
+
+    name: str = spec_field("", doc="Variable name.", required=True,
+                           pattern=r"^[-._a-zA-Z][-._a-zA-Z0-9]*$")
+    value: Optional[str] = spec_field(None, doc="Literal value.")
+    value_from: Optional[Dict[str, Any]] = spec_field(
+        None, doc="Source for the value (k8s core/v1 EnvVarSource).",
+        schema=ENV_VALUE_FROM)
     extra: Dict[str, Any] = spec_field(dict)
+
+    def to_k8s(self) -> Dict[str, Any]:
+        """Render as a k8s container env entry, preserving valueFrom."""
+        if self.value_from is not None:
+            return {"name": self.name, "valueFrom": self.value_from}
+        return {"name": self.name, "value": self.value or ""}
 
 
 @dataclasses.dataclass
 class ComponentSpec(SpecBase):
-    enabled: Optional[bool] = None
-    repository: Optional[str] = None
-    image: Optional[str] = None
-    version: Optional[str] = None
-    image_pull_policy: str = "IfNotPresent"
-    image_pull_secrets: List[str] = spec_field(list)
-    env: List[EnvVar] = spec_field(list)
-    args: List[str] = spec_field(list)
-    resources: Optional[Dict[str, Any]] = None
+    """Common operand knobs: enable switch, image coordinates, env, args,
+    resources (reference per-operand spec pattern,
+    api/nvidia/v1/clusterpolicy_types.go:41-97)."""
+
+    enabled: Optional[bool] = spec_field(
+        None, doc="Deploy this operand. Unset means the operand default "
+                  "(on for core operands, off for opt-in ones).")
+    repository: Optional[str] = spec_field(
+        None, doc="Image registry/repository prefix.",
+        pattern=REPOSITORY_PATTERN)
+    image: Optional[str] = spec_field(
+        None, doc="Image name (without repository or tag).",
+        pattern=IMAGE_PATTERN)
+    version: Optional[str] = spec_field(
+        None, doc="Image tag or sha256: digest.", pattern=VERSION_PATTERN)
+    image_pull_policy: str = spec_field(
+        "IfNotPresent", doc="Image pull policy for the operand pods.",
+        enum=("Always", "IfNotPresent", "Never"))
+    image_pull_secrets: List[str] = spec_field(
+        list, doc="Names of image pull Secrets in the operator namespace.")
+    env: List[EnvVar] = spec_field(
+        list, doc="Extra environment variables for the operand container.")
+    args: List[str] = spec_field(
+        list, doc="Extra command-line arguments for the operand container.")
+    resources: Optional[Dict[str, Any]] = spec_field(
+        None, schema=RESOURCE_REQUIREMENTS)
     extra: Dict[str, Any] = spec_field(dict)
 
     #: env var consulted when the CR does not pin an image (subclass override)
@@ -85,12 +127,21 @@ class ComponentSpec(SpecBase):
 class DaemonsetsSpec(SpecBase):
     """Cluster-wide DaemonSet defaults (reference DaemonsetsSpec)."""
 
-    update_strategy: str = "RollingUpdate"
-    rolling_update: Optional[Dict[str, Any]] = None
-    priority_class_name: str = "system-node-critical"
-    tolerations: List[Dict[str, Any]] = spec_field(list)
-    labels: Dict[str, str] = spec_field(dict)
-    annotations: Dict[str, str] = spec_field(dict)
+    update_strategy: str = spec_field(
+        "RollingUpdate", doc="DaemonSet update strategy for all operands.",
+        enum=("RollingUpdate", "OnDelete"))
+    rolling_update: Optional[Dict[str, Any]] = spec_field(
+        None, schema=ROLLING_UPDATE)
+    priority_class_name: str = spec_field(
+        "system-node-critical",
+        doc="PriorityClass assigned to every operand pod.")
+    tolerations: List[Dict[str, Any]] = spec_field(
+        list, doc="Tolerations applied to every operand pod.",
+        schema=TOLERATIONS)
+    labels: Dict[str, str] = spec_field(
+        dict, doc="Extra labels stamped on every operand pod.")
+    annotations: Dict[str, str] = spec_field(
+        dict, doc="Extra annotations stamped on every operand pod.")
     extra: Dict[str, Any] = spec_field(dict)
 
     def validate(self, path: str = "spec.daemonsets") -> List[str]:
@@ -101,26 +152,52 @@ class DaemonsetsSpec(SpecBase):
 
 @dataclasses.dataclass
 class DrainSpec(SpecBase):
-    enable: bool = False
-    force: bool = False
-    pod_selector: str = ""
-    timeout_seconds: int = 300
-    delete_empty_dir: bool = False
+    """Node-drain behavior during driver upgrade (reference DrainSpec)."""
+
+    enable: bool = spec_field(
+        False, doc="Evict workload pods from the node before upgrading.")
+    force: bool = spec_field(
+        False, doc="After timeoutSeconds, delete pods that refused "
+                   "eviction (bypasses PodDisruptionBudgets).")
+    pod_selector: str = spec_field(
+        "", doc="Only drain pods matching this label selector "
+                "(empty = all TPU workload pods).")
+    timeout_seconds: int = spec_field(
+        300, doc="Eviction budget before giving up or forcing.",
+        minimum=0)
+    delete_empty_dir: bool = spec_field(
+        False, doc="Drain even pods using emptyDir volumes "
+                   "(their local data is lost).")
     extra: Dict[str, Any] = spec_field(dict)
 
 
 @dataclasses.dataclass
 class PodDeletionSpec(SpecBase):
-    force: bool = False
-    timeout_seconds: int = 300
-    delete_empty_dir: bool = False
+    """Deletion behavior for pods consuming the TPU resource
+    (reference PodDeletionSpec)."""
+
+    force: bool = spec_field(
+        False, doc="After timeoutSeconds, delete pods that refused "
+                   "eviction (bypasses PodDisruptionBudgets).")
+    timeout_seconds: int = spec_field(
+        300, doc="Eviction budget before giving up or forcing.",
+        minimum=0)
+    delete_empty_dir: bool = spec_field(
+        False, doc="Delete even pods using emptyDir volumes.")
     extra: Dict[str, Any] = spec_field(dict)
 
 
 @dataclasses.dataclass
 class WaitForCompletionSpec(SpecBase):
-    pod_selector: str = ""
-    timeout_seconds: int = 0
+    """Wait for selected workload jobs to finish before upgrading a node
+    (reference WaitForCompletionSpec)."""
+
+    pod_selector: str = spec_field(
+        "", doc="Label selector for jobs/pods that must complete before "
+                "the node upgrade proceeds.")
+    timeout_seconds: int = spec_field(
+        0, doc="Seconds to wait for completion before escalating; "
+               "0 waits forever.", minimum=0)
     extra: Dict[str, Any] = spec_field(dict)
 
 
@@ -129,9 +206,15 @@ class UpgradePolicySpec(SpecBase):
     """Rolling-upgrade knobs (reference DriverUpgradePolicySpec via
     k8s-operator-libs; consumed by our upgrade state machine)."""
 
-    auto_upgrade: bool = False
-    max_parallel_upgrades: int = 1
-    max_unavailable: Optional[str] = "25%"
+    auto_upgrade: bool = spec_field(
+        False, doc="Enable automatic rolling upgrade when the driver "
+                   "spec changes.")
+    max_parallel_upgrades: int = spec_field(
+        1, doc="Nodes upgraded simultaneously; 0 = unlimited.", minimum=0)
+    max_unavailable: Optional[str] = spec_field(
+        "25%", doc="Ceiling on simultaneously-unavailable nodes, absolute "
+                   "or percentage.",
+        pattern=r"^([0-9]+|[0-9]+%)$")
     wait_for_completion: WaitForCompletionSpec = spec_field(WaitForCompletionSpec)
     pod_deletion: PodDeletionSpec = spec_field(PodDeletionSpec)
     drain: DrainSpec = spec_field(DrainSpec)

@@ -40,7 +40,7 @@ class SpecBase:
         kwargs = {}
         consumed = set()
         for f in dataclasses.fields(cls):
-            if f.name == "extra":
+            if f.name == "extra" or not f.repr:
                 continue
             key = f.metadata.get("key", to_camel(f.name))
             if key not in data:
@@ -58,7 +58,9 @@ class SpecBase:
     def to_dict(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {}
         for f in dataclasses.fields(self):
-            if f.name == "extra":
+            # repr=False marks internal fields (e.g. DEFAULT_IMAGE_ENV) that
+            # must never be serialized into the CR or counted in the schema
+            if f.name == "extra" or not f.repr:
                 continue
             value = getattr(self, f.name)
             if value is None:
@@ -98,7 +100,8 @@ def _encode(value):
 
 def spec_field(default=None, key: str | None = None, doc: str | None = None,
                enum=None, minimum=None, maximum=None, pattern: str | None = None,
-               schema: Dict[str, Any] | None = None, **kw):
+               schema: Dict[str, Any] | None = None, required: bool = False,
+               **kw):
     """Declare a CRD spec field.
 
     Beyond serde (``key`` overrides the camelCase name), fields carry their
@@ -109,6 +112,8 @@ def spec_field(default=None, key: str | None = None, doc: str | None = None,
     into the CRD's openAPIV3Schema, so types and schema cannot drift.
     """
     metadata: Dict[str, Any] = {"key": key} if key else {}
+    if required:
+        metadata["required"] = True
     sch: Dict[str, Any] = dict(schema or {})
     if doc is not None:
         sch["description"] = doc

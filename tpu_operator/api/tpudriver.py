@@ -15,6 +15,7 @@ import dataclasses
 from typing import Any, Dict, List, Optional
 
 from .common import ComponentSpec, SpecValidationError, UpgradePolicySpec
+from .k8s_schemas import NODE_AFFINITY, TOLERATIONS
 from .specbase import SpecBase, spec_field
 
 TPU_DRIVER_API_VERSION = "tpu.ai/v1alpha1"
@@ -29,17 +30,36 @@ DRIVER_TYPES = ("standard",)  # reference has gpu/vgpu/vgpu-host-manager; TPU ha
 
 @dataclasses.dataclass
 class TPUDriverSpec(ComponentSpec):
+    """Desired libtpu driver deployment for one node pool."""
+
     DEFAULT_IMAGE_ENV: str = dataclasses.field(default="DRIVER_IMAGE", repr=False)
 
-    driver_type: str = "standard"
-    libtpu_version: Optional[str] = None
-    install_dir: str = "/home/kubernetes/bin/libtpu"
-    node_selector: Dict[str, str] = spec_field(dict)
-    labels: Dict[str, str] = spec_field(dict)
-    annotations: Dict[str, str] = spec_field(dict)
-    tolerations: List[Dict[str, Any]] = spec_field(list)
-    node_affinity: Optional[Dict[str, Any]] = None
-    priority_class_name: str = "system-node-critical"
+    driver_type: str = spec_field(
+        "standard", doc="Driver flavor; TPU has a single standard flavor "
+                        "(reference has gpu/vgpu/vgpu-host-manager).",
+        enum=DRIVER_TYPES)
+    libtpu_version: Optional[str] = spec_field(
+        None, doc="libtpu build to install on the selected pool.",
+        pattern=r"^[a-zA-Z0-9._+-]+$")
+    install_dir: str = spec_field(
+        "/home/kubernetes/bin/libtpu",
+        doc="Host directory the driver installer writes libtpu into.",
+        pattern=r"^/.*$")
+    node_selector: Dict[str, str] = spec_field(
+        dict, doc="Nodes this driver instance manages; empty selects every "
+                  "TPU node (tpu.ai/tpu.present=true).")
+    labels: Dict[str, str] = spec_field(
+        dict, doc="Extra labels for this instance's DaemonSets.")
+    annotations: Dict[str, str] = spec_field(
+        dict, doc="Extra annotations for this instance's DaemonSets.")
+    tolerations: List[Dict[str, Any]] = spec_field(
+        list, doc="Tolerations for this instance's driver pods.",
+        schema=TOLERATIONS)
+    node_affinity: Optional[Dict[str, Any]] = spec_field(
+        None, schema=NODE_AFFINITY)
+    priority_class_name: str = spec_field(
+        "system-node-critical",
+        doc="PriorityClass assigned to the driver pods.")
     upgrade_policy: UpgradePolicySpec = spec_field(UpgradePolicySpec)
 
     def get_node_selector(self) -> Dict[str, str]:

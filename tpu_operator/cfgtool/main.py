@@ -63,12 +63,27 @@ SAMPLE_TPU_DRIVER = {
 
 
 def validate_doc(doc: dict) -> list:
+    """Schema + semantic (spec.validate) validation of one CR document.
+
+    The schema pass runs the same generated openAPIV3Schema a real
+    apiserver would enforce, so `tpuop-cfg validate` catches typo'd field
+    names and enum/bound violations before anything touches a cluster
+    (reference cmd/gpuop-cfg validates against the generated CRD types).
+    Schema errors short-circuit: a type-mangled doc (e.g. env as a string)
+    can't be loaded into the spec dataclasses for the semantic pass."""
+    from ..api import schema_gen, schema_validate
+
     kind = doc.get("kind")
     if kind == CLUSTER_POLICY_KIND:
-        return ClusterPolicy.from_obj(doc).spec.validate()
-    if kind == TPU_DRIVER_KIND:
-        return TPUDriver.from_obj(doc).spec.validate()
-    return [f"unsupported kind {kind!r} (expected ClusterPolicy or TPUDriver)"]
+        typed, crd = ClusterPolicy, schema_gen.clusterpolicy_crd()
+    elif kind == TPU_DRIVER_KIND:
+        typed, crd = TPUDriver, schema_gen.tpudriver_crd()
+    else:
+        return [f"unsupported kind {kind!r} (expected ClusterPolicy or TPUDriver)"]
+    errors = schema_validate.validate_cr(doc, crd)
+    if errors:
+        return errors
+    return typed.from_obj(doc).spec.validate()
 
 
 def validate_csv(path: str) -> int:

@@ -29,6 +29,12 @@ class KindNotServedError(ApiError):
     code = 404
 
 
+class InvalidError(ApiError):
+    """Object rejected by CRD schema validation (apiserver 422 Invalid)."""
+
+    code = 422
+
+
 class ConflictError(ApiError):
     code = 409
 

@@ -87,7 +87,7 @@ class StateDriver:
                 "image_pull_secrets": driver.image_pull_secrets,
                 "install_dir": driver.install_dir,
                 "libtpu_version": o.libtpu_version or driver.libtpu_version,
-                "env": [{"name": e.name, "value": e.value} for e in driver.env],
+                "env": [e.to_k8s() for e in driver.env],
                 "resources": driver.resources,
             },
         }

@@ -37,7 +37,7 @@ def component_data(component: ComponentSpec) -> dict:
         "image": component.image_path(),
         "image_pull_policy": component.image_pull_policy,
         "image_pull_secrets": component.image_pull_secrets,
-        "env": [{"name": e.name, "value": e.value} for e in component.env],
+        "env": [e.to_k8s() for e in component.env],
         "args": list(component.args),
         "resources": component.resources,
     }
@@ -157,9 +157,9 @@ def slice_partitioner_extras(policy: ClusterPolicy) -> dict:
 def validator_extras(policy: ClusterPolicy) -> dict:
     v = policy.spec.validator
     return {
-        "driver_env": [{"name": e.name, "value": e.value} for e in v.driver.env],
-        "plugin_env": [{"name": e.name, "value": e.value} for e in v.plugin.env],
-        "workload_env": [{"name": e.name, "value": e.value} for e in v.workload.env],
+        "driver_env": [e.to_k8s() for e in v.driver.env],
+        "plugin_env": [e.to_k8s() for e in v.plugin.env],
+        "workload_env": [e.to_k8s() for e in v.workload.env],
         "resource_name": policy.spec.device_plugin.resource_name,
         "install_dir": policy.spec.driver.install_dir,
     }
