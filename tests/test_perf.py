@@ -30,6 +30,92 @@ def test_perf_thresholds_gate():
     assert report.passed
 
 
+def test_report_carries_device_identity():
+    report = run_perf(**TINY)
+    assert report.device_kind != ""        # "cpu" on the test mesh
+    assert report.accumulation == "fp32"   # documented measurement mode
+    d = report.to_dict()
+    for key in ("device_kind", "chip", "mxu_peak_fraction",
+                "hbm_peak_fraction", "measurement_valid"):
+        assert key in d
+
+
+def test_lookup_peaks():
+    from tpu_operator.validator.perf import lookup_peaks
+    assert lookup_peaks("TPU v5 lite") == ("v5e", 197.0, 819.0)
+    assert lookup_peaks("TPU v5p") == ("v5p", 459.0, 2765.0)
+    assert lookup_peaks("TPU v4") == ("v4", 275.0, 1228.0)
+    assert lookup_peaks("TPU v6 lite") == ("v6e", 918.0, 1640.0)
+    assert lookup_peaks("cpu") is None
+
+
+def test_over_peak_reading_fails_gate(monkeypatch):
+    """A >105%-of-peak reading is a measurement bug, never a pass
+    (VERDICT r1 weak-#1: BENCH_r01 reported 118% of v5e HBM peak)."""
+    from tpu_operator.validator import perf
+
+    monkeypatch.setattr(perf, "measure_mxu_tflops",
+                        lambda *a, **k: (500.0, True, 1.0))   # 254% of v5e
+    monkeypatch.setattr(perf, "measure_hbm_gbps",
+                        lambda *a, **k: (963.0, True))        # 118% of v5e
+    monkeypatch.setattr(perf, "measure_ici_allreduce_gbps",
+                        lambda *a, **k: (0.0, True))
+    monkeypatch.setattr(perf, "lookup_peaks",
+                        lambda kind: ("v5e", 197.0, 819.0))
+    report = perf.run_perf(**TINY)
+    assert not report.passed
+    assert sum("exceeds chip peak" in f for f in report.failures) == 2
+    assert report.mxu_peak_fraction > 1.05
+
+
+def test_in_range_reading_passes_gate(monkeypatch):
+    from tpu_operator.validator import perf
+
+    monkeypatch.setattr(perf, "measure_mxu_tflops",
+                        lambda *a, **k: (150.0, True, 1.0))   # 76% of peak
+    monkeypatch.setattr(perf, "measure_hbm_gbps",
+                        lambda *a, **k: (700.0, True))        # 85% of peak
+    monkeypatch.setattr(perf, "measure_ici_allreduce_gbps",
+                        lambda *a, **k: (40.0, True))
+    monkeypatch.setattr(perf, "lookup_peaks",
+                        lambda kind: ("v5e", 197.0, 819.0))
+    report = perf.run_perf(**TINY)
+    assert report.passed, report.failures
+    assert report.chip == "v5e"
+    assert 0 < report.mxu_peak_fraction <= 1.05
+
+
+def test_untrustworthy_timing_fails(monkeypatch):
+    from tpu_operator.validator import perf
+
+    monkeypatch.setattr(perf, "measure_mxu_tflops",
+                        lambda *a, **k: (100.0, False, 1.0))  # noise floor
+    monkeypatch.setattr(perf, "measure_hbm_gbps",
+                        lambda *a, **k: (500.0, True))
+    monkeypatch.setattr(perf, "measure_ici_allreduce_gbps",
+                        lambda *a, **k: (0.0, True))
+    report = perf.run_perf(**TINY)
+    assert not report.measurement_valid
+    assert not report.passed
+    assert any("untrustworthy" in f for f in report.failures)
+
+
+def test_cross_check_disagreement_fails(monkeypatch):
+    """Chain-timing vs block_until_ready disagreeing >2x means the
+    backend's completion signals can't be trusted."""
+    from tpu_operator.validator import perf
+
+    monkeypatch.setattr(perf, "measure_mxu_tflops",
+                        lambda *a, **k: (100.0, True, 5.0))
+    monkeypatch.setattr(perf, "measure_hbm_gbps",
+                        lambda *a, **k: (500.0, True))
+    monkeypatch.setattr(perf, "measure_ici_allreduce_gbps",
+                        lambda *a, **k: (0.0, True))
+    report = perf.run_perf(**TINY)
+    assert not report.measurement_valid
+    assert not report.passed
+
+
 def test_perf_cli(tmp_path, capsys):
     rc = vmain.run([
         "-c", "perf", "--status-dir", str(tmp_path),
