@@ -152,3 +152,31 @@ def test_unregistered_kind_raises_distinct_kind_error(fake_client):
     assert not issubclass(KindNotServedError, NotFoundError)
     # ...but it still carries the API-server-compatible 404 code
     assert KindNotServedError.code == 404
+
+
+def test_schema_admission_covers_every_write_path(fake_client):
+    """create, update, PATCH and the status subresource all route through
+    CRD schema admission — no write path can rubber-stamp an object a real
+    apiserver rejects (VERDICT r1 #2)."""
+    from tpu_operator.api.tpudriver import new_tpu_driver
+    from tpu_operator.client.errors import InvalidError
+
+    with pytest.raises(InvalidError):
+        fake_client.create(new_tpu_driver("bad", {"driverType": "gpu"}))
+
+    fake_client.create(new_tpu_driver("ok", {"image": "img"}))
+    with pytest.raises(InvalidError):
+        fake_client.patch("tpu.ai/v1alpha1", "TPUDriver", "ok",
+                          {"spec": {"driverType": "gpu"}})
+    live = fake_client.get("tpu.ai/v1alpha1", "TPUDriver", "ok")
+    live["spec"]["imagePullPolicy"] = "Sometimes"
+    with pytest.raises(InvalidError):
+        fake_client.update(live)
+    live = fake_client.get("tpu.ai/v1alpha1", "TPUDriver", "ok")
+    live["status"] = {"state": "sort-of-ready"}
+    with pytest.raises(InvalidError):
+        fake_client.update_status(live)
+    # the object survived every rejected write untouched
+    final = fake_client.get("tpu.ai/v1alpha1", "TPUDriver", "ok")
+    assert final["spec"].get("driverType", "standard") == "standard"
+    assert "status" not in final or not final["status"].get("state")

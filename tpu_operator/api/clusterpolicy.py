@@ -122,6 +122,11 @@ class TelemetrySpec(ComponentSpec):
     metrics_port: int = spec_field(
         9400, doc="Port the exporter serves /metrics on.",
         minimum=1, maximum=65535)
+    #: custom-metrics surface (reference dcgm-exporter metrics ConfigMap,
+    #: controllers/object_controls.go:1533-1662): rename/allow/deny metric
+    #: families, static labels, runtime endpoint override
+    config: Optional[Dict[str, Any]] = spec_field(
+        None, schema=CONFIGMAP_REF)
 
 
 @dataclasses.dataclass

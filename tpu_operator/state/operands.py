@@ -133,7 +133,8 @@ class PrerequisitesState(OperandState):
 def telemetry_extras(policy: ClusterPolicy) -> dict:
     t = policy.spec.telemetry
     return {"metrics_port": t.metrics_port,
-            "service_monitor": t.service_monitor or {}}
+            "service_monitor": t.service_monitor or {},
+            "metrics_config": t.config or {}}
 
 
 def node_status_exporter_extras(policy: ClusterPolicy) -> dict:

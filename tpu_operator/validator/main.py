@@ -59,6 +59,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--port", type=int, default=8000)
     p.add_argument("--sleep-interval", type=float, default=60.0)
     p.add_argument("--matrix-dim", type=int, default=512)
+    p.add_argument("--metrics-config",
+                   default=os.environ.get("TPU_TELEMETRY_CONFIG"),
+                   help="telemetry custom-metrics config file (from the "
+                        "spec.telemetry.config ConfigMap)")
     p.add_argument("--perf-matrix-dim", type=int, default=4096)
     p.add_argument("--perf-hbm-mib", type=int, default=512)
     p.add_argument("--perf-ici-mib", type=int, default=64)
@@ -198,7 +202,8 @@ def run(argv=None, client=None) -> int:
     if component == "telemetry":
         from . import telemetry
 
-        return telemetry.serve(args.port, refresh_interval=min(args.sleep_interval, 60.0))
+        return telemetry.serve(args.port, refresh_interval=min(args.sleep_interval, 60.0),
+                               config_path=args.metrics_config)
 
     if component == "feature-discovery":
         from . import feature_discovery

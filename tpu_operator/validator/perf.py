@@ -102,8 +102,9 @@ def _fetch_one(out):
     return jax.device_get(out[idx] if idx else out)
 
 
-def _chain_time(fn, x, iters: int) -> Tuple[float, bool]:
-    """(wall time per call, trustworthy?) for shape-preserving ``fn``.
+def _chain_time(fn, x, iters: int) -> Tuple[float, bool, int]:
+    """(wall time per call, trustworthy?, final iters) for shape-preserving
+    ``fn``.
 
     Measured as a chain of dependent calls closed by a single one-element
     fetch, minus the median fetch round-trip. Dependent chaining means no
@@ -137,7 +138,7 @@ def _chain_time(fn, x, iters: int) -> Tuple[float, bool]:
             break
         iters *= 4
     trustworthy = total >= floor and total > 2.0 * rtt
-    return max(total - rtt, 1e-9) / iters, trustworthy
+    return max(total - rtt, 1e-9) / iters, trustworthy, iters
 
 
 def _block_time(fn, x, iters: int) -> float:
@@ -180,8 +181,12 @@ def measure_mxu_tflops(dim: int = 4096, iters: int = 5
         return x
 
     a = jax.random.normal(key, (dim, dim), dtype=jnp.bfloat16)
-    t, ok = _chain_time(chained, a, iters)
-    t_block = _block_time(chained, a, iters)
+    t, ok, grown_iters = _chain_time(chained, a, iters)
+    # cross-check with the SAME iteration count the chain timing settled
+    # on, so both totals sit equally far above the noise floor — with the
+    # original small iters the block timing is noise-dominated and the
+    # ratio gate trips nondeterministically
+    t_block = _block_time(chained, a, grown_iters)
     ratio = round(t / t_block, 3) if t_block > 0 else None
     flops = 2.0 * dim * dim * dim * chain
     return flops / t / 1e12, ok, ratio
@@ -199,7 +204,7 @@ def measure_hbm_gbps(mib: int = 512, iters: int = 5) -> Tuple[float, bool]:
         return x * 1.0001 + 1.0
 
     x = jnp.ones((n,), dtype=jnp.float32)
-    t, ok = _chain_time(touch, x, iters)
+    t, ok, _ = _chain_time(touch, x, iters)
     bytes_moved = 2.0 * n * 4  # one read + one write of the array
     return bytes_moved / t / 1e9, ok
 
@@ -222,7 +227,7 @@ def measure_ici_allreduce_gbps(mib: int = 64, iters: int = 5
         return jax.lax.pmean(x, axis_name="i")
 
     x = jnp.ones((n, elems), dtype=jnp.float32)
-    t, ok = _chain_time(allreduce, x, iters)
+    t, ok, _ = _chain_time(allreduce, x, iters)
     # standard allreduce traffic model: each chip sends+receives
     # 2*(n-1)/n of the buffer
     bytes_on_bus = 2.0 * (n - 1) / n * elems * 4
