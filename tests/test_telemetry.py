@@ -103,7 +103,8 @@ def test_sysfs_source_reads_hwmon(tmp_path):
     assert temp == [("tpu_temperature_celsius",
                      {"sensor": "tpu_board/temp1"}, 45.5)]
     power = [s for s in samples if s[0] == "tpu_power_watts"]
-    assert power == [("tpu_power_watts", {"sensor": "tpu_board"}, 92.0)]
+    assert power == [("tpu_power_watts",
+                      {"sensor": "tpu_board/power1"}, 92.0)]
 
 
 def test_records_source_reads_partition_handoff(tmp_path):
@@ -215,3 +216,20 @@ def test_collection_never_imports_jax(tmp_path):
                           capture_output=True, text=True, timeout=120)
     assert proc.returncode == 0, proc.stderr[-1000:]
     assert json.loads(proc.stdout)["jax_imported"] is False
+
+
+def test_excluded_derived_families_do_not_crash_refresh():
+    """Config excluding tpu_chip_up/tpu_chips_total must filter the derived
+    samples too, not KeyError the refresh loop."""
+    srv, url = serve_text(RUNTIME_TEXT)
+    try:
+        config = MetricsConfig(exclude=["tpu_chip_up", "tpu_chips_total"])
+        metrics = TelemetryMetrics(config=config,
+                                   sources=[RuntimeEndpointSource(url)])
+        metrics.refresh()
+        text = metrics.scrape().decode()
+    finally:
+        srv.shutdown()
+    assert "tpu_chip_up" not in text
+    assert "tpu_chips_total" not in text
+    assert "tpu_hbm_used_bytes" in text

@@ -390,3 +390,184 @@ def test_frozen_pool_counts_as_available(fake_client):
     # 1 pending (ClusterPolicy node) + 2 frozen-but-healthy = available
     assert "tpu_operator_nodes_upgrades_pending 1.0" in scraped
     assert "tpu_operator_nodes_upgrades_available 2.0" in scraped
+
+
+# -- eviction-based drain with budgets (VERDICT r1 #5) ------------------------
+
+def mk_pdb(name, selector, min_available=1):
+    return {"apiVersion": "policy/v1", "kind": "PodDisruptionBudget",
+            "metadata": {"name": name, "namespace": NS},
+            "spec": {"selector": {"matchLabels": selector},
+                     "minAvailable": min_available}}
+
+
+def machine_at(fake_client, clock, **kw):
+    policy = UpgradePolicySpec.from_dict({"autoUpgrade": True, **kw})
+    return UpgradeStateMachine(fake_client, NS, policy, now=lambda: clock[0])
+
+
+def test_pdb_blocked_eviction_retries_then_fails_without_force(fake_client):
+    """PDB holds the only workload pod -> eviction 429s -> machine retries
+    until podDeletion.timeoutSeconds, then fails the node (force=false)."""
+    setup(fake_client)
+    pod = mk_pod("workload", "tpu-0", None, "user:1", tpu_limit=4)
+    pod["metadata"]["labels"]["app"] = "train"
+    fake_client.create(pod)
+    fake_client.create(mk_pdb("train-pdb", {"app": "train"}, min_available=1))
+
+    clock = [1000.0]
+    sm = machine_at(fake_client, clock,
+                    podDeletion={"timeoutSeconds": 300, "force": False})
+    sm.process(fresh_nodes(fake_client))   # -> upgrade-required
+    sm.process(fresh_nodes(fake_client))   # cordon..pod-deletion, blocked
+    node = fake_client.get("v1", "Node", "tpu-0")
+    assert node_upgrade_state(node) == m.POD_DELETION_REQUIRED
+    # the pod survived: eviction respected the budget, no bare delete
+    assert fake_client.get("v1", "Pod", "workload", NS)
+
+    clock[0] += 100.0                      # inside budget: still waiting
+    sm.process(fresh_nodes(fake_client))
+    assert node_upgrade_state(fake_client.get("v1", "Node", "tpu-0")) \
+        == m.POD_DELETION_REQUIRED
+
+    clock[0] += 300.0                      # budget exceeded, force=false
+    sm.process(fresh_nodes(fake_client))
+    assert node_upgrade_state(fake_client.get("v1", "Node", "tpu-0")) == m.FAILED
+    assert fake_client.get("v1", "Pod", "workload", NS)  # never bare-deleted
+    evs = [e for e in fake_client.list("v1", "Event", NS)
+           if e.get("reason") == "UpgradeDrainFailed"]
+    assert evs, "timeout must emit a warning Event"
+
+
+def test_pdb_blocked_eviction_force_deletes_after_budget(fake_client):
+    setup(fake_client)
+    pod = mk_pod("workload", "tpu-0", None, "user:1", tpu_limit=4)
+    pod["metadata"]["labels"]["app"] = "train"
+    fake_client.create(pod)
+    fake_client.create(mk_pdb("train-pdb", {"app": "train"}, min_available=1))
+
+    clock = [1000.0]
+    sm = machine_at(fake_client, clock,
+                    podDeletion={"timeoutSeconds": 60, "force": True})
+    sm.process(fresh_nodes(fake_client))
+    sm.process(fresh_nodes(fake_client))   # blocked inside budget
+    assert fake_client.get("v1", "Pod", "workload", NS)
+
+    clock[0] += 120.0                      # budget exceeded, force=true
+    sm.process(fresh_nodes(fake_client))
+    names = [p["metadata"]["name"] for p in fake_client.list("v1", "Pod", NS)]
+    assert "workload" not in names
+    state = node_upgrade_state(fake_client.get("v1", "Node", "tpu-0"))
+    assert state not in (m.FAILED, m.POD_DELETION_REQUIRED)
+    evs = [e for e in fake_client.list("v1", "Event", NS)
+           if e.get("reason") == "UpgradeDrainForced"]
+    assert evs, "forced override must emit a warning Event"
+
+
+def test_empty_dir_pod_blocks_drain_even_with_force(fake_client):
+    """force never implies data loss: an emptyDir pod needs the explicit
+    deleteEmptyDir permission (kubectl drain --delete-emptydir-data)."""
+    setup(fake_client)
+    pod = mk_pod("scratch", "tpu-0", None, "user:1", tpu_limit=4)
+    pod["spec"]["volumes"] = [{"name": "tmp", "emptyDir": {}}]
+    fake_client.create(pod)
+
+    clock = [1000.0]
+    sm = machine_at(fake_client, clock,
+                    podDeletion={"timeoutSeconds": 60, "force": True,
+                                 "deleteEmptyDir": False})
+    sm.process(fresh_nodes(fake_client))
+    sm.process(fresh_nodes(fake_client))
+    assert fake_client.get("v1", "Pod", "scratch", NS)  # still there
+
+    clock[0] += 120.0
+    sm.process(fresh_nodes(fake_client))
+    assert node_upgrade_state(fake_client.get("v1", "Node", "tpu-0")) == m.FAILED
+    assert fake_client.get("v1", "Pod", "scratch", NS)  # data preserved
+
+    # with the permission it proceeds
+    fake_client2 = type(fake_client)()
+    setup(fake_client2)
+    pod2 = mk_pod("scratch", "tpu-0", None, "user:1", tpu_limit=4)
+    pod2["spec"]["volumes"] = [{"name": "tmp", "emptyDir": {}}]
+    fake_client2.create(pod2)
+    sm2 = machine_at(fake_client2, clock,
+                     podDeletion={"deleteEmptyDir": True})
+    sm2.process(fresh_nodes(fake_client2))
+    sm2.process(fresh_nodes(fake_client2))
+    names = [p["metadata"]["name"] for p in fake_client2.list("v1", "Pod", NS)]
+    assert "scratch" not in names
+
+
+def test_stuck_job_escalates_after_wait_timeout(fake_client):
+    setup(fake_client)
+    job = mk_pod("job", "tpu-0", None, "user:1")
+    job["metadata"]["labels"]["app"] = "train"
+    fake_client.create(job)
+
+    clock = [1000.0]
+    sm = machine_at(fake_client, clock,
+                    waitForCompletion={"podSelector": "app=train",
+                                       "timeoutSeconds": 600})
+    sm.process(fresh_nodes(fake_client))
+    sm.process(fresh_nodes(fake_client))
+    assert node_upgrade_state(fake_client.get("v1", "Node", "tpu-0")) \
+        == m.WAIT_FOR_JOBS_REQUIRED
+
+    clock[0] += 300.0                      # inside budget: still waiting
+    sm.process(fresh_nodes(fake_client))
+    assert node_upgrade_state(fake_client.get("v1", "Node", "tpu-0")) \
+        == m.WAIT_FOR_JOBS_REQUIRED
+
+    clock[0] += 600.0                      # past budget: escalate
+    sm.process(fresh_nodes(fake_client))
+    state = node_upgrade_state(fake_client.get("v1", "Node", "tpu-0"))
+    assert state not in (m.WAIT_FOR_JOBS_REQUIRED, m.UNKNOWN)
+    evs = [e for e in fake_client.list("v1", "Event", NS)
+           if e.get("reason") == "UpgradeWaitForJobsTimeout"]
+    assert evs
+
+
+def test_stuck_job_waits_forever_with_zero_timeout(fake_client):
+    setup(fake_client)
+    job = mk_pod("job", "tpu-0", None, "user:1")
+    job["metadata"]["labels"]["app"] = "train"
+    fake_client.create(job)
+
+    clock = [1000.0]
+    sm = machine_at(fake_client, clock,
+                    waitForCompletion={"podSelector": "app=train"})
+    sm.process(fresh_nodes(fake_client))
+    sm.process(fresh_nodes(fake_client))
+    clock[0] += 10_000_000.0
+    sm.process(fresh_nodes(fake_client))
+    assert node_upgrade_state(fake_client.get("v1", "Node", "tpu-0")) \
+        == m.WAIT_FOR_JOBS_REQUIRED
+
+
+def test_skip_drain_label_still_honored(fake_client):
+    setup(fake_client)
+    node = fake_client.get("v1", "Node", "tpu-0")
+    node["metadata"]["labels"][consts.UPGRADE_SKIP_DRAIN_LABEL] = "true"
+    fake_client.update(node)
+    keep = mk_pod("keep-me", "tpu-0", None, "user:1")  # no TPU limit
+    fake_client.create(keep)
+    sm = machine(fake_client, drain={"enable": True})
+    sm.process(fresh_nodes(fake_client))
+    sm.process(fresh_nodes(fake_client))
+    assert fake_client.get("v1", "Pod", "keep-me", NS)  # drain skipped
+
+
+def test_drain_pod_selector_limits_targets(fake_client):
+    setup(fake_client)
+    a = mk_pod("match", "tpu-0", None, "user:1")
+    a["metadata"]["labels"]["team"] = "ml"
+    b = mk_pod("nomatch", "tpu-0", None, "user:1")
+    fake_client.create(a)
+    fake_client.create(b)
+    sm = machine(fake_client, drain={"enable": True, "podSelector": "team=ml"})
+    sm.process(fresh_nodes(fake_client))
+    sm.process(fresh_nodes(fake_client))
+    names = [p["metadata"]["name"] for p in fake_client.list("v1", "Pod", NS)]
+    assert "match" not in names
+    assert "nomatch" in names

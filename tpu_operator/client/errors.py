@@ -35,6 +35,12 @@ class InvalidError(ApiError):
     code = 422
 
 
+class TooManyRequestsError(ApiError):
+    """Eviction blocked by a PodDisruptionBudget (apiserver 429)."""
+
+    code = 429
+
+
 class ConflictError(ApiError):
     code = 409
 

@@ -17,7 +17,13 @@ import uuid
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..utils.objects import deep_get, json_merge_patch, rfc3339_now
-from .errors import AlreadyExistsError, ConflictError, InvalidError, NotFoundError
+from .errors import (
+    AlreadyExistsError,
+    ConflictError,
+    InvalidError,
+    NotFoundError,
+    TooManyRequestsError,
+)
 from .interface import Client, WatchEvent, WatchHandle
 from .scheme import Scheme, default_scheme
 
@@ -251,6 +257,42 @@ class FakeClient(Client):
                 self.delete(api_version, kind, name, ns or None)
             except NotFoundError:
                 pass
+
+    def evict(self, name: str, namespace: Optional[str] = None) -> None:
+        """Eviction subresource semantics: every PodDisruptionBudget whose
+        selector matches the pod must have disruption headroom, else 429.
+
+        Headroom follows the apiserver's bookkeeping: an explicit
+        ``status.disruptionsAllowed`` wins; otherwise it is computed from
+        ``spec.minAvailable`` against currently-matching non-terminating
+        pods (the common case for the tests/sim)."""
+        with self._lock:
+            pod = self.get("v1", "Pod", name, namespace)
+            ns = pod["metadata"].get("namespace")
+            labels = deep_get(pod, "metadata", "labels", default={}) or {}
+            for pdb in self.list("policy/v1", "PodDisruptionBudget", ns):
+                selector = deep_get(pdb, "spec", "selector", "matchLabels",
+                                    default={}) or {}
+                if not selector or not all(
+                        labels.get(k) == v for k, v in selector.items()):
+                    continue
+                allowed = deep_get(pdb, "status", "disruptionsAllowed")
+                if allowed is None:
+                    matching = [
+                        p for p in self.list("v1", "Pod", ns)
+                        if all((deep_get(p, "metadata", "labels", k)) == v
+                               for k, v in selector.items())]
+                    min_avail = deep_get(pdb, "spec", "minAvailable",
+                                         default=0) or 0
+                    if isinstance(min_avail, str) and min_avail.endswith("%"):
+                        min_avail = -(-len(matching) * int(min_avail[:-1]) // 100)
+                    allowed = len(matching) - int(min_avail)
+                if allowed <= 0:
+                    raise TooManyRequestsError(
+                        f"Cannot evict pod {ns}/{name}: disruption budget "
+                        f"{pdb['metadata']['name']} needs "
+                        f"{deep_get(pdb, 'spec', 'minAvailable')} available")
+            self.delete("v1", "Pod", name, namespace)
 
     def update_status(self, obj: dict) -> dict:
         with self._lock:

@@ -54,6 +54,14 @@ class Client:
         """Update only the status subresource."""
         raise NotImplementedError
 
+    def evict(self, name: str, namespace: Optional[str] = None) -> None:
+        """Evict a pod via the Eviction subresource (policy/v1): honors
+        PodDisruptionBudgets, raising TooManyRequestsError (429) when a
+        budget blocks the disruption — unlike delete(), which bypasses
+        budgets. The drain path must use this (reference drain_manager
+        wraps kubectl's eviction-based drain helper)."""
+        raise NotImplementedError
+
     # -- watches -------------------------------------------------------------
     def watch(
         self,

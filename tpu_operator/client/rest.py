@@ -16,7 +16,14 @@ from typing import Callable, List, Optional
 
 import requests
 
-from .errors import AlreadyExistsError, ApiError, ConflictError, NotFoundError
+from .errors import (
+    AlreadyExistsError,
+    ApiError,
+    ConflictError,
+    InvalidError,
+    NotFoundError,
+    TooManyRequestsError,
+)
 from .interface import Client, WatchEvent, WatchHandle
 from .scheme import Scheme, default_scheme
 
@@ -95,6 +102,10 @@ class RestClient(Client):
             if "already exists" in message:
                 raise AlreadyExistsError(message)
             raise ConflictError(message)
+        if resp.status_code == 422:
+            raise InvalidError(message)
+        if resp.status_code == 429:
+            raise TooManyRequestsError(message)
         raise ApiError(message, resp.status_code)
 
     # -- CRUD ----------------------------------------------------------------
@@ -141,6 +152,13 @@ class RestClient(Client):
 
     def delete(self, api_version, kind, name, namespace=None) -> None:
         resp = self._session.delete(self.resource_url(api_version, kind, namespace, name))
+        self._raise_for(resp)
+
+    def evict(self, name: str, namespace: Optional[str] = None) -> None:
+        url = self.resource_url("v1", "Pod", namespace, name, "eviction")
+        body = {"apiVersion": "policy/v1", "kind": "Eviction",
+                "metadata": {"name": name, "namespace": namespace}}
+        resp = self._session.post(url, json=body)
         self._raise_for(resp)
 
     def update_status(self, obj: dict) -> dict:

@@ -35,6 +35,9 @@ MULTIHOST_VALIDATED_ANNOTATION = "tpu.ai/multihost-validated"
 #: upgrade state machine's per-node persistent state
 UPGRADE_STATE_LABEL = "tpu.ai/tpu-driver-upgrade-state"
 UPGRADE_SKIP_DRAIN_LABEL = "tpu.ai/tpu-driver-upgrade-drain.skip"
+#: when the node entered its current upgrade state (RFC3339); drives the
+#: drain/pod-deletion/wait-for-jobs timeout budgets across operator restarts
+UPGRADE_STATE_SINCE_ANNOTATION = "tpu.ai/tpu-driver-upgrade-state-since"
 
 # -- labels read from the platform (GKE / device discovery) -------------------
 GKE_TPU_ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"

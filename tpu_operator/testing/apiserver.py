@@ -145,7 +145,12 @@ class MiniApiServer:
 
             def do_POST(self):
                 try:
-                    api_version, kind, ns, _, _ = server._router.resolve(urlparse(self.path).path)
+                    api_version, kind, ns, name, sub = server._router.resolve(urlparse(self.path).path)
+                    if kind == "Pod" and name and sub == "eviction":
+                        self._body()  # Eviction object; pod identity is in the URL
+                        server.backend.evict(name, ns)
+                        self._send(201, {"kind": "Status", "status": "Success"})
+                        return
                     obj = self._body()
                     obj.setdefault("apiVersion", api_version)
                     obj.setdefault("kind", kind)

@@ -22,6 +22,7 @@ variants).
 
 from __future__ import annotations
 
+import calendar
 import dataclasses
 import logging
 import time
@@ -29,7 +30,7 @@ from typing import Dict, List, Optional
 
 from .. import consts
 from ..api.common import UpgradePolicySpec
-from ..client.errors import ApiError, NotFoundError
+from ..client.errors import ApiError, NotFoundError, TooManyRequestsError
 from ..client.interface import Client
 from ..utils import deep_get
 
@@ -85,10 +86,12 @@ class UpgradeStateCounts:
 
 class UpgradeStateMachine:
     def __init__(self, client: Client, namespace: str,
-                 policy: Optional[UpgradePolicySpec] = None):
+                 policy: Optional[UpgradePolicySpec] = None,
+                 now=time.time):
         self.client = client
         self.namespace = namespace
         self.policy = policy or UpgradePolicySpec()
+        self._now = now  # injectable clock for timeout tests
 
     # -- cluster inspection ---------------------------------------------------
     def _pods_on(self, node_name: str, component: Optional[str] = None) -> List[dict]:
@@ -124,9 +127,36 @@ class UpgradeStateMachine:
     def _set_state(self, node: dict, state: str) -> None:
         name = node["metadata"]["name"]
         log.info("upgrade: node %s -> %s", name, state or "<clear>")
-        self.client.patch("v1", "Node", name,
-                          {"metadata": {"labels": {consts.UPGRADE_STATE_LABEL: state or None}}})
-        node.setdefault("metadata", {}).setdefault("labels", {})[consts.UPGRADE_STATE_LABEL] = state
+        since = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                              time.gmtime(self._now())) if state else None
+        self.client.patch("v1", "Node", name, {"metadata": {
+            "labels": {consts.UPGRADE_STATE_LABEL: state or None},
+            "annotations": {consts.UPGRADE_STATE_SINCE_ANNOTATION: since},
+        }})
+        meta = node.setdefault("metadata", {})
+        meta.setdefault("labels", {})[consts.UPGRADE_STATE_LABEL] = state
+        anns = meta.setdefault("annotations", {})
+        if since:
+            anns[consts.UPGRADE_STATE_SINCE_ANNOTATION] = since
+        else:
+            anns.pop(consts.UPGRADE_STATE_SINCE_ANNOTATION, None)
+
+    def _state_age(self, node: dict) -> float:
+        """Seconds the node has sat in its current state. Resumable across
+        operator restarts (the reference's drain/pod-deletion/wait budgets,
+        drainSpec.timeoutSeconds). An absent/corrupt annotation starts the
+        clock now — better to grant a fresh budget than to escalate
+        instantly on a legacy node."""
+        raw = deep_get(node, "metadata", "annotations",
+                       consts.UPGRADE_STATE_SINCE_ANNOTATION)
+        if raw:
+            try:
+                since = calendar.timegm(time.strptime(raw, "%Y-%m-%dT%H:%M:%SZ"))
+                return max(0.0, self._now() - since)
+            except ValueError:
+                pass
+        self._set_state(node, node_upgrade_state(node))  # stamp now
+        return 0.0
 
     def _cordon(self, node: dict, unschedulable: bool) -> None:
         self.client.patch("v1", "Node", node["metadata"]["name"],
@@ -150,6 +180,71 @@ class UpgradeStateMachine:
                                pod["metadata"].get("namespace"))
         except NotFoundError:
             pass
+
+    def _evict_pod(self, pod: dict) -> bool:
+        """Evict via the Eviction subresource, honoring PDBs. True when the
+        eviction was accepted (or the pod is already gone); False when a
+        PodDisruptionBudget blocked it (retry next sweep)."""
+        try:
+            self.client.evict(pod["metadata"]["name"],
+                              pod["metadata"].get("namespace"))
+            return True
+        except TooManyRequestsError:
+            return False
+        except NotFoundError:
+            return True
+
+    @staticmethod
+    def _uses_empty_dir(pod: dict) -> bool:
+        return any("emptyDir" in v for v in
+                   deep_get(pod, "spec", "volumes", default=[]) or [])
+
+    def _evict_with_budget(self, node: dict, pods: List[dict], *,
+                           timeout_s: int, force: bool,
+                           delete_empty_dir: bool, what: str) -> Optional[str]:
+        """Shared drain core (reference drain_manager wrapping kubectl's
+        eviction helper): evict every target; when the budget expires,
+        force-delete if allowed, else fail the node's upgrade. Returns None
+        when all targets are gone (advance), the current-state sentinel
+        ``"wait"`` to retry next sweep, or FAILED."""
+        from .. import events
+
+        blocked_empty = [p for p in pods
+                         if self._uses_empty_dir(p) and not delete_empty_dir]
+        candidates = [p for p in pods if p not in blocked_empty]
+        pdb_blocked = [p for p in candidates if not self._evict_pod(p)]
+        remaining = blocked_empty + pdb_blocked
+        if not remaining:
+            return None
+        if timeout_s > 0 and self._state_age(node) > timeout_s:
+            name = node["metadata"]["name"]
+            if blocked_empty:
+                # force never implies data loss: emptyDir pods need the
+                # explicit deleteEmptyDir permission (kubectl drain's
+                # --delete-emptydir-data), even past the budget
+                events.record(self.client, self.namespace, node,
+                              events.WARNING, "UpgradeDrainFailed",
+                              f"{what} on {name}: pods with emptyDir data "
+                              f"block the drain and deleteEmptyDir=false")
+                self._set_state(node, FAILED)
+                return FAILED
+            if force:
+                for pod in pdb_blocked:
+                    self._delete_pod(pod)
+                events.record(self.client, self.namespace, node,
+                              events.WARNING, "UpgradeDrainForced",
+                              f"{what} on {name}: {len(pdb_blocked)} pod(s) "
+                              f"force-deleted after {timeout_s}s budget "
+                              f"(PodDisruptionBudget overridden)")
+                return None
+            events.record(self.client, self.namespace, node, events.WARNING,
+                          "UpgradeDrainFailed",
+                          f"{what} on {name}: {len(pdb_blocked)} pod(s) "
+                          f"still blocked by PodDisruptionBudget after "
+                          f"{timeout_s}s and force=false")
+            self._set_state(node, FAILED)
+            return FAILED
+        return "wait"
 
     # -- the sweep ------------------------------------------------------------
     def process(self, nodes: List[dict]) -> UpgradeStateCounts:
@@ -231,30 +326,76 @@ class UpgradeStateMachine:
             state = WAIT_FOR_JOBS_REQUIRED
 
         if state == WAIT_FOR_JOBS_REQUIRED:
-            if self.policy.wait_for_completion.pod_selector:
-                key, _, value = self.policy.wait_for_completion.pod_selector.partition("=")
+            wait_spec = self.policy.wait_for_completion
+            if wait_spec.pod_selector:
+                key, _, value = wait_spec.pod_selector.partition("=")
                 waiting = [p for p in self._pods_on(name)
                            if deep_get(p, "metadata", "labels", key) == (value or None)
                            and deep_get(p, "status", "phase") in ("Running", "Pending")]
                 if waiting:
-                    return state
+                    # a stuck job must not wedge the upgrade forever:
+                    # waitForCompletion.timeoutSeconds escalates past it
+                    # (reference WaitForCompletionSpec; 0 = wait forever)
+                    if (wait_spec.timeout_seconds > 0
+                            and self._state_age(node) > wait_spec.timeout_seconds):
+                        from .. import events
+
+                        events.record(
+                            self.client, self.namespace, node, events.WARNING,
+                            "UpgradeWaitForJobsTimeout",
+                            f"{len(waiting)} job pod(s) on {name} still "
+                            f"running after waitForCompletion budget of "
+                            f"{wait_spec.timeout_seconds}s; proceeding")
+                    else:
+                        return state
             self._set_state(node, POD_DELETION_REQUIRED)
             state = POD_DELETION_REQUIRED
 
         if state == POD_DELETION_REQUIRED:
-            for pod in self._tpu_consumer_pods(name):
-                self._delete_pod(pod)
+            pd = self.policy.pod_deletion
+            outcome = self._evict_with_budget(
+                node, self._tpu_consumer_pods(name),
+                timeout_s=pd.timeout_seconds, force=pd.force,
+                delete_empty_dir=pd.delete_empty_dir,
+                what="TPU-consumer pod deletion")
+            if outcome == FAILED:
+                return FAILED
+            if outcome == "wait" or self._tpu_consumer_pods(name):
+                return state  # evictions pending; retry next sweep
             self._set_state(node, DRAIN_REQUIRED)
             state = DRAIN_REQUIRED
 
         if state == DRAIN_REQUIRED:
             skip = deep_get(node, "metadata", "labels",
                             consts.UPGRADE_SKIP_DRAIN_LABEL) == "true"
-            if self.policy.drain.enable and not skip:
-                for pod in self._pods_on(name):
-                    if deep_get(pod, "metadata", "labels", "app.kubernetes.io/component"):
-                        continue  # operand DS pods stay (like kubectl drain ignores DS)
-                    self._delete_pod(pod)
+            drain = self.policy.drain
+            if drain.enable and not skip:
+                def drain_targets() -> List[dict]:
+                    sel_key, _, sel_value = drain.pod_selector.partition("=")
+                    targets = []
+                    for pod in self._pods_on(name):
+                        if deep_get(pod, "metadata", "labels",
+                                    "app.kubernetes.io/component"):
+                            continue  # operand DS pods stay (kubectl drain ignores DS)
+                        if sel_key and deep_get(pod, "metadata", "labels",
+                                                sel_key) != (sel_value or None):
+                            continue
+                        targets.append(pod)
+                    return targets
+
+                outcome = self._evict_with_budget(
+                    node, drain_targets(), timeout_s=drain.timeout_seconds,
+                    force=drain.force,
+                    delete_empty_dir=drain.delete_empty_dir,
+                    what="node drain")
+                if outcome == FAILED:
+                    return FAILED
+                # evictions accepted != pods gone: on a real apiserver an
+                # accepted Eviction only stamps deletionTimestamp and the
+                # pod runs out its grace period — don't restart the driver
+                # under still-running workloads
+                if outcome == "wait" or drain_targets():
+                    return state
             self._set_state(node, POD_RESTART_REQUIRED)
             state = POD_RESTART_REQUIRED
 

@@ -188,8 +188,9 @@ class SysfsSource:
             for pf in sorted(glob.glob(os.path.join(hw, "power*_input"))):
                 raw = self._read(pf)
                 if raw is not None:
+                    sensor = os.path.basename(pf).replace("_input", "")
                     samples.append(("tpu_power_watts",
-                                    {"sensor": hw_name},
+                                    {"sensor": f"{hw_name}/{sensor}"},
                                     float(raw) / 1e6))
         return samples
 
@@ -325,9 +326,11 @@ class TelemetryMetrics:
         # derive chip presence from whatever per-chip samples any source
         # produced: the runtime endpoint's labels tell us which chips are
         # live without us ever opening the runtime
-        for chip in sorted(chips_seen):
-            collected.append(("tpu_chip_up", {"chip": chip}, 1.0))
-        if chips_seen and not chips_total_known:
+        if "tpu_chip_up" in self.families:
+            for chip in sorted(chips_seen):
+                collected.append(("tpu_chip_up", {"chip": chip}, 1.0))
+        if chips_seen and not chips_total_known \
+                and "tpu_chips_total" in self.families:
             collected.append(("tpu_chips_total", {}, float(len(chips_seen))))
 
         registry = CollectorRegistry()
