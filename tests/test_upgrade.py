@@ -571,3 +571,58 @@ def test_drain_pod_selector_limits_targets(fake_client):
     names = [p["metadata"]["name"] for p in fake_client.list("v1", "Pod", NS)]
     assert "match" not in names
     assert "nomatch" in names
+
+
+def test_drain_timeout_failed_is_sticky_until_template_changes(fake_client):
+    """A drain-timeout FAILED must not recycle into upgrade-required while
+    the driver template is unchanged (endless cordon->evict->fail loop);
+    rolling a NEW template un-sticks it."""
+    setup(fake_client)
+    pod = mk_pod("workload", "tpu-0", None, "user:1", tpu_limit=4)
+    pod["metadata"]["labels"]["app"] = "train"
+    fake_client.create(pod)
+    fake_client.create(mk_pdb("train-pdb", {"app": "train"}, min_available=1))
+
+    clock = [1000.0]
+    sm = machine_at(fake_client, clock,
+                    podDeletion={"timeoutSeconds": 60, "force": False})
+    sm.process(fresh_nodes(fake_client))
+    sm.process(fresh_nodes(fake_client))
+    clock[0] += 120.0
+    sm.process(fresh_nodes(fake_client))
+    assert node_upgrade_state(fake_client.get("v1", "Node", "tpu-0")) == m.FAILED
+
+    # further sweeps: stays FAILED (sticky), no re-cordon loop
+    for _ in range(3):
+        clock[0] += 600.0
+        sm.process(fresh_nodes(fake_client))
+        assert node_upgrade_state(
+            fake_client.get("v1", "Node", "tpu-0")) == m.FAILED
+
+    # admin rolls a NEW driver version -> retry is allowed again (the
+    # machine falls through the chain in one sweep, so the node lands
+    # back in the in-progress pipeline rather than staying FAILED)
+    ds = fake_client.get("apps/v1", "DaemonSet", "libtpu-driver", NS)
+    ds["spec"]["template"]["spec"]["containers"][0]["image"] = "img:3"
+    fake_client.update(ds)
+    sm.process(fresh_nodes(fake_client))
+    state = node_upgrade_state(fake_client.get("v1", "Node", "tpu-0"))
+    assert state in (m.UPGRADE_REQUIRED,) + m.IN_PROGRESS_STATES
+
+
+def test_pdb_ignores_unhealthy_pods(fake_client):
+    """Succeeded pods provide no availability: a PDB whose only healthy
+    matching pod is the eviction target must block (429), matching the
+    apiserver's currentHealthy bookkeeping."""
+    from tpu_operator.client.errors import TooManyRequestsError
+
+    run = mk_pod("running", "tpu-0", None, "user:1")
+    run["metadata"]["labels"]["app"] = "train"
+    done = mk_pod("done", "tpu-0", None, "user:1", phase="Succeeded")
+    done["metadata"]["labels"]["app"] = "train"
+    fake_client.create(run)
+    fake_client.create(done)
+    fake_client.create(mk_pdb("train-pdb", {"app": "train"}, min_available=1))
+    import pytest
+    with pytest.raises(TooManyRequestsError):
+        fake_client.evict("running", NS)

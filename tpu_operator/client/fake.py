@@ -278,10 +278,15 @@ class FakeClient(Client):
                     continue
                 allowed = deep_get(pdb, "status", "disruptionsAllowed")
                 if allowed is None:
+                    # only healthy (running) pods count toward the budget,
+                    # matching the apiserver's currentHealthy bookkeeping —
+                    # Succeeded/Failed pods provide no availability
                     matching = [
                         p for p in self.list("v1", "Pod", ns)
-                        if all((deep_get(p, "metadata", "labels", k)) == v
-                               for k, v in selector.items())]
+                        if deep_get(p, "status", "phase",
+                                    default="Running") == "Running"
+                        and all((deep_get(p, "metadata", "labels", k)) == v
+                                for k, v in selector.items())]
                     min_avail = deep_get(pdb, "spec", "minAvailable",
                                          default=0) or 0
                     if isinstance(min_avail, str) and min_avail.endswith("%"):

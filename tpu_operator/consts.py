@@ -38,6 +38,10 @@ UPGRADE_SKIP_DRAIN_LABEL = "tpu.ai/tpu-driver-upgrade-drain.skip"
 #: when the node entered its current upgrade state (RFC3339); drives the
 #: drain/pod-deletion/wait-for-jobs timeout budgets across operator restarts
 UPGRADE_STATE_SINCE_ANNOTATION = "tpu.ai/tpu-driver-upgrade-state-since"
+#: driver-DS template fingerprint recorded when a node's upgrade fails:
+#: upgrade-failed stays sticky until the template actually changes, so a
+#: drain timeout can't loop cordon->evict->fail forever
+UPGRADE_FAILED_TEMPLATE_ANNOTATION = "tpu.ai/tpu-driver-upgrade-failed-template"
 
 # -- labels read from the platform (GKE / device discovery) -------------------
 GKE_TPU_ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"

@@ -38,6 +38,7 @@ from ..state.manager import (
 from ..state.operands import cluster_policy_states
 from ..utils import deep_get
 from .metrics import OperatorMetrics
+from .predicates import filtered_node_mapper
 from .runtime import Controller, Reconciler, Request, Result
 
 log = logging.getLogger(__name__)
@@ -154,6 +155,8 @@ def _all_policy_requests(client: Client) -> List[Request]:
             for p in client.list("tpu.ai/v1", "ClusterPolicy")]
 
 
+
+
 def setup_clusterpolicy_controller(client: Client,
                                    reconciler: ClusterPolicyReconciler) -> Controller:
     controller = Controller(reconciler)
@@ -161,10 +164,10 @@ def setup_clusterpolicy_controller(client: Client,
     def map_policy(event: WatchEvent) -> List[Request]:
         return [Request(name=event.object["metadata"]["name"])]
 
-    def map_node(event: WatchEvent) -> List[Request]:
-        # node added/changed/removed -> re-reconcile the policy (node labeling
-        # + DS scheduling may change; reference addWatchNewGPUNode :256-352)
-        return _all_policy_requests(client)
+    # node added/changed/removed -> re-reconcile the policy (node labeling
+    # + DS scheduling may change; reference addWatchNewGPUNode :256-352).
+    # Status-only heartbeats are filtered out.
+    map_node = filtered_node_mapper(lambda event: _all_policy_requests(client))
 
     def map_owned(event: WatchEvent) -> List[Request]:
         labels = deep_get(event.object, "metadata", "labels", default={}) or {}

@@ -32,6 +32,7 @@ from ..state.driver import DriverRenderOverrides, StateDriver
 from ..state.nodepool import get_node_pools
 from ..state.skel import StateSkel, SyncState, node_matches_selector
 from ..utils import deep_get
+from .predicates import filtered_node_mapper
 from .runtime import Controller, Reconciler, Request, Result
 
 log = logging.getLogger(__name__)
@@ -188,7 +189,8 @@ def setup_tpudriver_controller(client: Client, reconciler: TPUDriverReconciler) 
         return [Request(name=instance)] if instance else []
 
     controller.watches("tpu.ai/v1alpha1", "TPUDriver", map_instance)
-    controller.watches("v1", "Node", all_instances)
+    # heartbeat-only node updates must not re-reconcile every instance
+    controller.watches("v1", "Node", filtered_node_mapper(all_instances))
     controller.watches("apps/v1", "DaemonSet", map_owned)
     controller.resyncs(lambda: all_instances(None), period=10.0)
     return controller

@@ -17,6 +17,7 @@ from ..upgrade import UpgradeStateMachine
 from ..upgrade.machine import UpgradeStateCounts
 from ..utils import deep_get
 from .metrics import OperatorMetrics
+from .predicates import filtered_node_mapper
 from .runtime import Controller, Reconciler, Request, Result
 
 log = logging.getLogger(__name__)
@@ -147,7 +148,8 @@ def setup_upgrade_controller(client: Client, reconciler: UpgradeReconciler) -> C
 
     controller.watches("tpu.ai/v1", "ClusterPolicy", singleton)
     controller.watches("tpu.ai/v1alpha1", "TPUDriver", singleton)
-    controller.watches("v1", "Node", singleton)
+    # heartbeat-only node updates carry no upgrade signal
+    controller.watches("v1", "Node", filtered_node_mapper(singleton))
     controller.watches("v1", "Pod", map_pod)
     controller.resyncs(lambda: [SINGLETON_REQUEST], period=30.0)
     return controller

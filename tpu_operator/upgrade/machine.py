@@ -129,9 +129,13 @@ class UpgradeStateMachine:
         log.info("upgrade: node %s -> %s", name, state or "<clear>")
         since = time.strftime("%Y-%m-%dT%H:%M:%SZ",
                               time.gmtime(self._now())) if state else None
+        ann_patch = {consts.UPGRADE_STATE_SINCE_ANNOTATION: since}
+        if not state:
+            # leaving the machine entirely: drop failure bookkeeping too
+            ann_patch[consts.UPGRADE_FAILED_TEMPLATE_ANNOTATION] = None
         self.client.patch("v1", "Node", name, {"metadata": {
             "labels": {consts.UPGRADE_STATE_LABEL: state or None},
-            "annotations": {consts.UPGRADE_STATE_SINCE_ANNOTATION: since},
+            "annotations": ann_patch,
         }})
         meta = node.setdefault("metadata", {})
         meta.setdefault("labels", {})[consts.UPGRADE_STATE_LABEL] = state
@@ -140,6 +144,33 @@ class UpgradeStateMachine:
             anns[consts.UPGRADE_STATE_SINCE_ANNOTATION] = since
         else:
             anns.pop(consts.UPGRADE_STATE_SINCE_ANNOTATION, None)
+            anns.pop(consts.UPGRADE_FAILED_TEMPLATE_ANNOTATION, None)
+
+    @staticmethod
+    def _template_fingerprint(ds: Optional[dict]) -> str:
+        """Hash of what _pod_outdated compares: the installer container's
+        image+args in the DS template."""
+        from ..utils.hash import object_hash
+
+        want = deep_get(ds or {}, "spec", "template", "spec", "containers",
+                        default=[])
+        first = want[0] if want else {}
+        return object_hash({"image": first.get("image"),
+                            "args": first.get("args")})
+
+    def _mark_failed(self, node: dict, ds: Optional[dict]) -> None:
+        """FAILED + the failing template's fingerprint: the FAILED recovery
+        branch only retries when the template has CHANGED since the
+        failure, so a drain timeout is sticky (admin-visible) instead of
+        looping cordon->evict->fail forever."""
+        self.client.patch("v1", "Node", node["metadata"]["name"],
+                          {"metadata": {"annotations": {
+                              consts.UPGRADE_FAILED_TEMPLATE_ANNOTATION:
+                                  self._template_fingerprint(ds)}}})
+        node.setdefault("metadata", {}).setdefault("annotations", {})[
+            consts.UPGRADE_FAILED_TEMPLATE_ANNOTATION] = \
+            self._template_fingerprint(ds)
+        self._set_state(node, FAILED)
 
     def _state_age(self, node: dict) -> float:
         """Seconds the node has sat in its current state. Resumable across
@@ -201,7 +232,8 @@ class UpgradeStateMachine:
 
     def _evict_with_budget(self, node: dict, pods: List[dict], *,
                            timeout_s: int, force: bool,
-                           delete_empty_dir: bool, what: str) -> Optional[str]:
+                           delete_empty_dir: bool, what: str,
+                           ds: Optional[dict] = None) -> Optional[str]:
         """Shared drain core (reference drain_manager wrapping kubectl's
         eviction helper): evict every target; when the budget expires,
         force-delete if allowed, else fail the node's upgrade. Returns None
@@ -226,7 +258,7 @@ class UpgradeStateMachine:
                               events.WARNING, "UpgradeDrainFailed",
                               f"{what} on {name}: pods with emptyDir data "
                               f"block the drain and deleteEmptyDir=false")
-                self._set_state(node, FAILED)
+                self._mark_failed(node, ds)
                 return FAILED
             if force:
                 for pod in pdb_blocked:
@@ -242,7 +274,7 @@ class UpgradeStateMachine:
                           f"{what} on {name}: {len(pdb_blocked)} pod(s) "
                           f"still blocked by PodDisruptionBudget after "
                           f"{timeout_s}s and force=false")
-            self._set_state(node, FAILED)
+            self._mark_failed(node, ds)
             return FAILED
         return "wait"
 
@@ -297,11 +329,22 @@ class UpgradeStateMachine:
             #  - the node's driver pods now match the template and are ready
             #    (DS controller replaced the crashed pod / admin fixed the
             #    image) -> re-validate, then uncordon via the normal chain
-            if ds and driver_pods and any(self._pod_outdated(p, ds) for p in driver_pods):
+            recorded = deep_get(node, "metadata", "annotations",
+                                consts.UPGRADE_FAILED_TEMPLATE_ANNOTATION)
+            template_changed = (recorded is None
+                                or recorded != self._template_fingerprint(ds))
+            if ds and driver_pods and template_changed \
+                    and any(self._pod_outdated(p, ds) for p in driver_pods):
                 self._set_state(node, UPGRADE_REQUIRED)
                 state = UPGRADE_REQUIRED  # throttle applies below
             elif driver_pods and not any(
-                    deep_get(p, "status", "phase") == "Failed" for p in driver_pods):
+                    deep_get(p, "status", "phase") == "Failed" for p in driver_pods) \
+                    and not (ds and any(self._pod_outdated(p, ds)
+                                        for p in driver_pods)):
+                # pods MATCH the template and are healthy (DS controller
+                # replaced the crashed pod / admin fixed the image) —
+                # outdated-but-ready pods are NOT recovery, they're the
+                # thing the upgrade was supposed to replace
                 from ..state.skel import is_pod_ready
 
                 if all(is_pod_ready(p) for p in driver_pods):
@@ -357,7 +400,7 @@ class UpgradeStateMachine:
                 node, self._tpu_consumer_pods(name),
                 timeout_s=pd.timeout_seconds, force=pd.force,
                 delete_empty_dir=pd.delete_empty_dir,
-                what="TPU-consumer pod deletion")
+                what="TPU-consumer pod deletion", ds=ds)
             if outcome == FAILED:
                 return FAILED
             if outcome == "wait" or self._tpu_consumer_pods(name):
@@ -387,7 +430,7 @@ class UpgradeStateMachine:
                     node, drain_targets(), timeout_s=drain.timeout_seconds,
                     force=drain.force,
                     delete_empty_dir=drain.delete_empty_dir,
-                    what="node drain")
+                    what="node drain", ds=ds)
                 if outcome == FAILED:
                     return FAILED
                 # evictions accepted != pods gone: on a real apiserver an
@@ -415,7 +458,7 @@ class UpgradeStateMachine:
                 events.record(self.client, self.namespace, node, events.WARNING,
                               "DriverUpgradeFailed",
                               f"driver pod entered Failed during upgrade on {name}")
-                self._set_state(node, FAILED)
+                self._mark_failed(node, ds)
                 return FAILED
             from ..state.skel import is_pod_ready
 
