@@ -33,6 +33,12 @@ TPU_SLICE_ID_LABEL = "tpu.ai/slice.id"
 #: slice-level validation stamp (value = hash of the validated config)
 MULTIHOST_VALIDATED_ANNOTATION = "tpu.ai/multihost-validated"
 #: upgrade state machine's per-node persistent state
+#: which stack provides the component on this node: "operator" objects are
+#: ours; "host" records adoption of a platform-preinstalled stack
+#: (VERDICT r1 #7: GKE nodes ship libtpu + Google's device plugin)
+DRIVER_STACK_LABEL = "tpu.ai/tpu.driver.stack"
+PLUGIN_STACK_LABEL = "tpu.ai/tpu.device-plugin.stack"
+
 UPGRADE_STATE_LABEL = "tpu.ai/tpu-driver-upgrade-state"
 UPGRADE_SKIP_DRAIN_LABEL = "tpu.ai/tpu-driver-upgrade-drain.skip"
 #: when the node entered its current upgrade state (RFC3339); drives the

@@ -53,6 +53,54 @@ def desired_state_labels(policy: ClusterPolicy) -> Dict[str, str]:
     return labels
 
 
+def adoption_labels(policy: ClusterPolicy, node: dict) -> Dict[str, Optional[str]]:
+    """Host-stack adoption (VERDICT r1 #7; validateHostDriver analog).
+
+    GKE TPU nodes arrive with libtpu preinstalled and Google's device
+    plugin already advertising the resource; deploying a second stack on
+    top would fight it. Two adoption paths:
+
+    - driver: ``spec.driver.enabled=false`` is the operator-wide statement
+      that the platform owns libtpu (reference driver.enabled=false ->
+      validateHostDriver); every node records ``driver.stack=host``.
+      Re-enabling the driver removes the label again.
+    - device plugin: with ``spec.devicePlugin.enabled`` UNSET (auto), a
+      node already advertising the TPU resource before we ever labeled it
+      has a working host plugin — adopt it: deploy gate forced "false"
+      (our DS skips the node) + ``device-plugin.stack=host``. An explicit
+      ``enabled: true`` always deploys ours, including un-adopting nodes
+      adopted earlier.
+
+    Returned entries OVERRIDE the desired-state labels and bypass the
+    per-node kill-switch filter (the adoption machinery owns these keys;
+    a value of None removes the label)."""
+    labels = deep_get(node, "metadata", "labels", default={}) or {}
+    out: Dict[str, Optional[str]] = {}
+
+    if not policy.spec.driver.is_enabled():
+        out[consts.DRIVER_STACK_LABEL] = "host"
+    elif consts.DRIVER_STACK_LABEL in labels:
+        out[consts.DRIVER_STACK_LABEL] = None  # driver re-enabled: un-adopt
+
+    plugin_gate = consts.deploy_label("device-plugin")
+    plugin_auto = policy.spec.device_plugin.enabled is None
+    already_adopted = labels.get(consts.PLUGIN_STACK_LABEL) == "host"
+    preloaded = (
+        plugin_auto
+        and plugin_gate not in labels
+        and deep_get(node, "status", "capacity",
+                     consts.TPU_RESOURCE_NAME) is not None)
+    if plugin_auto and (preloaded or already_adopted):
+        out[plugin_gate] = "false"
+        out[consts.PLUGIN_STACK_LABEL] = "host"
+    elif already_adopted:
+        # explicit enabled: true/false supersedes the auto-adoption
+        out[consts.PLUGIN_STACK_LABEL] = None
+        if policy.spec.device_plugin.is_enabled():
+            out[plugin_gate] = "true"  # flip the adoption-set gate back
+    return out
+
+
 def _apply_label_patch(node: dict, patch: Dict[str, Optional[str]]) -> None:
     labels = node.setdefault("metadata", {}).setdefault("labels", {})
     for key, value in patch.items():
@@ -70,10 +118,19 @@ def label_tpu_nodes(client: Client, policy: ClusterPolicy) -> LabelResult:
         if is_tpu_node(node):
             result.tpu_nodes += 1
             patch: Dict[str, Optional[str]] = {}
+            adopt = adoption_labels(policy, node)
             for key, value in desired_state_labels(policy).items():
+                if key in adopt:
+                    continue  # adoption owns this key (applied below)
                 if labels.get(key) == "false" and key != consts.TPU_PRESENT_LABEL:
                     continue  # per-node kill switch wins
                 if labels.get(key) != value:
+                    patch[key] = value
+            for key, value in adopt.items():
+                if value is None:
+                    if key in labels:
+                        patch[key] = None
+                elif labels.get(key) != value:
                     patch[key] = value
             # disabled operands lose their deploy label (unless kill-switched)
             for operand in consts.OPERANDS:
@@ -87,7 +144,10 @@ def label_tpu_nodes(client: Client, policy: ClusterPolicy) -> LabelResult:
                 result.labeled += 1
         else:
             stale = [k for k in labels
-                     if k == consts.TPU_PRESENT_LABEL or k.startswith(consts.DEPLOY_LABEL_PREFIX)]
+                     if k == consts.TPU_PRESENT_LABEL
+                     or k.startswith(consts.DEPLOY_LABEL_PREFIX)
+                     or k in (consts.DRIVER_STACK_LABEL,
+                              consts.PLUGIN_STACK_LABEL)]
             if stale:
                 log.info("cleaning TPU labels from node %s", name)
                 client.patch("v1", "Node", name, {"metadata": {"labels": {k: None for k in stale}}})

@@ -124,15 +124,19 @@ class UpgradeStateMachine:
                 or want[0].get("args") != have[0].get("args"))
 
     # -- node operations ------------------------------------------------------
-    def _set_state(self, node: dict, state: str) -> None:
+    def _set_state(self, node: dict, state: str,
+                   extra_annotations: Optional[Dict[str, Optional[str]]] = None
+                   ) -> None:
         name = node["metadata"]["name"]
         log.info("upgrade: node %s -> %s", name, state or "<clear>")
         since = time.strftime("%Y-%m-%dT%H:%M:%SZ",
                               time.gmtime(self._now())) if state else None
-        ann_patch = {consts.UPGRADE_STATE_SINCE_ANNOTATION: since}
+        ann_patch: Dict[str, Optional[str]] = {
+            consts.UPGRADE_STATE_SINCE_ANNOTATION: since}
         if not state:
             # leaving the machine entirely: drop failure bookkeeping too
             ann_patch[consts.UPGRADE_FAILED_TEMPLATE_ANNOTATION] = None
+        ann_patch.update(extra_annotations or {})
         self.client.patch("v1", "Node", name, {"metadata": {
             "labels": {consts.UPGRADE_STATE_LABEL: state or None},
             "annotations": ann_patch,
@@ -140,11 +144,11 @@ class UpgradeStateMachine:
         meta = node.setdefault("metadata", {})
         meta.setdefault("labels", {})[consts.UPGRADE_STATE_LABEL] = state
         anns = meta.setdefault("annotations", {})
-        if since:
-            anns[consts.UPGRADE_STATE_SINCE_ANNOTATION] = since
-        else:
-            anns.pop(consts.UPGRADE_STATE_SINCE_ANNOTATION, None)
-            anns.pop(consts.UPGRADE_FAILED_TEMPLATE_ANNOTATION, None)
+        for key, value in ann_patch.items():
+            if value is None:
+                anns.pop(key, None)
+            else:
+                anns[key] = value
 
     @staticmethod
     def _template_fingerprint(ds: Optional[dict]) -> str:
@@ -159,18 +163,13 @@ class UpgradeStateMachine:
                             "args": first.get("args")})
 
     def _mark_failed(self, node: dict, ds: Optional[dict]) -> None:
-        """FAILED + the failing template's fingerprint: the FAILED recovery
-        branch only retries when the template has CHANGED since the
-        failure, so a drain timeout is sticky (admin-visible) instead of
-        looping cordon->evict->fail forever."""
-        self.client.patch("v1", "Node", node["metadata"]["name"],
-                          {"metadata": {"annotations": {
-                              consts.UPGRADE_FAILED_TEMPLATE_ANNOTATION:
-                                  self._template_fingerprint(ds)}}})
-        node.setdefault("metadata", {}).setdefault("annotations", {})[
-            consts.UPGRADE_FAILED_TEMPLATE_ANNOTATION] = \
-            self._template_fingerprint(ds)
-        self._set_state(node, FAILED)
+        """FAILED + the failing template's fingerprint, in one patch: the
+        FAILED recovery branch only retries when the template has CHANGED
+        since the failure, so a drain timeout is sticky (admin-visible)
+        instead of looping cordon->evict->fail forever."""
+        self._set_state(node, FAILED, extra_annotations={
+            consts.UPGRADE_FAILED_TEMPLATE_ANNOTATION:
+                self._template_fingerprint(ds)})
 
     def _state_age(self, node: dict) -> float:
         """Seconds the node has sat in its current state. Resumable across

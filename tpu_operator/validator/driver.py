@@ -37,6 +37,52 @@ def discover_devices(dev_globs=None) -> List[str]:
     return found
 
 
+#: where platform-managed node images preinstall libtpu (GKE node image,
+#: Cloud TPU VM wheel); override with $TPU_HOST_LIBTPU_PATHS (colon-sep)
+HOST_LIBTPU_PATHS = (
+    "/home/kubernetes/bin/libtpu.so",
+    "/usr/lib/libtpu.so",
+    "/usr/local/lib/libtpu/libtpu.so",
+)
+
+
+def find_host_libtpu(paths=None) -> Optional[str]:
+    """First pre-installed host libtpu that passes the ELF check."""
+    if paths is None:
+        env = os.environ.get("TPU_HOST_LIBTPU_PATHS")
+        paths = env.split(":") if env else HOST_LIBTPU_PATHS
+    for path in paths:
+        if path and is_valid_libtpu(path):
+            return path
+    return None
+
+
+def validate_host(status: Optional[StatusFiles] = None,
+                  require_devices: bool = True) -> bool:
+    """Adopt the host's pre-installed libtpu instead of requiring ours
+    (validateHostDriver analog, reference validator/main.go:694-708:
+    driver.enabled=false means the platform owns the driver). Runs when
+    the validation DS is rendered with TPU_USE_HOST_DRIVER=1; writes the
+    same driver barrier the installer path would, with source=host so
+    feature discovery / support bundles can tell the stacks apart."""
+    status = status or StatusFiles()
+    so = find_host_libtpu()
+    if not so:
+        log.error("host-driver validation failed: no pre-installed libtpu "
+                  "found (looked at %s)",
+                  os.environ.get("TPU_HOST_LIBTPU_PATHS")
+                  or ":".join(HOST_LIBTPU_PATHS))
+        return False
+    devices = discover_devices()
+    if require_devices and not devices:
+        log.error("host-driver validation failed: no TPU device nodes")
+        return False
+    status.write("driver", {"libtpu": so, "devices": devices,
+                            "source": "host"})
+    log.info("host-driver adoption ok: %s, %d device nodes", so, len(devices))
+    return True
+
+
 def find_bundled_libtpu() -> Optional[str]:
     """Locate the libtpu shipped inside this image (env override first)."""
     explicit = os.environ.get("LIBTPU_SRC")

@@ -101,6 +101,10 @@ def run(argv=None, client=None) -> int:
     if component == "driver":
         from . import driver
 
+        if os.environ.get("TPU_USE_HOST_DRIVER") == "1":
+            # driver.enabled=false: adopt the platform's pre-installed
+            # libtpu (validateHostDriver analog, validator/main.go:694-708)
+            return 0 if driver.validate_host(status, require_devices) else 1
         return 0 if driver.validate(args.install_dir, status, require_devices) else 1
 
     if component == "driver-daemon":
