@@ -1,38 +1,116 @@
 #!/usr/bin/env bash
-# Debug-dump for support bundles (reference hack/must-gather.sh, shipped as
-# /usr/bin/gather in the operator image). Collects operator + operand +
-# node state into a tarball.
+# Support-bundle collector (reference hack/must-gather.sh, shipped as
+# /usr/bin/gather in the operator image). Two modes:
+#
+#   BASE=<url> ./must-gather.sh     harness/in-cluster mode: delegates to
+#                                   the Python collector, which speaks the
+#                                   operator's own REST client (works
+#                                   against the e2e mini apiserver too)
+#   ./must-gather.sh                kubectl mode for real clusters: same
+#                                   section layout plus kubectl-only
+#                                   extras (pod logs, exec'd barrier dumps)
+#
+# Sections: cluster/ crs/ operands/ nodes/ validation/ telemetry/ events/
+# plus manifest.json. See tpu_operator/cmd/must_gather.py for the layout.
 set -uo pipefail
 
 ARTIFACT_DIR="${ARTIFACT_DIR:-/tmp/tpu-operator-must-gather-$(date +%s)}"
 NS="${OPERATOR_NAMESPACE:-tpu-operator}"
 K="${KUBECTL:-kubectl}"
+STATUS_DIR="${VALIDATION_STATUS_DIR:-/run/tpu/validations}"
 
-mkdir -p "$ARTIFACT_DIR"/{cluster,operator,operands,nodes}
+if [ -n "${BASE:-}" ]; then
+  exec python3 -m tpu_operator.cmd.must_gather \
+    --base-url "$BASE" --namespace "$NS" --out "$ARTIFACT_DIR" \
+    ${TELEMETRY_URL:+--telemetry-url "$TELEMETRY_URL"} \
+    ${STATUS_DIR_OVERRIDE:+--status-dir "$STATUS_DIR_OVERRIDE"}
+fi
 
+mkdir -p "$ARTIFACT_DIR"/{cluster,crs,operands/pods,nodes,validation/barriers,telemetry,events}
 echo "gathering into $ARTIFACT_DIR"
+manifest_entries=()
+error_entries=()
 
-$K version -o yaml                          > "$ARTIFACT_DIR/cluster/version.yaml" 2>&1
-$K get nodes -o yaml                        > "$ARTIFACT_DIR/cluster/nodes.yaml" 2>&1
-$K get nodes -L tpu.ai/tpu.present,tpu.ai/tpu.chip-type,tpu.ai/tpu.topology,tpu.ai/tpu-driver-upgrade-state \
-                                            > "$ARTIFACT_DIR/cluster/node-labels.txt" 2>&1
-$K get clusterpolicies.tpu.ai -o yaml       > "$ARTIFACT_DIR/operator/clusterpolicies.yaml" 2>&1
-$K get tpudrivers.tpu.ai -o yaml            > "$ARTIFACT_DIR/operator/tpudrivers.yaml" 2>&1
-$K -n "$NS" get all -o wide                 > "$ARTIFACT_DIR/operator/all.txt" 2>&1
-$K -n "$NS" get ds,deploy,svc,cm -o yaml    > "$ARTIFACT_DIR/operands/objects.yaml" 2>&1
-$K -n "$NS" get events --sort-by=.lastTimestamp > "$ARTIFACT_DIR/operator/events.txt" 2>&1
+collect() { # collect <section/relpath> <command...>
+  local rel="$1"; shift
+  if "$@" > "$ARTIFACT_DIR/$rel" 2>&1; then
+    manifest_entries+=("$rel")
+  else
+    # failures stay out of sections and land in errors, matching the
+    # Python collector's manifest contract — a partial bundle must not
+    # read as complete
+    echo "  warning: $rel failed" >&2
+    error_entries+=("$rel")
+  fi
+}
 
+# cluster/
+collect cluster/version.txt        $K version -o yaml
+collect cluster/nodes.yaml         $K get nodes -o yaml
+collect cluster/node-summary.txt   $K get nodes \
+  -L tpu.ai/tpu.present,tpu.ai/tpu.chip-type,tpu.ai/tpu.topology,tpu.ai/tpu-driver-upgrade-state,tpu.ai/tpu.driver.stack,tpu.ai/tpu.device-plugin.stack
+
+# crs/ — full objects include spec + status + conditions
+collect crs/clusterpolicies.yaml   $K get clusterpolicies.tpu.ai -o yaml
+collect crs/tpudrivers.yaml        $K get tpudrivers.tpu.ai -o yaml
+
+# operands/
+collect operands/daemonsets.yaml   $K -n "$NS" get ds -o yaml
+collect operands/deployments.yaml  $K -n "$NS" get deploy -o yaml
+collect operands/services.yaml     $K -n "$NS" get svc -o yaml
+collect operands/configmaps.yaml   $K -n "$NS" get cm -o yaml
 for pod in $($K -n "$NS" get pods -o name 2>/dev/null); do
   name="${pod#pod/}"
-  $K -n "$NS" logs "$pod" --all-containers --tail=2000 \
-                                            > "$ARTIFACT_DIR/operands/$name.log" 2>&1
-  $K -n "$NS" describe "$pod"               > "$ARTIFACT_DIR/operands/$name.describe.txt" 2>&1
+  collect "operands/pods/$name.yaml"         $K -n "$NS" get "$pod" -o yaml
+  collect "operands/pods/$name.describe.txt" $K -n "$NS" describe "$pod"
+  collect "operands/pods/$name.log"          $K -n "$NS" logs "$pod" --all-containers --tail=2000
 done
 
+# nodes/ + validation/ — per-TPU-node detail; barrier files via exec into
+# the node-status exporter pod (it mounts the validation status dir)
+collect validation/upgrade-states.txt $K get nodes \
+  -L tpu.ai/tpu-driver-upgrade-state -l tpu.ai/tpu.present=true
 for node in $($K get nodes -l tpu.ai/tpu.present=true -o name 2>/dev/null); do
   n="${node#node/}"
-  $K describe "$node"                       > "$ARTIFACT_DIR/nodes/$n.describe.txt" 2>&1
+  collect "nodes/$n.describe.txt" $K describe "$node"
+  exporter=$($K -n "$NS" get pods -l app=tpu-node-status-exporter \
+    --field-selector "spec.nodeName=$n" -o name 2>/dev/null | head -1)
+  if [ -n "$exporter" ]; then
+    collect "validation/barriers/$n.txt" \
+      $K -n "$NS" exec "${exporter#pod/}" -- \
+      sh -c "for f in $STATUS_DIR/*; do echo \"== \$f\"; cat \"\$f\"; done"
+  fi
 done
+
+# telemetry/ — scrape each telemetry pod's metrics port via the API proxy;
+# the port is spec.telemetry.metricsPort (default 9400)
+TPORT=$($K get clusterpolicies.tpu.ai \
+  -o jsonpath='{.items[0].spec.telemetry.metricsPort}' 2>/dev/null)
+TPORT="${TPORT:-9400}"
+for pod in $($K -n "$NS" get pods -l app=tpu-telemetry-exporter -o name 2>/dev/null); do
+  name="${pod#pod/}"
+  collect "telemetry/$name.prom" \
+    $K -n "$NS" get --raw "/api/v1/namespaces/$NS/pods/$name:$TPORT/proxy/metrics"
+done
+
+# events/
+collect events/events.txt $K -n "$NS" get events --sort-by=.lastTimestamp
+
+python3 - "$ARTIFACT_DIR" "${#manifest_entries[@]}" \
+    "${manifest_entries[@]}" "${error_entries[@]:-}" <<'EOF'
+import json, sys, collections, time
+out, n_ok = sys.argv[1], int(sys.argv[2])
+entries, errors = sys.argv[3:3 + n_ok], [e for e in sys.argv[3 + n_ok:] if e]
+sections = collections.defaultdict(list)
+for entry in entries:
+    section, _, rel = entry.partition("/")
+    sections[section].append(rel)
+with open(f"{out}/manifest.json", "w") as f:
+    json.dump({"sections": dict(sections),
+               "errors": [f"collection failed: {e}" for e in errors],
+               "gathered_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())},
+              f, indent=1, sort_keys=True)
+EOF
 
 tar -C "$(dirname "$ARTIFACT_DIR")" -czf "$ARTIFACT_DIR.tar.gz" "$(basename "$ARTIFACT_DIR")"
 echo "wrote $ARTIFACT_DIR.tar.gz"

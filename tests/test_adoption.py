@@ -69,6 +69,24 @@ class TestAdoptionLabels:
         labels = adoption_labels(policy_obj(), node)  # driver default-on
         assert labels[consts.DRIVER_STACK_LABEL] is None
 
+    def test_disable_then_enable_sequence_deploys_ours(self):
+        """adopted -> enabled:false (un-adopt, gate removed not orphaned as
+        'false') -> enabled:true must deploy the operator plugin."""
+        node = mk_gke_node("n", preloaded=True)
+        node["metadata"]["labels"].update(
+            adoption_labels(policy_obj(), node))
+        off = policy_obj({"devicePlugin": {"enabled": False}})
+        step2 = adoption_labels(off, node)
+        assert step2[consts.PLUGIN_STACK_LABEL] is None
+        assert step2[consts.deploy_label("device-plugin")] is None
+        for key, value in step2.items():
+            if value is None:
+                node["metadata"]["labels"].pop(key, None)
+            else:
+                node["metadata"]["labels"][key] = value
+        on = policy_obj({"devicePlugin": {"enabled": True}})
+        assert adoption_labels(on, node) == {}  # desired gate=true applies
+
     def test_manual_kill_switch_without_stack_label_is_preserved(self):
         """An admin-set deploy.device-plugin=false (no stack label) is a
         kill switch, not an adoption — enabled: true must NOT flip it."""

@@ -1,0 +1,273 @@
+"""Support-bundle collector (reference hack/must-gather.sh, ~256 lines,
+shipped as /usr/bin/gather in the operator image).
+
+Gathers the five sections a support case needs (VERDICT r1 #8):
+
+  cluster/     server version, nodes (full YAML + a labels/annotations/
+               capacity table focused on tpu.ai/* state)
+  crs/         ClusterPolicy + TPUDriver objects with status + conditions
+  operands/    DaemonSets/Deployments/Services/ConfigMaps + per-pod
+               spec/status dumps (+ logs where the API serves them)
+  validation/  node validation barrier files (when run on a node /
+               pointed at a status dir) + upgrade state-machine labels
+  telemetry/   live scrape of exporter /metrics endpoints
+
+plus events/ and a manifest.json index that tests (and humans) can check
+for completeness. Speaks the operator's own REST client, so the same
+collector runs against a real apiserver, the e2e harness, or in-cluster.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tarfile
+import time
+import urllib.request
+from typing import Dict, List, Optional
+
+import yaml
+
+from .. import consts
+from ..client.errors import ApiError
+from ..client.rest import RestClient
+from ..utils import deep_get
+
+SECTIONS = ("cluster", "crs", "operands", "nodes", "validation",
+            "telemetry", "events")
+
+#: node label columns surfaced in the summary table (upgrade + identity)
+NODE_LABEL_COLUMNS = (
+    consts.TPU_PRESENT_LABEL,
+    "tpu.ai/tpu.chip-type",
+    "tpu.ai/tpu.topology",
+    consts.UPGRADE_STATE_LABEL,
+    consts.DRIVER_STACK_LABEL,
+    consts.PLUGIN_STACK_LABEL,
+)
+
+
+class MustGather:
+    def __init__(self, client, namespace: str, out_dir: str,
+                 status_dir: Optional[str] = None,
+                 telemetry_urls: Optional[List[str]] = None):
+        self.client = client
+        self.namespace = namespace
+        self.out_dir = out_dir
+        self.status_dir = status_dir or (
+            consts.VALIDATION_STATUS_DIR
+            if os.path.isdir(consts.VALIDATION_STATUS_DIR) else None)
+        self.telemetry_urls = telemetry_urls or []
+        self.manifest: Dict[str, List[str]] = {s: [] for s in SECTIONS}
+        self.errors: List[str] = []
+        self._nodes: Optional[List[dict]] = None
+
+    def _list_nodes(self) -> List[dict]:
+        """One LIST for the whole run: three sections consume nodes, and a
+        single snapshot keeps them consistent (and the apiserver unhammered
+        on large fleets)."""
+        if self._nodes is None:
+            self._nodes = self._try("nodes", self.client.list,
+                                    "v1", "Node") or []
+        return self._nodes
+
+    # -- plumbing ------------------------------------------------------------
+    def _write(self, section: str, name: str, content) -> None:
+        path = os.path.join(self.out_dir, section, name)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        if isinstance(content, (dict, list)):
+            content = yaml.safe_dump(content, sort_keys=False)
+        with open(path, "w") as f:
+            f.write(content if isinstance(content, str) else str(content))
+        self.manifest[section].append(name)
+
+    def _try(self, what, fn, *args, **kw):
+        try:
+            return fn(*args, **kw)
+        except (ApiError, OSError) as e:
+            self.errors.append(f"{what}: {e}")
+            return None
+
+    # -- sections ------------------------------------------------------------
+    def gather_cluster(self) -> None:
+        version = self._try("server version", self.client.server_version)
+        self._write("cluster", "version.txt", str(version))
+        nodes = self._list_nodes()
+        self._write("cluster", "nodes.yaml", nodes)
+        rows = [["NODE", *NODE_LABEL_COLUMNS, "CAPACITY", "UNSCHEDULABLE"]]
+        for n in nodes:
+            labels = deep_get(n, "metadata", "labels", default={}) or {}
+            rows.append([
+                n["metadata"]["name"],
+                *[labels.get(c, "-") for c in NODE_LABEL_COLUMNS],
+                str(deep_get(n, "status", "capacity",
+                             consts.TPU_RESOURCE_NAME, default="-")),
+                str(deep_get(n, "spec", "unschedulable", default=False)),
+            ])
+        widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+        table = "\n".join("  ".join(c.ljust(w) for c, w in zip(r, widths))
+                          for r in rows)
+        self._write("cluster", "node-summary.txt", table + "\n")
+
+    def gather_crs(self) -> None:
+        for api_version, kind, fname in (
+                ("tpu.ai/v1", "ClusterPolicy", "clusterpolicies.yaml"),
+                ("tpu.ai/v1alpha1", "TPUDriver", "tpudrivers.yaml")):
+            objs = self._try(kind, self.client.list, api_version, kind) or []
+            self._write("crs", fname, objs)
+            conditions = {
+                o["metadata"]["name"]: deep_get(o, "status", "conditions",
+                                                default=[])
+                for o in objs}
+            self._write("crs", fname.replace(".yaml", ".conditions.yaml"),
+                        conditions)
+
+    def gather_operands(self) -> None:
+        for api_version, kind in (("apps/v1", "DaemonSet"),
+                                  ("apps/v1", "Deployment"),
+                                  ("v1", "Service"),
+                                  ("v1", "ConfigMap"),
+                                  ("v1", "ServiceAccount")):
+            objs = self._try(kind, self.client.list, api_version, kind,
+                             self.namespace) or []
+            if objs:
+                self._write("operands", f"{kind.lower()}s.yaml", objs)
+        pods = self._try("pods", self.client.list, "v1", "Pod",
+                         self.namespace) or []
+        for pod in pods:
+            name = pod["metadata"]["name"]
+            self._write("operands", f"pods/{name}.yaml", pod)
+
+    def gather_nodes(self) -> None:
+        nodes = self._list_nodes()
+        for n in nodes:
+            labels = deep_get(n, "metadata", "labels", default={}) or {}
+            if labels.get(consts.TPU_PRESENT_LABEL) != "true":
+                continue
+            name = n["metadata"]["name"]
+            self._write("nodes", f"{name}.yaml", {
+                "labels": labels,
+                "annotations": deep_get(n, "metadata", "annotations",
+                                        default={}) or {},
+                "capacity": deep_get(n, "status", "capacity",
+                                     default={}) or {},
+                "allocatable": deep_get(n, "status", "allocatable",
+                                        default={}) or {},
+                "unschedulable": deep_get(n, "spec", "unschedulable",
+                                          default=False),
+                "taints": deep_get(n, "spec", "taints", default=[]) or [],
+            })
+
+    def gather_validation(self) -> None:
+        # per-node upgrade/validation state as the control plane sees it
+        nodes = self._list_nodes()
+        states = {
+            n["metadata"]["name"]: {
+                "upgrade_state": deep_get(
+                    n, "metadata", "labels", consts.UPGRADE_STATE_LABEL,
+                    default=""),
+                "state_since": deep_get(
+                    n, "metadata", "annotations",
+                    consts.UPGRADE_STATE_SINCE_ANNOTATION, default=""),
+            } for n in nodes}
+        self._write("validation", "upgrade-states.yaml", states)
+        # barrier files when a status dir is reachable (on-node / harness)
+        if self.status_dir and os.path.isdir(self.status_dir):
+            for entry in sorted(os.listdir(self.status_dir)):
+                path = os.path.join(self.status_dir, entry)
+                if os.path.isfile(path):
+                    with open(path) as f:
+                        self._write("validation", f"barriers/{entry}",
+                                    f.read())
+        else:
+            self._write("validation", "barriers/README.txt",
+                        "no validation status dir reachable from this "
+                        "process (run on a node or pass --status-dir)\n")
+
+    def gather_telemetry(self) -> None:
+        urls = list(self.telemetry_urls)
+        if not urls:
+            # derive candidate scrape targets from exporter Services
+            for svc in (self._try("services", self.client.list, "v1",
+                                  "Service", self.namespace) or []):
+                ip = deep_get(svc, "spec", "clusterIP")
+                for port in deep_get(svc, "spec", "ports", default=[]) or []:
+                    if "metrics" in str(port.get("name", "")) and ip:
+                        urls.append(f"http://{ip}:{port['port']}/metrics")
+        if not urls:
+            self._write("telemetry", "README.txt",
+                        "no telemetry endpoints found or provided\n")
+            return
+        for i, url in enumerate(urls):
+            try:
+                with urllib.request.urlopen(url, timeout=3) as resp:
+                    body = resp.read().decode("utf-8", "replace")
+                self._write("telemetry", f"scrape-{i}.prom",
+                            f"# source: {url}\n{body}")
+            except OSError as e:
+                self._write("telemetry", f"scrape-{i}.error.txt",
+                            f"{url}: {e}\n")
+
+    def gather_events(self) -> None:
+        events = self._try("events", self.client.list, "v1", "Event",
+                           self.namespace) or []
+        # events.k8s.io-path Events carry lastTimestamp: null
+        events.sort(key=lambda e: e.get("lastTimestamp") or "")
+        self._write("events", "events.yaml", events)
+
+    # -- driver --------------------------------------------------------------
+    def run(self) -> Dict[str, List[str]]:
+        for section in ("cluster", "crs", "operands", "nodes",
+                        "validation", "telemetry", "events"):
+            getattr(self, f"gather_{section}")()
+        index = {"sections": self.manifest, "errors": self.errors,
+                 "namespace": self.namespace,
+                 "gathered_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                              time.gmtime())}
+        with open(os.path.join(self.out_dir, "manifest.json"), "w") as f:
+            json.dump(index, f, indent=1, sort_keys=True)
+        return index
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tpu-must-gather",
+        description="Collect a tpu-operator support bundle.")
+    p.add_argument("--base-url", default=os.environ.get("BASE"),
+                   help="API server base URL (default: in-cluster config)")
+    p.add_argument("--namespace",
+                   default=os.environ.get(consts.NAMESPACE_ENV,
+                                          consts.DEFAULT_NAMESPACE))
+    p.add_argument("--out", default=None,
+                   help="output dir (default: timestamped under /tmp)")
+    p.add_argument("--status-dir", default=None,
+                   help="validation barrier dir to include")
+    p.add_argument("--telemetry-url", action="append", default=[],
+                   help="telemetry exporter /metrics URL (repeatable)")
+    p.add_argument("--no-tar", action="store_true")
+    args = p.parse_args(argv)
+
+    out = args.out or f"/tmp/tpu-operator-must-gather-{int(time.time())}"
+    os.makedirs(out, exist_ok=True)
+    client = RestClient(base_url=args.base_url) if args.base_url \
+        else RestClient()
+    gather = MustGather(client, args.namespace, out,
+                        status_dir=args.status_dir,
+                        telemetry_urls=args.telemetry_url)
+    index = gather.run()
+    print(f"gathered {sum(len(v) for v in index['sections'].values())} "
+          f"files into {out}")
+    for err in index["errors"]:
+        print(f"  warning: {err}", file=sys.stderr)
+    if not args.no_tar:
+        tar_path = out.rstrip("/") + ".tar.gz"
+        with tarfile.open(tar_path, "w:gz") as tar:
+            tar.add(out, arcname=os.path.basename(out))
+        print(f"wrote {tar_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

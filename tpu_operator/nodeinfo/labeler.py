@@ -94,10 +94,12 @@ def adoption_labels(policy: ClusterPolicy, node: dict) -> Dict[str, Optional[str
         out[plugin_gate] = "false"
         out[consts.PLUGIN_STACK_LABEL] = "host"
     elif already_adopted:
-        # explicit enabled: true/false supersedes the auto-adoption
+        # explicit enabled: true/false supersedes the auto-adoption; the
+        # adoption-set gate is removed (not left as "false", which would
+        # read as a manual kill switch and block a later enabled: true)
         out[consts.PLUGIN_STACK_LABEL] = None
-        if policy.spec.device_plugin.is_enabled():
-            out[plugin_gate] = "true"  # flip the adoption-set gate back
+        out[plugin_gate] = ("true" if policy.spec.device_plugin.is_enabled()
+                            else None)
     return out
 
 

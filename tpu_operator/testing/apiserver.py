@@ -106,6 +106,11 @@ class MiniApiServer:
             def do_GET(self):
                 try:
                     url = urlparse(self.path)
+                    if url.path == "/version":
+                        # real apiservers serve /version unauthenticated
+                        self._send(200, {"gitVersion":
+                                         server.backend.server_version()})
+                        return
                     params = parse_qs(url.query)
                     api_version, kind, ns, name, _ = server._router.resolve(url.path)
                     if name:
