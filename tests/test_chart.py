@@ -1,0 +1,177 @@
+"""Chart fidelity (VERDICT r1 #9): render the REAL chart templates and
+validate the output — values<->CRD 1:1 coverage, schema-valid rendered CR,
+install-path parity with deploy/operator.yaml — the reference validates
+chart values against its CRD the same way (Makefile validate-helm-values).
+"""
+
+import os
+import re
+
+import pytest
+import yaml
+
+from tpu_operator.api import schema_gen, schema_validate
+from tpu_operator.testing.helmlite import HelmLite
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHART = os.path.join(REPO, "deployments", "tpu-operator")
+
+#: values keys that configure the chart itself, not ClusterPolicy spec
+CHART_ONLY_KEYS = {"tpuDriver"}
+#: operator-section keys consumed by the Deployment template, not the CR
+OPERATOR_CHART_KEYS = {"image", "version", "imagePullPolicy", "replicas",
+                       "resources"}
+
+
+@pytest.fixture(scope="module")
+def rendered():
+    return HelmLite(CHART).render_all()
+
+
+def test_render_produces_all_install_objects(rendered):
+    kinds = {(o["kind"], o["metadata"]["name"]) for o in rendered}
+    assert ("ClusterPolicy", "cluster-policy") in kinds
+    assert ("Deployment", "tpu-operator") in kinds
+    assert ("ServiceAccount", "tpu-operator") in kinds
+    assert ("ClusterRole", "tpu-operator") in kinds
+    assert ("ClusterRoleBinding", "tpu-operator") in kinds
+    # helm installs crds/ automatically; render_all folds them in
+    assert ("CustomResourceDefinition", "clusterpolicies.tpu.ai") in kinds
+    assert ("CustomResourceDefinition", "tpudrivers.tpu.ai") in kinds
+
+
+def test_rendered_clusterpolicy_passes_crd_schema(rendered):
+    """The strongest possible values<->CRD check: the CR the chart actually
+    installs must be admitted by the schema a real apiserver enforces."""
+    cp = next(o for o in rendered if o["kind"] == "ClusterPolicy")
+    errors = schema_validate.validate_cr(cp, schema_gen.clusterpolicy_crd())
+    assert errors == []
+
+
+def test_operator_cr_fields_actually_render():
+    """operator.runtimeClass/labels/annotations/initContainer must land in
+    the CR, not silently drop (the operator values section mixes chart-only
+    keys with CR keys, so the template picks explicitly)."""
+    objs = HelmLite(CHART, values={"operator": {
+        "runtimeClass": "custom-tpu",
+        "labels": {"team": "ml"},
+        "annotations": {"note": "x"},
+        "initContainer": {"image": "busybox", "version": "1.36"},
+    }}).render_all()
+    cp = next(o for o in objs if o["kind"] == "ClusterPolicy")
+    op = cp["spec"]["operator"]
+    assert op["runtimeClass"] == "custom-tpu"
+    assert op["labels"] == {"team": "ml"}
+    assert op["annotations"] == {"note": "x"}
+    assert op["initContainer"]["image"] == "busybox"
+    assert schema_validate.validate_cr(cp, schema_gen.clusterpolicy_crd()) == []
+
+
+def test_tpudriver_variant_passes_crd_schema():
+    objs = HelmLite(CHART, values={
+        "tpuDriver": {"enabled": True, "name": "pool-a",
+                      "nodeSelector": {"cloud.google.com/gke-tpu-accelerator":
+                                       "tpu-v5-lite-podslice"}}}).render_all()
+    drv = next(o for o in objs if o["kind"] == "TPUDriver")
+    errors = schema_validate.validate_cr(drv, schema_gen.tpudriver_crd())
+    assert errors == []
+
+
+def test_values_cover_every_crd_spec_field():
+    """1:1 coverage: every property the ClusterPolicy schema accepts must
+    appear in values.yaml — as a live key or a documented commented-out
+    default (reference values.yaml mirrors ClusterPolicySpec completely)."""
+    crd = schema_gen.clusterpolicy_crd()
+    spec_props = (crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
+                  ["properties"]["spec"]["properties"])
+    values_text = open(os.path.join(CHART, "values.yaml")).read()
+    values = yaml.safe_load(values_text)
+
+    missing = []
+    for section, schema in spec_props.items():
+        section_values = values.get(section)
+        if section_values is None:
+            missing.append(section)
+            continue
+        # section text including comments (documented optionals count)
+        m = re.search(rf"^{section}:\n((?:[ #].*\n|\n)*)", values_text,
+                      re.MULTILINE)
+        section_text = m.group(1) if m else ""
+        for prop in schema.get("properties", {}):
+            if section == "operator" and prop in OPERATOR_CHART_KEYS:
+                continue
+            if prop in section_values or f"{prop}:" in section_text:
+                continue
+            missing.append(f"{section}.{prop}")
+    assert missing == [], f"values.yaml missing CRD fields: {missing}"
+
+
+def test_no_unknown_values_keys():
+    """Reverse direction: every ClusterPolicy-bound values section key must
+    be accepted by the schema (catches typos in values.yaml)."""
+    crd = schema_gen.clusterpolicy_crd()
+    spec_props = (crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
+                  ["properties"]["spec"]["properties"])
+    values = yaml.safe_load(open(os.path.join(CHART, "values.yaml")))
+    for section, content in values.items():
+        if section in CHART_ONLY_KEYS:
+            continue
+        assert section in spec_props, f"values section {section} not in CRD"
+        schema = spec_props[section].get("properties", {})
+        for key in (content or {}):
+            if section == "operator" and key in OPERATOR_CHART_KEYS:
+                continue
+            assert key in schema, f"values.{section}.{key} not in CRD schema"
+
+
+def test_chart_deployment_matches_static_install(rendered):
+    """The chart and deploy/operator.yaml are two routes to the same
+    operator Deployment; their images env and container commands must not
+    drift apart."""
+    chart_dep = next(o for o in rendered if o["kind"] == "Deployment")
+    with open(os.path.join(REPO, "deploy", "operator.yaml")) as f:
+        static_dep = next(d for d in yaml.safe_load_all(f)
+                          if d and d["kind"] == "Deployment")
+
+    def container(dep):
+        return dep["spec"]["template"]["spec"]["containers"][0]
+
+    chart_ctr, static_ctr = container(chart_dep), container(static_dep)
+    assert chart_ctr["command"] == static_ctr["command"]
+    chart_envs = {e["name"] for e in chart_ctr["env"]}
+    static_envs = {e["name"] for e in static_ctr["env"]}
+    assert chart_envs == static_envs, (chart_envs ^ static_envs)
+    assert [p["containerPort"] for p in chart_ctr["ports"]] == \
+        [p["containerPort"] for p in static_ctr["ports"]]
+
+
+def test_chart_crds_identical_to_canonical():
+    for fname in ("tpu.ai_clusterpolicies.yaml", "tpu.ai_tpudrivers.yaml"):
+        chart_crd = open(os.path.join(CHART, "crds", fname)).read()
+        canonical = open(os.path.join(
+            REPO, "tpu_operator", "api", "crds", fname)).read()
+        assert chart_crd == canonical
+
+
+def test_validate_csv_checks_crd_presence(capsys):
+    from tpu_operator.cfgtool.main import run
+
+    csv_path = os.path.join(REPO, "bundle", "manifests",
+                            "tpu-operator.clusterserviceversion.yaml")
+    assert run(["validate-csv", csv_path]) == 0
+    out = capsys.readouterr().out
+    assert "clusterpolicies.tpu.ai: shipped" in out
+    assert "tpudrivers.tpu.ai: shipped" in out
+
+
+def test_validate_csv_fails_when_crds_absent(tmp_path, capsys):
+    import shutil
+
+    from tpu_operator.cfgtool.main import run
+
+    src = os.path.join(REPO, "bundle", "manifests",
+                       "tpu-operator.clusterserviceversion.yaml")
+    dst = tmp_path / "csv.yaml"
+    shutil.copy(src, dst)  # CSV alone, no CRD files next to it
+    assert run(["validate-csv", str(dst)]) == 1
+    assert "NOT shipped" in capsys.readouterr().out

@@ -88,8 +88,12 @@ def validate_doc(doc: dict) -> list:
 
 def validate_csv(path: str) -> int:
     """Validate the alm-examples CRs embedded in an OLM CSV (reference
-    cmd/gpuop-cfg validates the same surface)."""
+    cmd/gpuop-cfg validates the same surface), and that every CRD the CSV
+    declares as owned is actually shipped next to it in the bundle (the
+    reference bundle/manifests includes both CRD YAMLs — a CSV without
+    them is not installable by OLM)."""
     import json
+    import os
 
     try:
         with open(path) as f:
@@ -100,6 +104,7 @@ def validate_csv(path: str) -> int:
     if not isinstance(csv, dict):
         print(f"{path}: not a CSV document (parsed {type(csv).__name__})")
         return 1
+
     raw = csv.get("metadata", {}).get("annotations", {}).get("alm-examples")
     if raw is None:
         print(f"{path}: missing alm-examples annotation")
@@ -125,6 +130,39 @@ def validate_csv(path: str) -> int:
                 print(f"{path}: alm-example {doc.get('kind')}/{name}: {err}")
         else:
             print(f"{path}: alm-example {doc.get('kind')}/{name}: OK")
+
+    # every owned CRD must ship next to the CSV, with a description
+    owned = (csv.get("spec", {}).get("customresourcedefinitions", {})
+             .get("owned") or [])
+    if not owned:
+        print(f"{path}: CSV owns no CRDs")
+        failed = True
+    bundle_dir = os.path.dirname(os.path.abspath(path))
+    shipped = {}
+    for fname in os.listdir(bundle_dir):
+        if not fname.endswith((".yaml", ".yml")) or fname == os.path.basename(path):
+            continue
+        try:
+            with open(os.path.join(bundle_dir, fname)) as f:
+                for doc in yaml.safe_load_all(f):
+                    if isinstance(doc, dict) and \
+                            doc.get("kind") == "CustomResourceDefinition":
+                        name = doc.get("metadata", {}).get("name")
+                        if name:
+                            shipped[name] = fname
+        except (OSError, yaml.YAMLError):
+            continue  # unreadable sibling (dir named *.yaml, perms) is
+            # someone else's problem; we only need the CRDs we can read
+    for entry in owned:
+        name = entry.get("name", "?")
+        if not entry.get("description"):
+            print(f"{path}: owned CRD {name}: missing description")
+            failed = True
+        if name in shipped:
+            print(f"{path}: owned CRD {name}: shipped in {shipped[name]}")
+        else:
+            print(f"{path}: owned CRD {name}: NOT shipped in bundle dir")
+            failed = True
     return 1 if failed else 0
 
 

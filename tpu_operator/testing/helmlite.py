@@ -1,0 +1,203 @@
+"""Minimal Helm-template renderer for chart fidelity tests.
+
+No helm binary ships in the test image, but the chart templates
+(deployments/tpu-operator/templates/) use a small, stable subset of
+Go-template syntax; rendering that subset in-process lets tests validate
+the REAL chart output — e.g. the rendered ClusterPolicy against the
+generated CRD schema — the way the reference validates chart values
+against its CRD (reference Makefile `validate-helm-values`).
+
+Supported subset (everything the chart uses):
+
+- ``{{ .Values.a.b }}`` / ``{{ .Release.X }}`` / ``{{ .Chart.X }}``
+  inline interpolation
+- ``{{- toYaml EXPR | nindent N }}`` on its own line
+- ``{{- include "name" . | nindent N }}`` with ``{{- define "name" -}}``
+  blocks loaded from ``_helpers.tpl``
+- ``{{- if EXPR }} ... {{- end }}`` and ``{{- with EXPR }} ... {{- end }}``
+  occupying whole lines (``.`` inside a with-block is the scoped value)
+
+Anything outside the subset raises, so a chart edit that outgrows the
+renderer fails loudly instead of silently skipping validation.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Dict, List, Optional
+
+import yaml
+
+from ..utils.objects import deep_merge
+
+_INLINE = re.compile(r"\{\{-?\s*(\.[A-Za-z0-9_.]+)\s*-?\}\}")
+_CONTROL = re.compile(
+    r"^(\s*)\{\{-?\s*(if|with)\s+(.+?)\s*-?\}\}\s*$")
+_END = re.compile(r"^\s*\{\{-?\s*end\s*-?\}\}\s*$", re.MULTILINE)
+_TOYAML = re.compile(
+    r"^(\s*)\{\{-?\s*toYaml\s+(\.[A-Za-z0-9_.]*|\.)\s*\|\s*nindent\s+(\d+)\s*-?\}\}\s*$")
+_INCLUDE = re.compile(
+    r'^(\s*)\{\{-?\s*include\s+"([^"]+)"\s+\.\s*\|\s*nindent\s+(\d+)\s*-?\}\}\s*$')
+_DEFINE = re.compile(r'\{\{-?\s*define\s+"([^"]+)"\s*-?\}\}')
+
+
+class HelmLite:
+    def __init__(self, chart_dir: str, values: Optional[Dict[str, Any]] = None,
+                 release_namespace: str = "tpu-operator",
+                 release_name: str = "tpu-operator"):
+        self.chart_dir = chart_dir
+        with open(os.path.join(chart_dir, "Chart.yaml")) as f:
+            chart = yaml.safe_load(f)
+        with open(os.path.join(chart_dir, "values.yaml")) as f:
+            base_values = yaml.safe_load(f) or {}
+        # base_values is a fresh local load, so in-place merge is fine
+        self.context = {
+            "Values": deep_merge(base_values, values or {}),
+            "Release": {"Namespace": release_namespace,
+                        "Name": release_name, "Service": "Helm"},
+            "Chart": {"Name": chart.get("name", ""),
+                      "Version": str(chart.get("version", "")),
+                      "AppVersion": str(chart.get("appVersion", ""))},
+        }
+        self.defines = self._load_defines()
+
+    def _load_defines(self) -> Dict[str, str]:
+        defines: Dict[str, str] = {}
+        helpers = os.path.join(self.chart_dir, "templates", "_helpers.tpl")
+        if not os.path.exists(helpers):
+            return defines
+        with open(helpers) as f:
+            text = f.read()
+        for m in _DEFINE.finditer(text):
+            name = m.group(1)
+            rest = text[m.end():]
+            end = _END.search(rest)
+            if end is None:
+                raise ValueError(f"define {name!r} has no end")
+            defines[name] = rest[:end.start()].strip("\n")
+        return defines
+
+    # -- expression evaluation ----------------------------------------------
+    def _lookup(self, expr: str, scope: Any) -> Any:
+        expr = expr.strip()
+        if expr == ".":
+            return scope
+        if not expr.startswith(".") or expr.split(".")[1] not in (
+                "Values", "Release", "Chart"):
+            # real Helm resolves bare .foo against the with-scope; this
+            # renderer doesn't model scoped lookup, so fail loudly rather
+            # than silently resolving from the root context
+            raise ValueError(f"unsupported expression {expr!r}")
+        node: Any = self.context
+        for part in expr.lstrip(".").split("."):
+            if isinstance(node, dict):
+                node = node.get(part)
+            else:
+                return None
+            if node is None:
+                return None
+        return node
+
+    def _interp(self, line: str, scope: Any) -> str:
+        def sub(m):
+            value = self._lookup(m.group(1), scope)
+            if value is None:
+                return ""
+            if isinstance(value, bool):
+                return "true" if value else "false"
+            if isinstance(value, (dict, list)):
+                # inline interpolation of a structure would emit Python
+                # repr, not Helm's output — the template needs toYaml
+                raise ValueError(
+                    f"inline interpolation of non-scalar {m.group(1)!r}; "
+                    f"use toYaml | nindent")
+            return str(value)
+        out = _INLINE.sub(sub, line)
+        if "{{" in out:
+            raise ValueError(f"unsupported template syntax: {line.strip()!r}")
+        return out
+
+    # -- block rendering -----------------------------------------------------
+    def _render_lines(self, lines: List[str], scope: Any) -> List[str]:
+        out: List[str] = []
+        i = 0
+        while i < len(lines):
+            line = lines[i]
+            ctl = _CONTROL.match(line)
+            if ctl:
+                _indent, keyword, expr = ctl.groups()
+                block, i = self._collect_block(lines, i + 1)
+                value = self._lookup(expr, scope)
+                if value:
+                    inner_scope = value if keyword == "with" else scope
+                    out.extend(self._render_lines(block, inner_scope))
+                continue
+            ty = _TOYAML.match(line)
+            if ty:
+                _indent, expr, n = ty.groups()
+                value = self._lookup(expr, scope)
+                if value is not None:
+                    dumped = yaml.safe_dump(value, sort_keys=False,
+                                            default_flow_style=False).rstrip()
+                    pad = " " * int(n)
+                    out.extend(pad + l for l in dumped.splitlines())
+                i += 1
+                continue
+            inc = _INCLUDE.match(line)
+            if inc:
+                _indent, name, n = inc.groups()
+                body = self.defines.get(name)
+                if body is None:
+                    raise ValueError(f"include of undefined template {name!r}")
+                rendered = self._render_lines(body.splitlines(), scope)
+                pad = " " * int(n)
+                out.extend(pad + l for l in rendered)
+                i += 1
+                continue
+            if _END.match(line):
+                raise ValueError("unbalanced {{ end }}")
+            out.append(self._interp(line, scope))
+            i += 1
+        return out
+
+    def _collect_block(self, lines: List[str], start: int):
+        depth = 1
+        block: List[str] = []
+        i = start
+        while i < len(lines):
+            if _CONTROL.match(lines[i]):
+                depth += 1
+            elif _END.match(lines[i]):
+                depth -= 1
+                if depth == 0:
+                    return block, i + 1
+            block.append(lines[i])
+            i += 1
+        raise ValueError("unterminated control block")
+
+    # -- public API ----------------------------------------------------------
+    def render_template(self, name: str) -> str:
+        path = os.path.join(self.chart_dir, "templates", name)
+        with open(path) as f:
+            lines = f.read().splitlines()
+        return "\n".join(self._render_lines(lines, None)) + "\n"
+
+    def render_all(self) -> List[dict]:
+        """Every template (skipping _helpers) + crds/, parsed to objects —
+        the moral equivalent of ``helm template`` output."""
+        objs: List[dict] = []
+        tdir = os.path.join(self.chart_dir, "templates")
+        for fname in sorted(os.listdir(tdir)):
+            if fname.startswith("_"):
+                continue
+            text = self.render_template(fname)
+            objs.extend(d for d in yaml.safe_load_all(text) if d)
+        crds = os.path.join(self.chart_dir, "crds")
+        if os.path.isdir(crds):
+            for fname in sorted(os.listdir(crds)):
+                with open(os.path.join(crds, fname)) as f:
+                    objs.extend(d for d in yaml.safe_load_all(f) if d)
+        return objs
+
+
