@@ -4,12 +4,18 @@
 # Built per libtpu release: the pinned libtpu wheel IS the "driver" payload
 # (reference ships a driver image per kernel/driver version the same way).
 ARG LIBTPU_VERSION=latest
+#: "tpu" (default) bundles the pinned libtpu wheel; "cpu" builds a light
+#: image for control-plane e2e (kind) where JAX runs on CPU
+ARG JAX_VARIANT=tpu
 FROM python:3.12-slim AS base
 ARG LIBTPU_VERSION
+ARG JAX_VARIANT
 
 # LIBTPU_VERSION pins the actual payload: the bundled libtpu wheel IS what
 # driver.install() places on the host, so the label and the .so must agree.
-RUN if [ "$LIBTPU_VERSION" = "latest" ]; then \
+RUN if [ "$JAX_VARIANT" = "cpu" ]; then \
+      pip install --no-cache-dir jax; \
+    elif [ "$LIBTPU_VERSION" = "latest" ]; then \
       pip install --no-cache-dir "jax[tpu]" \
         -f https://storage.googleapis.com/jax-releases/libtpu_releases.html; \
     else \
@@ -33,5 +39,15 @@ RUN apt-get update && apt-get install -y --no-install-recommends g++ make \
     && install -m 0755 native/tpu-exporter/build/tpu-exporter /usr/local/bin/tpu-exporter \
     && apt-get purge -y g++ make && apt-get autoremove -y && rm -rf /var/lib/apt/lists/*
 
+# the LIBTPU_VERSION label and the payload must agree: cpu builds ship no
+# libtpu wheel, so they must not advertise one (feature discovery stamps
+# this env onto node labels)
+FROM base AS variant-tpu
+ARG LIBTPU_VERSION
 ENV LIBTPU_VERSION=${LIBTPU_VERSION}
+
+FROM base AS variant-cpu
+ENV LIBTPU_VERSION=none
+
+FROM variant-${JAX_VARIANT}
 ENTRYPOINT ["tpu-validator"]

@@ -1,0 +1,165 @@
+#!/usr/bin/env bash
+# Real-API-server e2e (VERDICT r1 #2; BASELINE config #1): everything the
+# in-repo MiniApiServer e2es assert, replayed against a REAL kube-apiserver
+# in an ephemeral kind cluster:
+#
+#   1. CRDs + operator install from deploy/operator.yaml alone (quickstart)
+#   2. a typo'd ClusterPolicy field is rejected BY THE APISERVER (422)
+#   3. reconcile-to-ready on a stub TPU node: host-driver adoption against
+#      a node-prepped fake libtpu, the builtin device plugin registering
+#      with the REAL kubelet and advertising google.com/tpu, the workload
+#      validation allreduce running on CPU JAX
+#   4. disable/enable an operand flips its DaemonSet
+#   5. deleting the ClusterPolicy garbage-collects owned objects (real
+#      apiserver ownerRef GC, which the fake only simulates)
+#
+# Requires kind + docker + kubectl (CI); exits 77 = skip when absent.
+set -euo pipefail
+
+for tool in kind docker kubectl; do
+  command -v "$tool" >/dev/null 2>&1 || {
+    echo "SKIP: $tool not available (kind e2e needs kind+docker+kubectl)"
+    exit 77
+  }
+done
+
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+CLUSTER="${KIND_CLUSTER_NAME:-tpu-operator-e2e}"
+NS=tpu-operator
+cd "$REPO"
+
+echo "=== build images ==="
+docker build -q -t tpu-operator:e2e -f docker/Dockerfile .
+docker build -q -t tpu-validator:e2e -f docker/validator.Dockerfile \
+  --build-arg JAX_VARIANT=cpu .
+
+echo "=== create cluster ==="
+kind create cluster --name "$CLUSTER" --wait 180s
+trap 'kind export logs /tmp/kind-e2e-logs --name "$CLUSTER" >/dev/null 2>&1 || true; kind delete cluster --name "$CLUSTER"' EXIT
+kind load docker-image tpu-operator:e2e tpu-validator:e2e --name "$CLUSTER"
+
+echo "=== install: quickstart path (CRDs + RBAC + Deployment) ==="
+kubectl apply -f deploy/operator.yaml
+kubectl -n "$NS" set image deployment/tpu-operator tpu-operator=tpu-operator:e2e
+kubectl -n "$NS" set env deployment/tpu-operator \
+  DRIVER_IMAGE=tpu-validator:e2e VALIDATOR_IMAGE=tpu-validator:e2e \
+  DEVICE_PLUGIN_IMAGE=tpu-validator:e2e FEATURE_DISCOVERY_IMAGE=tpu-validator:e2e \
+  TELEMETRY_EXPORTER_IMAGE=tpu-validator:e2e SLICE_PARTITIONER_IMAGE=tpu-validator:e2e
+kubectl -n "$NS" rollout status deployment/tpu-operator --timeout 180s
+
+echo "=== apiserver rejects a typo'd field (the generated schema at work) ==="
+if kubectl apply -f - <<'EOF' 2>/tmp/typo-err
+apiVersion: tpu.ai/v1
+kind: ClusterPolicy
+metadata: {name: typo-policy}
+spec:
+  driver: {libtpuVerion: "2025.1.0"}
+EOF
+then
+  echo "FAIL: apiserver accepted a typo'd field"; exit 1
+fi
+grep -qi "libtpuVerion\|unknown field\|ValidationError" /tmp/typo-err \
+  && echo "ok: typo rejected server-side"
+
+echo "=== node prep: fake TPU stack on a kind node ==="
+NODE=$(kubectl get nodes -o name | head -1); NODE="${NODE#node/}"
+kubectl label node "$NODE" \
+  cloud.google.com/gke-tpu-accelerator=tpu-v5-lite-podslice \
+  cloud.google.com/gke-tpu-topology=2x2 --overwrite
+# fake host libtpu (ELF magic) + fake device files, via a privileged one-shot
+kubectl apply -f - <<'EOF'
+apiVersion: apps/v1
+kind: DaemonSet
+metadata: {name: node-prep, namespace: kube-system}
+spec:
+  selector: {matchLabels: {app: node-prep}}
+  template:
+    metadata: {labels: {app: node-prep}}
+    spec:
+      tolerations: [{operator: Exists}]
+      containers:
+        - name: prep
+          image: busybox
+          command: [sh, -c]
+          args:
+            - >
+              mkdir -p /host/home/kubernetes/bin &&
+              printf '\177ELF-fake-libtpu' > /host/home/kubernetes/bin/libtpu.so &&
+              touch /host/dev/faketpu0 /host/dev/faketpu1 &&
+              sleep 1000000
+          securityContext: {privileged: true}
+          volumeMounts: [{name: host, mountPath: /host}]
+      volumes: [{name: host, hostPath: {path: /}}]
+EOF
+kubectl -n kube-system rollout status daemonset/node-prep --timeout 120s
+
+echo "=== ClusterPolicy: host-driver adoption + CPU-JAX validation ==="
+kubectl apply -f - <<'EOF'
+apiVersion: tpu.ai/v1
+kind: ClusterPolicy
+metadata: {name: cluster-policy}
+spec:
+  driver: {enabled: false}
+  devicePlugin:
+    enabled: true
+    builtinPlugin: true
+    env:
+      - {name: TPU_DEV_GLOBS, value: "/dev/faketpu*"}
+      - {name: TPU_PLUGIN_DEVICE_INJECTION, value: mounts}
+  featureDiscovery: {enabled: true}
+  telemetry: {enabled: true}
+  nodeStatusExporter: {enabled: true}
+  validator:
+    enabled: true
+    driver:
+      env:
+        - {name: TPU_DEV_GLOBS, value: "/dev/faketpu*"}
+    workload:
+      env:
+        - {name: JAX_PLATFORMS, value: cpu}
+        - {name: TPU_DEV_GLOBS, value: "/dev/faketpu*"}
+  slicePartitioner: {enabled: false}
+EOF
+
+echo "=== reconcile to ready ==="
+kubectl wait clusterpolicies.tpu.ai/cluster-policy \
+  --for jsonpath='{.status.state}'=ready --timeout 600s || {
+    echo "--- debug dump ---"
+    kubectl get clusterpolicies.tpu.ai -o yaml
+    kubectl -n "$NS" get all -o wide
+    kubectl -n "$NS" logs deploy/tpu-operator --tail=100
+    for p in $(kubectl -n "$NS" get pods -o name); do
+      echo "--- $p"; kubectl -n "$NS" describe "$p" | tail -30
+      kubectl -n "$NS" logs "$p" --all-containers --tail=30 || true
+    done
+    exit 1
+  }
+echo "ok: ClusterPolicy ready against a real apiserver"
+
+echo "=== conditions + resource advertisement ==="
+kubectl get clusterpolicies.tpu.ai/cluster-policy \
+  -o jsonpath='{.status.conditions[?(@.type=="Ready")].status}' | grep -q True
+CAP=$(kubectl get node "$NODE" -o jsonpath='{.status.capacity.google\.com/tpu}')
+[ -n "$CAP" ] && [ "$CAP" != "0" ] || {
+  echo "FAIL: google.com/tpu not advertised by the builtin plugin"; exit 1; }
+echo "ok: google.com/tpu=$CAP via real kubelet device-plugin registration"
+
+echo "=== disable/enable operand flips its DaemonSet ==="
+kubectl patch clusterpolicies.tpu.ai/cluster-policy --type merge \
+  -p '{"spec":{"telemetry":{"enabled":false}}}'
+timeout 120 bash -c \
+  'until ! kubectl -n '"$NS"' get ds tpu-telemetry-exporter >/dev/null 2>&1; do sleep 2; done'
+echo "ok: telemetry DS removed"
+kubectl patch clusterpolicies.tpu.ai/cluster-policy --type merge \
+  -p '{"spec":{"telemetry":{"enabled":true}}}'
+timeout 120 bash -c \
+  'until kubectl -n '"$NS"' get ds tpu-telemetry-exporter >/dev/null 2>&1; do sleep 2; done'
+echo "ok: telemetry DS recreated"
+
+echo "=== ClusterPolicy delete garbage-collects owned objects ==="
+kubectl delete clusterpolicies.tpu.ai/cluster-policy --wait
+timeout 180 bash -c \
+  'until [ "$(kubectl -n '"$NS"' get ds -o name | wc -l)" = 0 ]; do sleep 2; done'
+echo "ok: owned DaemonSets garbage-collected by the real apiserver"
+
+echo "=== PASS: kind e2e ==="

@@ -73,6 +73,22 @@ def test_allocate_returns_devices_mounts_envs(plugin, tmp_path):
     assert c.mounts[0].read_only and c.mounts[0].host_path.endswith("libtpu")
 
 
+def test_allocate_mounts_injection_mode(plugin, monkeypatch):
+    """TPU_PLUGIN_DEVICE_INJECTION=mounts: device paths become read-only
+    bind mounts instead of DeviceSpec entries (container runtimes reject
+    regular files as devices — the kind e2e fakes devices with files)."""
+    _, stub, _ = plugin
+    monkeypatch.setenv("TPU_PLUGIN_DEVICE_INJECTION", "mounts")
+    resp = stub.Allocate(pb.AllocateRequest(container_requests=[
+        pb.ContainerAllocateRequest(devicesIDs=["tpu-1"])]))
+    c = resp.container_responses[0]
+    assert len(c.devices) == 0
+    device_mounts = [m for m in c.mounts if "libtpu" not in m.host_path]
+    assert len(device_mounts) == 4
+    assert all(m.read_only for m in device_mounts)
+    assert c.envs["TPU_VISIBLE_CHIPS"] == "1"
+
+
 def test_allocate_partitioned_unit_sets_topology(plugin):
     p, stub, tmp_path = plugin
     write_handoff([{"topology": "2x2", "chips": [0, 1, 2, 3]}],

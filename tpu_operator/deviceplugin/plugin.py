@@ -205,9 +205,18 @@ class TPUDevicePlugin:
                     pb.CDIDevice(name=qualified_name(c)) for c in chips]))
                 continue
             dev_nodes = discover_devices()
-            devices = [pb.DeviceSpec(container_path=d, host_path=d, permissions="rw")
-                       for d in dev_nodes]
-            mounts = []
+            if os.environ.get("TPU_PLUGIN_DEVICE_INJECTION") == "mounts":
+                # sim/e2e mode: inject device paths as bind mounts —
+                # container runtimes reject regular files in DeviceSpec,
+                # and control-plane e2e (kind) fakes devices with files
+                devices = []
+                mounts = [pb.Mount(container_path=d, host_path=d,
+                                   read_only=True) for d in dev_nodes]
+            else:
+                devices = [pb.DeviceSpec(container_path=d, host_path=d,
+                                         permissions="rw")
+                           for d in dev_nodes]
+                mounts = []
             if os.path.isdir(self.libtpu_dir):
                 mounts.append(pb.Mount(container_path=self.libtpu_dir,
                                        host_path=self.libtpu_dir, read_only=True))

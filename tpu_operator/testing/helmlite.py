@@ -31,7 +31,7 @@ import yaml
 
 from ..utils.objects import deep_merge
 
-_INLINE = re.compile(r"\{\{-?\s*(\.[A-Za-z0-9_.]+)\s*-?\}\}")
+_INLINE = re.compile(r"\{\{-?\s*(\.[A-Za-z0-9_.]*)\s*-?\}\}")
 _CONTROL = re.compile(
     r"^(\s*)\{\{-?\s*(if|with)\s+(.+?)\s*-?\}\}\s*$")
 _END = re.compile(r"^\s*\{\{-?\s*end\s*-?\}\}\s*$", re.MULTILINE)
@@ -89,6 +89,13 @@ class HelmLite:
             # renderer doesn't model scoped lookup, so fail loudly rather
             # than silently resolving from the root context
             raise ValueError(f"unsupported expression {expr!r}")
+        if scope is not None:
+            # inside a with-block real Helm rebinds '.', so .Values would
+            # resolve against the scoped value (nil) and error — accepting
+            # it here would pass templates real helm rejects
+            raise ValueError(
+                f"{expr!r} inside a with-block: Helm rebinds '.'; "
+                f"use '$' forms outside this renderer's subset")
         node: Any = self.context
         for part in expr.lstrip(".").split("."):
             if isinstance(node, dict):
