@@ -26,6 +26,15 @@ if [ -n "${BASE:-}" ]; then
     ${STATUS_DIR_OVERRIDE:+--status-dir "$STATUS_DIR_OVERRIDE"}
 fi
 
+if ! command -v "${K%% *}" >/dev/null 2>&1; then  # K may be a wrapper + args
+  # operator image (/usr/bin/gather) ships no kubectl: collect through the
+  # Python collector's in-cluster REST config instead
+  exec python3 -m tpu_operator.cmd.must_gather \
+    --namespace "$NS" --out "$ARTIFACT_DIR" \
+    ${TELEMETRY_URL:+--telemetry-url "$TELEMETRY_URL"} \
+    ${STATUS_DIR_OVERRIDE:+--status-dir "$STATUS_DIR_OVERRIDE"}
+fi
+
 mkdir -p "$ARTIFACT_DIR"/{cluster,crs,operands/pods,nodes,validation/barriers,telemetry,events}
 echo "gathering into $ARTIFACT_DIR"
 manifest_entries=()

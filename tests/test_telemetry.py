@@ -108,14 +108,34 @@ def test_sysfs_source_reads_hwmon(tmp_path):
 
 
 def test_records_source_reads_partition_handoff(tmp_path):
-    handoff = {"name": "2x2", "groups": [
-        {"devices": ["/dev/accel0", "/dev/accel1"]},
-        {"devices": ["/dev/accel2", "/dev/accel3"]}]}
-    (tmp_path / "partition.json").write_text(json.dumps(handoff))
+    """Reads the REAL partitioner handoff contract
+    (partitioner.write_handoff): partition/groups[].topology/chips."""
+    from tpu_operator.partitioner.partitioner import write_handoff
+
+    write_handoff([{"topology": "1x2", "chips": [0, 1]},
+                   {"topology": "1x2", "chips": [2, 3]}],
+                  "2x2-split", handoff_dir=str(tmp_path))
     samples = RecordsSource(handoff_dir=str(tmp_path)).collect()
     assert ("tpu_slice_partitions_total", {}, 2.0) in samples
     assert ("tpu_chips_total", {}, 4.0) in samples
-    assert ("tpu_slice_partition_info", {"partition": "2x2"}, 1.0) in samples
+    assert ("tpu_slice_partition_info",
+            {"partition": "2x2-split"}, 1.0) in samples
+    # 1x2 = one real dimension -> 1 link per chip per group
+    assert ("tpu_ici_links_total", {}, 4.0) in samples
+
+
+def test_records_source_ici_links_by_dimensionality(tmp_path):
+    from tpu_operator.partitioner.partitioner import write_handoff
+
+    write_handoff([{"topology": "2x2", "chips": [0, 1, 2, 3]}], "full",
+                  handoff_dir=str(tmp_path))
+    samples = RecordsSource(handoff_dir=str(tmp_path)).collect()
+    assert ("tpu_ici_links_total", {}, 8.0) in samples  # 2 dims * 4 chips
+
+    write_handoff([{"topology": "2x2x2", "chips": list(range(8))}], "cube",
+                  handoff_dir=str(tmp_path))
+    samples = RecordsSource(handoff_dir=str(tmp_path)).collect()
+    assert ("tpu_ici_links_total", {}, 24.0) in samples  # 3 dims * 8 chips
 
 
 def test_custom_metrics_config(tmp_path):

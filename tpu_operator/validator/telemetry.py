@@ -220,16 +220,32 @@ class RecordsSource:
             return []
         with open(self.path) as f:
             handoff = json.load(f)
+        # the handoff contract (partitioner.py module docstring, shared
+        # with the device plugin): {"partition": <name>,
+        #  "groups": [{"topology": "2x2", "chips": [0,1,2,3]}]}
         samples: List[Tuple[str, Dict[str, str], float]] = []
         groups = handoff.get("groups", [])
         samples.append(("tpu_slice_partitions_total", {}, float(len(groups))))
-        chips = sum(len(g.get("devices", [])) for g in groups)
+        chips = sum(len(g.get("chips", [])) for g in groups)
         if chips:
             samples.append(("tpu_chips_total", {}, float(chips)))
-        name = handoff.get("name")
+        name = handoff.get("partition")
         if name:
             samples.append(("tpu_slice_partition_info",
                             {"partition": str(name)}, 1.0))
+        # ICI capacity from the recorded topology: a torus of N chips
+        # carries N undirected links per dimension (wraparound rings),
+        # degenerate 1-sized dimensions contributing none
+        links = 0
+        for g in groups:
+            dims = str(g.get("topology", "")).split("x")
+            try:
+                real_dims = sum(1 for d in dims if int(d) > 1)
+            except ValueError:
+                continue
+            links += real_dims * len(g.get("chips", []))
+        if links:
+            samples.append(("tpu_ici_links_total", {}, float(links)))
         return samples
 
 
