@@ -19,17 +19,12 @@ NS="${OPERATOR_NAMESPACE:-tpu-operator}"
 K="${KUBECTL:-kubectl}"
 STATUS_DIR="${VALIDATION_STATUS_DIR:-/run/tpu/validations}"
 
-if [ -n "${BASE:-}" ]; then
+# Python-collector modes: explicit BASE (harness), or no kubectl on PATH
+# (operator image ships /usr/bin/gather without kubectl — the collector
+# then uses its in-cluster REST config). K may be a wrapper + args.
+if [ -n "${BASE:-}" ] || ! command -v "${K%% *}" >/dev/null 2>&1; then
   exec python3 -m tpu_operator.cmd.must_gather \
-    --base-url "$BASE" --namespace "$NS" --out "$ARTIFACT_DIR" \
-    ${TELEMETRY_URL:+--telemetry-url "$TELEMETRY_URL"} \
-    ${STATUS_DIR_OVERRIDE:+--status-dir "$STATUS_DIR_OVERRIDE"}
-fi
-
-if ! command -v "${K%% *}" >/dev/null 2>&1; then  # K may be a wrapper + args
-  # operator image (/usr/bin/gather) ships no kubectl: collect through the
-  # Python collector's in-cluster REST config instead
-  exec python3 -m tpu_operator.cmd.must_gather \
+    ${BASE:+--base-url "$BASE"} \
     --namespace "$NS" --out "$ARTIFACT_DIR" \
     ${TELEMETRY_URL:+--telemetry-url "$TELEMETRY_URL"} \
     ${STATUS_DIR_OVERRIDE:+--status-dir "$STATUS_DIR_OVERRIDE"}

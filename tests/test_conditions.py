@@ -39,3 +39,24 @@ def test_last_transition_time_kept_when_status_unchanged(fake_client):
         fake_client.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy"), READY
     )["lastTransitionTime"]
     assert first == second
+
+
+def test_observed_generation_tracks_spec_revision(fake_client):
+    """status.observedGeneration (and per-condition observedGeneration)
+    record which spec revision the status describes — metav1 convention,
+    declared in the generated CRD schemas."""
+    obj = fake_client.create(new_cluster_policy())
+    updater = Updater(fake_client)
+    updater.set_ready(obj)
+    live = fake_client.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy")
+    assert live["status"]["observedGeneration"] == 1
+    assert get_condition(live, READY)["observedGeneration"] == 1
+
+    live["spec"]["driver"] = {"enabled": False}  # generation bump
+    fake_client.update(live)
+    live = fake_client.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy")
+    assert live["metadata"]["generation"] == 2
+    assert live["status"]["observedGeneration"] == 1  # status lags...
+    updater.set_ready(live)
+    live = fake_client.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy")
+    assert live["status"]["observedGeneration"] == 2  # ...until reconciled

@@ -74,9 +74,17 @@ def mark_error(obj: dict, reason: str, message: str) -> None:
 
 
 def _mark(obj: dict, new_conditions: List[dict]) -> None:
-    conditions = obj.setdefault("status", {}).setdefault("conditions", [])
+    status = obj.setdefault("status", {})
+    conditions = status.setdefault("conditions", [])
+    generation = obj.get("metadata", {}).get("generation")
     for c in new_conditions:
+        if generation is not None:
+            c["observedGeneration"] = generation
         set_condition(conditions, c)
+    # which spec revision this status describes (metav1 convention) — lets
+    # clients detect a status that lags a just-edited spec
+    if generation is not None:
+        status["observedGeneration"] = generation
 
 
 class Updater:
