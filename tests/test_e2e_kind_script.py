@@ -1,0 +1,81 @@
+"""Static validation of tests/e2e-kind.sh (it can only RUN in CI, where
+kind/docker exist — but its embedded manifests can be proven well-formed
+here, so CI doesn't discover YAML/schema typos at cluster-spinup cost)."""
+
+import os
+import re
+
+import yaml
+
+from tpu_operator.api import schema_gen, schema_validate
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "tests", "e2e-kind.sh")
+
+HEREDOC = re.compile(r"<<'EOF'[^\n]*\n(.*?)\nEOF", re.DOTALL)
+
+
+def heredocs():
+    with open(SCRIPT) as f:
+        return HEREDOC.findall(f.read())
+
+
+def docs():
+    out = []
+    for block in heredocs():
+        try:
+            for doc in yaml.safe_load_all(block):
+                if isinstance(doc, dict):
+                    out.append(doc)
+        except yaml.YAMLError:
+            pass  # non-YAML heredocs (none currently)
+    return out
+
+
+def test_embedded_yaml_parses():
+    parsed = docs()
+    kinds = [d.get("kind") for d in parsed]
+    assert "ClusterPolicy" in kinds
+    assert "DaemonSet" in kinds  # node-prep
+
+
+def test_good_clusterpolicy_passes_schema():
+    cps = [d for d in docs() if d.get("kind") == "ClusterPolicy"]
+    good = [d for d in cps
+            if d["metadata"]["name"] == "cluster-policy"]
+    assert good, "main ClusterPolicy heredoc missing"
+    errors = schema_validate.validate_cr(good[0],
+                                         schema_gen.clusterpolicy_crd())
+    assert errors == [], errors
+
+
+def test_typo_clusterpolicy_fails_schema():
+    """The script's negative case must actually be schema-invalid, or the
+    'apiserver rejects a typo' assertion tests nothing."""
+    cps = [d for d in docs() if d.get("kind") == "ClusterPolicy"]
+    typo = [d for d in cps if d["metadata"]["name"] == "typo-policy"]
+    assert typo, "typo-policy heredoc missing"
+    errors = schema_validate.validate_cr(typo[0],
+                                         schema_gen.clusterpolicy_crd())
+    assert any("unknown field" in e for e in errors)
+
+
+def test_node_prep_daemonset_is_wellformed():
+    ds = next(d for d in docs() if d.get("kind") == "DaemonSet")
+    spec = ds["spec"]["template"]["spec"]
+    ctr = spec["containers"][0]
+    assert ctr["securityContext"]["privileged"] is True
+    # the fake libtpu lands where HOST_LIBTPU_PATHS expects it
+    from tpu_operator.validator.driver import HOST_LIBTPU_PATHS
+
+    args = " ".join(ctr["args"])
+    assert "/host/home/kubernetes/bin/libtpu.so" in args
+    assert HOST_LIBTPU_PATHS[0] == "/home/kubernetes/bin/libtpu.so"
+    # fake devices match the TPU_DEV_GLOBS the ClusterPolicy sets
+    assert "/host/dev/faketpu0" in args
+    cp = next(d for d in docs() if d.get("kind") == "ClusterPolicy"
+              and d["metadata"]["name"] == "cluster-policy")
+    dp_env = {e["name"]: e["value"]
+              for e in cp["spec"]["devicePlugin"]["env"]}
+    assert dp_env["TPU_DEV_GLOBS"] == "/dev/faketpu*"
+    assert dp_env["TPU_PLUGIN_DEVICE_INJECTION"] == "mounts"
