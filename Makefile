@@ -14,6 +14,30 @@ e2e:
 bench:
 	$(PYTHON) bench.py
 
+.PHONY: generate
+generate:  ## regenerate CRDs into all install channels (reference: make manifests)
+	$(PYTHON) hack/gen-crds.py
+
+.PHONY: validate-generated
+validate-generated:  ## CI guard: committed CRDs match the spec types
+	$(PYTHON) hack/gen-crds.py --check
+
+.PHONY: validate-csv
+validate-csv:  ## OLM bundle: alm-examples valid, owned CRDs shipped
+	$(PYTHON) -m tpu_operator.cmd.cfg validate-csv bundle/manifests/tpu-operator.clusterserviceversion.yaml
+
+.PHONY: validate-helm-values
+validate-helm-values:  ## chart renders a schema-valid ClusterPolicy (reference target of the same name)
+	$(PYTHON) -m pytest tests/test_chart.py -q
+
+.PHONY: e2e-kind
+e2e-kind:  ## real-API-server e2e (needs kind + docker + kubectl)
+	bash tests/e2e-kind.sh
+
+.PHONY: must-gather
+must-gather:
+	bash hack/must-gather.sh
+
 .PHONY: validate-samples
 validate-samples:
 	$(PYTHON) -m tpu_operator.cmd.cfg validate config/samples/*.yaml
