@@ -30,3 +30,44 @@ def test_crd_urls():
 
 def test_selector_param():
     assert RestClient._selector_param({"a": "1", "b": None}) == "a=1,b"
+
+
+def test_eviction_url():
+    c = RestClient(base_url="https://apiserver:6443", token="t")
+    assert c.resource_url("v1", "Pod", "ns1", "p1", "eviction") == \
+        "https://apiserver:6443/api/v1/namespaces/ns1/pods/p1/eviction"
+
+
+def test_eviction_over_the_wire():
+    """POST pods/{name}/eviction end-to-end: PDB blocks -> 429 raised as
+    TooManyRequestsError; headroom -> pod actually deleted."""
+    from tpu_operator.client.errors import TooManyRequestsError
+    from tpu_operator.testing import MiniApiServer
+
+    srv = MiniApiServer()
+    base = srv.start()
+    try:
+        client = RestClient(base_url=base)
+        client.create({"apiVersion": "v1", "kind": "Pod",
+                       "metadata": {"name": "w", "namespace": "ns1",
+                                    "labels": {"app": "train"}},
+                       "spec": {}, "status": {"phase": "Running"}})
+        client.create({"apiVersion": "policy/v1",
+                       "kind": "PodDisruptionBudget",
+                       "metadata": {"name": "pdb", "namespace": "ns1"},
+                       "spec": {"selector": {"matchLabels": {"app": "train"}},
+                                "minAvailable": 1}})
+        import pytest
+        with pytest.raises(TooManyRequestsError):
+            client.evict("w", "ns1")
+        # second healthy replica gives headroom
+        client.create({"apiVersion": "v1", "kind": "Pod",
+                       "metadata": {"name": "w2", "namespace": "ns1",
+                                    "labels": {"app": "train"}},
+                       "spec": {}, "status": {"phase": "Running"}})
+        client.evict("w", "ns1")
+        from tpu_operator.client.errors import NotFoundError
+        with pytest.raises(NotFoundError):
+            client.get("v1", "Pod", "w", "ns1")
+    finally:
+        srv.stop()
