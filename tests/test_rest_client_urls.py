@@ -1,4 +1,8 @@
+import pytest
+
+from tpu_operator.client.errors import NotFoundError, TooManyRequestsError
 from tpu_operator.client.rest import RestClient
+from tpu_operator.testing import MiniApiServer
 
 
 def client():
@@ -41,9 +45,6 @@ def test_eviction_url():
 def test_eviction_over_the_wire():
     """POST pods/{name}/eviction end-to-end: PDB blocks -> 429 raised as
     TooManyRequestsError; headroom -> pod actually deleted."""
-    from tpu_operator.client.errors import TooManyRequestsError
-    from tpu_operator.testing import MiniApiServer
-
     srv = MiniApiServer()
     base = srv.start()
     try:
@@ -57,7 +58,6 @@ def test_eviction_over_the_wire():
                        "metadata": {"name": "pdb", "namespace": "ns1"},
                        "spec": {"selector": {"matchLabels": {"app": "train"}},
                                 "minAvailable": 1}})
-        import pytest
         with pytest.raises(TooManyRequestsError):
             client.evict("w", "ns1")
         # second healthy replica gives headroom
@@ -66,7 +66,6 @@ def test_eviction_over_the_wire():
                                     "labels": {"app": "train"}},
                        "spec": {}, "status": {"phase": "Running"}})
         client.evict("w", "ns1")
-        from tpu_operator.client.errors import NotFoundError
         with pytest.raises(NotFoundError):
             client.get("v1", "Pod", "w", "ns1")
     finally:
