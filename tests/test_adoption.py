@@ -122,6 +122,17 @@ class TestLabelerIntegration:
             consts.deploy_label("device-plugin")] == "true"
         assert consts.PLUGIN_STACK_LABEL not in fresh["metadata"]["labels"]
 
+    def test_adoption_records_event_once(self, fake_client):
+        """kubectl describe node must show the adoption decision; repeat
+        sweeps must not mint duplicate Events."""
+        fake_client.create(mk_gke_node("gke-pre", preloaded=True))
+        label_tpu_nodes(fake_client, policy_obj())
+        label_tpu_nodes(fake_client, policy_obj())  # second sweep: no-op
+        evs = [e for e in fake_client.list("v1", "Event", "default")
+               if e.get("reason") == "HostPluginAdopted"]
+        assert len(evs) == 1
+        assert evs[0]["involvedObject"]["name"] == "gke-pre"
+
     def test_stack_labels_cleaned_with_tpu_removal(self, fake_client):
         fake_client.create(mk_gke_node("gke-pre", preloaded=True))
         label_tpu_nodes(fake_client, policy_obj())

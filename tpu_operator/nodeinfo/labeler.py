@@ -14,7 +14,7 @@ import dataclasses
 import logging
 from typing import Dict, List, Optional
 
-from .. import consts
+from .. import consts, events
 from ..api.clusterpolicy import ClusterPolicy
 from ..client.interface import Client
 from ..utils import deep_get
@@ -144,6 +144,17 @@ def label_tpu_nodes(client: Client, policy: ClusterPolicy) -> LabelResult:
                 client.patch("v1", "Node", name, {"metadata": {"labels": patch}})
                 _apply_label_patch(node, patch)  # keep the snapshot current
                 result.labeled += 1
+                if patch.get(consts.PLUGIN_STACK_LABEL) == "host":
+                    # adoption is a real decision an admin should see in
+                    # `kubectl describe node`. After the successful patch:
+                    # a failed patch must retry WITHOUT minting a second
+                    # Event for the same transition.
+                    events.record(
+                        client, "", node, events.NORMAL,
+                        "HostPluginAdopted",
+                        f"node {name} already advertises the TPU resource; "
+                        f"adopting its device plugin instead of deploying "
+                        f"ours")
         else:
             stale = [k for k in labels
                      if k == consts.TPU_PRESENT_LABEL
