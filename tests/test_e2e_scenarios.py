@@ -43,7 +43,9 @@ def cluster():
     srv.stop()
 
 
-def wait_for(predicate, timeout=20.0, interval=0.05, message="condition"):
+def wait_for(predicate, timeout=45.0, interval=0.05, message="condition"):
+    # generous default: these e2es share the machine with jit-compiling
+    # suites in CI and with the bench driver — 20 s flaked under load
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
         if predicate():
