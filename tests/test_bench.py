@@ -1,0 +1,105 @@
+"""The bench line must consume the PerfReport's own verdict.
+
+VERDICT r2 weak-#1: BENCH_r02 published mxu_peak_fraction 1.0612 (106% of
+the v5e's physical peak) with perf_measurement_valid: true because bench.py
+surfaced only `measurement_valid` and never read `passed`/`failures`. These
+tests pin the whole chain: an impossible fraction must come out of
+`perf_summary` flagged invalid with the failure strings attached, no matter
+which half of the validator caught it.
+"""
+
+from bench import perf_summary  # repo root is on sys.path via conftest
+
+
+def _report(**over):
+    base = dict(
+        platform="tpu", n_devices=1, device_kind="TPU v5 lite", chip="v5e",
+        accumulation="fp32", mxu_tflops=170.0, hbm_gbps=700.0,
+        ici_allreduce_gbps=0.0, mxu_peak_fraction=0.863,
+        hbm_peak_fraction=0.8547, mxu_cross_check_ratio=1.01,
+        measurement_valid=True, elapsed_s=12.0, passed=True, failures=[])
+    base.update(over)
+    return base
+
+
+def test_impossible_peak_fraction_flags_bench_line():
+    """Inject the exact r2 failure: fraction > 1.05 but measurement_valid
+    True (the half-fixed state). The bench line must still go invalid."""
+    out = perf_summary(_report(
+        mxu_peak_fraction=1.1, mxu_tflops=216.7,
+        measurement_valid=True, passed=False,
+        failures=["mxu_peak_fraction=1.1 exceeds chip peak — "
+                  "measurement untrustworthy"]))
+    assert out["perf_measurement_valid"] is False
+    assert any("exceeds chip peak" in f for f in out["perf_failures"])
+
+
+def test_peak_overshoot_flags_even_if_report_forgot():
+    """Defense in depth: even a report that claims passed+valid while
+    carrying a >1.05 fraction is never republished as valid."""
+    out = perf_summary(_report(mxu_peak_fraction=1.1, passed=True,
+                               measurement_valid=True, failures=[]))
+    assert out["perf_measurement_valid"] is False
+    # the rejection must be self-documenting even when the report forgot
+    assert any("exceeds chip peak" in f for f in out["perf_failures"])
+
+
+def test_report_failures_propagate():
+    out = perf_summary(_report(passed=False, measurement_valid=False,
+                               failures=["timing noise floor reached"]))
+    assert out["perf_measurement_valid"] is False
+    assert out["perf_failures"] == ["timing noise floor reached"]
+
+
+def test_clean_report_is_valid():
+    out = perf_summary(_report())
+    assert out["perf_measurement_valid"] is True
+    assert out["perf_failures"] == []
+    assert out["mxu_cross_check_ratio"] == 1.01
+
+
+def test_perf_not_run_is_none_not_false():
+    """No perf sweep (CPU platform) is 'not measured', distinct from
+    'measured and untrustworthy'."""
+    out = perf_summary({})
+    assert out["perf_measurement_valid"] is None
+    assert out["perf_failures"] == []
+
+
+def test_run_perf_rejects_ten_percent_cross_check_drift(monkeypatch):
+    """r2's bounds (0.5-2.0) waved through a 6% overshoot; the tightened
+    gate (0.9-1.1) must reject a 15% disagreement."""
+    from tpu_operator.validator import perf
+
+    monkeypatch.setattr(perf, "measure_mxu_tflops",
+                        lambda *a, **k: (150.0, True, 1.15))
+    monkeypatch.setattr(perf, "measure_hbm_gbps",
+                        lambda *a, **k: (500.0, True))
+    monkeypatch.setattr(perf, "measure_ici_allreduce_gbps",
+                        lambda *a, **k: (0.0, True))
+    report = perf.run_perf(matrix_dim=128, hbm_mib=4, ici_mib=1, iters=2)
+    assert not report.measurement_valid
+    assert not report.passed
+
+
+def test_run_perf_peak_overshoot_invalidates_measurement(monkeypatch):
+    """The >1.05 fraction must flip measurement_valid itself, not just
+    append a failure (the r2 half-fix)."""
+    from tpu_operator.validator import perf
+
+    monkeypatch.setattr(perf, "measure_mxu_tflops",
+                        lambda *a, **k: (216.7, True, 1.0))  # 110% of v5e
+    monkeypatch.setattr(perf, "measure_hbm_gbps",
+                        lambda *a, **k: (500.0, True))
+    monkeypatch.setattr(perf, "measure_ici_allreduce_gbps",
+                        lambda *a, **k: (0.0, True))
+    monkeypatch.setattr(perf, "lookup_peaks",
+                        lambda kind: ("v5e", 197.0, 819.0))
+    report = perf.run_perf(matrix_dim=128, hbm_mib=4, ici_mib=1, iters=2)
+    assert report.mxu_peak_fraction > 1.05
+    assert not report.measurement_valid
+    # the failure names the real problem: a clean-timing overshoot must NOT
+    # also claim a noise-floor/cross-check issue that never occurred
+    assert len(report.failures) == 1
+    assert "exceeds chip peak" in report.failures[0]
+    assert perf_summary(report.to_dict())["perf_measurement_valid"] is False
