@@ -66,6 +66,23 @@ def test_perf_not_run_is_none_not_false():
     assert out["perf_failures"] == []
 
 
+def test_miniapiserver_latency_injection():
+    """The honest control-plane variant depends on per-request latency
+    actually being injected (VERDICT r2 weak-#4)."""
+    import time
+    from tpu_operator.client.rest import RestClient
+    from tpu_operator.testing import MiniApiServer
+
+    srv = MiniApiServer(latency_s=0.05)
+    try:
+        client = RestClient(base_url=srv.start())
+        t0 = time.monotonic()
+        client.list("v1", "Node")
+        assert time.monotonic() - t0 >= 0.05
+    finally:
+        srv.stop()
+
+
 def test_run_perf_rejects_ten_percent_cross_check_drift(monkeypatch):
     """r2's bounds (0.5-2.0) waved through a 6% overshoot; the tightened
     gate (0.9-1.1) must reject a 15% disagreement."""

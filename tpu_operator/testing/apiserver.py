@@ -13,6 +13,7 @@ from __future__ import annotations
 import json
 import queue
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
 from urllib.parse import parse_qs, urlparse
@@ -65,11 +66,18 @@ class _Router:
 
 
 class MiniApiServer:
-    """HTTP facade over a FakeClient; start() returns the base URL."""
+    """HTTP facade over a FakeClient; start() returns the base URL.
 
-    def __init__(self, backend: Optional[FakeClient] = None, scheme: Optional[Scheme] = None):
+    ``latency_s`` injects a fixed delay before every request is processed,
+    modeling real apiserver round-trip cost: an in-process server answers
+    in microseconds, which makes control-plane timings look dishonestly
+    fast next to a real cluster (VERDICT r2 weak-#4)."""
+
+    def __init__(self, backend: Optional[FakeClient] = None, scheme: Optional[Scheme] = None,
+                 latency_s: float = 0.0):
         self.scheme = scheme or default_scheme()
         self.backend = backend or FakeClient(self.scheme)
+        self.latency_s = latency_s
         self._router = _Router(self.scheme)
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -84,6 +92,11 @@ class MiniApiServer:
 
             def log_message(self, *args):
                 pass
+
+            def handle_one_request(self):
+                if server.latency_s > 0:
+                    time.sleep(server.latency_s)
+                super().handle_one_request()
 
             def _body(self) -> dict:
                 length = int(self.headers.get("Content-Length", 0))

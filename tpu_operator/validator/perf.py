@@ -164,22 +164,30 @@ def _chain_time(fn, x, iters: int, cross_check: bool = False,
         closer(o)
         return time.perf_counter() - t0
 
-    probe = timed_chain(_fetch_one)
-    while probe < floor and iters * 4 <= max_iters:
-        iters *= 4
+    while True:
         probe = timed_chain(_fetch_one)
-    # median of three at the settled size (reusing the settled probe as the
-    # first sample): a single sample sits one scheduler hiccup away from
-    # crossing the peak-fraction gate or the noise floor; cross-check
-    # samples interleave so both closers see the same chip state
-    fetch_samples, block_samples = [probe], []
-    for _ in range(2):
+        if probe < floor and iters * 4 <= max_iters:
+            iters *= 4
+            continue
+        # median of three at the settled size (reusing the settled probe
+        # as the first sample): a single sample sits one scheduler hiccup
+        # away from crossing the peak-fraction gate or the noise floor;
+        # cross-check samples interleave so both closers see the same
+        # chip state
+        fetch_samples, block_samples = [probe], []
+        for _ in range(2):
+            if cross_check:
+                block_samples.append(timed_chain(jax.block_until_ready))
+            fetch_samples.append(timed_chain(_fetch_one))
         if cross_check:
             block_samples.append(timed_chain(jax.block_until_ready))
-        fetch_samples.append(timed_chain(_fetch_one))
-    if cross_check:
-        block_samples.append(timed_chain(jax.block_until_ready))
-    total = statistics.median(fetch_samples)
+        total = statistics.median(fetch_samples)
+        # the median, not just the probe, must clear the floor — else keep
+        # growing (the pre-r3 loop had this; losing it makes honest
+        # hardware flag untrustworthy when two samples come in noisy)
+        if total >= floor or iters * 4 > max_iters:
+            break
+        iters *= 4
     ratio = None
     if cross_check:
         # compare RAW totals (no RTT subtraction on either side): the two
@@ -212,14 +220,15 @@ def measure_mxu_tflops(dim: int = 4096, iters: int = 5
         return x
 
     a = jax.random.normal(key, (dim, dim), dtype=jnp.bfloat16)
-    t, ok, _, ratio = _chain_time(chained, a, iters, cross_check=True)
+    t, ok, grown, ratio = _chain_time(chained, a, iters, cross_check=True)
     if ratio is not None and not (
             CROSS_CHECK_BAND[0] <= ratio <= CROSS_CHECK_BAND[1]):
         # one retry before distrusting the backend: a transient scheduler
         # stall skews 3-sample medians past the band on honest hardware,
         # while a backend whose completion signals lie disagrees by orders
-        # of magnitude on every run
-        t, ok, _, ratio = _chain_time(chained, a, iters, cross_check=True)
+        # of magnitude on every run. Start from the settled iteration
+        # count so the retry skips the growth ladder.
+        t, ok, _, ratio = _chain_time(chained, a, grown, cross_check=True)
     flops = 2.0 * dim * dim * dim * chain
     return flops / t / 1e12, ok, ratio
 
