@@ -1,41 +1,109 @@
-"""Multi-host slice validation: real jax.distributed rendezvous between two
-processes (4 virtual chips each), ICI sweep over all 8 global chips — the
-v5e-16 north-star path at test scale."""
+"""Multi-host slice validation: real jax.distributed rendezvous between
+processes, ICI sweep over all global chips — the v5e-16 north-star path at
+test scale.
+
+The v5e-16 north star is 4 hosts x 4 chips; the 4-process case here matches
+that host count (4 procs x 2 virtual chips = 8 global chips), exercising
+>2-party coordinator behavior a 2-way rendezvous never does (worker N>1
+joining late, one-of-four failure containment).
+"""
 
 import json
 import os
+import signal
 import subprocess
 import sys
 
 import pytest
 
 
+def _spawn_worker(pid: int, num_processes: int, port: int, chips: int,
+                  status_root: str, init_timeout: float = 0.0):
+    env = {
+        "PATH": os.environ.get("PATH", "/usr/bin:/bin"),
+        "HOME": os.environ.get("HOME", "/root"),
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": f"--xla_force_host_platform_device_count={chips}",
+        "PYTHONPATH": os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    }
+    argv = [sys.executable, "-m", "tpu_operator.cmd.validator",
+            "-c", "workload-multihost",
+            f"--coordinator=127.0.0.1:{port}",
+            f"--num-processes={num_processes}", f"--process-id={pid}",
+            "--matrix-dim=64", f"--status-dir={status_root}/v{pid}"]
+    if init_timeout:
+        argv.append(f"--init-timeout={init_timeout}")
+    return subprocess.Popen(argv, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+
+
+def _report_of(proc_stdout: str) -> dict:
+    return json.loads(
+        [l for l in proc_stdout.splitlines() if l.startswith("{")][-1])
+
+
 @pytest.mark.slow
 def test_two_process_multihost_validation(tmp_path):
-    procs = []
     port = 19900 + os.getpid() % 50
-    for pid in range(2):
-        env = {
-            "PATH": os.environ.get("PATH", "/usr/bin:/bin"),
-            "HOME": os.environ.get("HOME", "/root"),
-            "JAX_PLATFORMS": "cpu",
-            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
-            "PYTHONPATH": os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        }
-        procs.append(subprocess.Popen(
-            [sys.executable, "-m", "tpu_operator.cmd.validator",
-             "-c", "workload-multihost",
-             f"--coordinator=127.0.0.1:{port}",
-             "--num-processes=2", f"--process-id={pid}",
-             "--matrix-dim=64", f"--status-dir={tmp_path}/v{pid}"],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
-    reports = []
+    procs = [_spawn_worker(pid, 2, port, chips=4, status_root=str(tmp_path))
+             for pid in range(2)]
     for i, p in enumerate(procs):
         out, err = p.communicate(timeout=220)
         assert p.returncode == 0, f"proc {i} failed:\n{err[-2000:]}"
-        reports.append(json.loads([l for l in out.splitlines() if l.startswith("{")][-1]))
-    for report in reports:
+        report = _report_of(out)
         assert report["passed"] and report["n_devices"] == 8
-    # both processes wrote their workload barrier
     for pid in range(2):
         assert os.path.exists(f"{tmp_path}/v{pid}/workload-ready")
+
+
+@pytest.mark.slow
+def test_four_process_multihost_validation(tmp_path):
+    """4 hosts' worth of processes (the v5e-16 host count), 2 chips each:
+    all 8 global chips validated by every process."""
+    port = 19960 + os.getpid() % 30
+    procs = [_spawn_worker(pid, 4, port, chips=2, status_root=str(tmp_path))
+             for pid in range(4)]
+    for i, p in enumerate(procs):
+        out, err = p.communicate(timeout=220)
+        assert p.returncode == 0, f"proc {i} failed:\n{err[-2000:]}"
+        report = _report_of(out)
+        assert report["passed"] and report["n_devices"] == 8
+        # every sub-check saw all 8 chips healthy
+        for check in ("compute", "psum", "ring", "all_gather"):
+            assert report["details"][check]["passed"], report["details"]
+    for pid in range(4):
+        assert os.path.exists(f"{tmp_path}/v{pid}/workload-ready")
+
+
+@pytest.mark.slow
+def test_worker_killed_fails_closed_then_retries_clean(tmp_path):
+    """One of four workers dies before joining: the remaining three must
+    fail CLOSED within the rendezvous budget (nonzero exit, no barrier
+    file), and a fresh 4-way attempt afterwards succeeds."""
+    port = 19860 + os.getpid() % 30
+    procs = [_spawn_worker(pid, 4, port, chips=2, status_root=str(tmp_path),
+                           init_timeout=30)
+             for pid in range(4)]
+    # kill worker 3 immediately — it is still in interpreter startup, well
+    # before it reaches the coordinator
+    procs[3].send_signal(signal.SIGKILL)
+    procs[3].communicate(timeout=30)
+    for i, p in enumerate(procs[:3]):
+        out, err = p.communicate(timeout=220)
+        assert p.returncode != 0, \
+            f"proc {i} must fail closed when a worker is missing:\n{out}"
+        assert not os.path.exists(f"{tmp_path}/v{i}/workload-ready"), \
+            "a failed rendezvous must never write the validation barrier"
+
+    # retry with fresh processes (fresh port: the dead coordinator's socket
+    # may linger in TIME_WAIT) — must come up clean
+    retry_root = tmp_path / "retry"
+    procs = [_spawn_worker(pid, 4, port + 1, chips=2,
+                           status_root=str(retry_root), init_timeout=60)
+             for pid in range(4)]
+    for i, p in enumerate(procs):
+        out, err = p.communicate(timeout=220)
+        assert p.returncode == 0, f"retry proc {i} failed:\n{err[-2000:]}"
+        assert _report_of(out)["passed"]
+    for pid in range(4):
+        assert os.path.exists(f"{retry_root}/v{pid}/workload-ready")

@@ -119,6 +119,10 @@ class MultihostValidationState:
                     "args": ["-c", "workload-multihost"],
                     "env": [
                         {"name": "TPU_COORDINATOR_ADDRESS", "value": coordinator},
+                        # bound the rendezvous: a worker pod that never
+                        # starts (node died mid-join) must fail the sweep
+                        # closed, not hang it until pod GC
+                        {"name": "TPU_INIT_TIMEOUT", "value": "600"},
                         {"name": "TPU_NUM_PROCESSES", "value": str(n)},
                         {"name": "TPU_WORKER_ID", "value": str(worker)},
                         {"name": "TPU_WORKER_HOSTNAMES", "value": ",".join(

@@ -77,6 +77,11 @@ def build_parser() -> argparse.ArgumentParser:
                    default=int(os.environ.get("TPU_NUM_PROCESSES", "1")))
     p.add_argument("--process-id", type=int,
                    default=int(os.environ.get("TPU_WORKER_ID", "0")))
+    p.add_argument("--init-timeout", type=float,
+                   default=float(os.environ.get("TPU_INIT_TIMEOUT", "0")),
+                   help="multihost rendezvous budget in seconds "
+                        "(0 = jax default); a worker that never joins "
+                        "fails validation closed within this budget")
     p.add_argument("--config", default="/etc/tpu-slice-partitioner/config.yaml")
     p.add_argument("--no-require-devices", action="store_true",
                    help="skip /dev checks (CI or pre-provisioned nodes)")
@@ -156,8 +161,20 @@ def run(argv=None, client=None) -> int:
         if not args.coordinator:
             log.error("workload-multihost: --coordinator required")
             return 1
-        report = run_multihost(args.coordinator, args.num_processes,
-                               args.process_id, matrix_dim=args.matrix_dim)
+        try:
+            report = run_multihost(args.coordinator, args.num_processes,
+                                   args.process_id,
+                                   matrix_dim=args.matrix_dim,
+                                   init_timeout=args.init_timeout)
+        except Exception as e:
+            # fail CLOSED: no barrier file, nonzero exit — a worker that
+            # missed the rendezvous must never mark the slice validated
+            log.error("workload-multihost: rendezvous/sweep failed: %s", e)
+            print(json.dumps({"passed": False, "n_devices": 0,
+                              "platform": "unknown", "elapsed_s": 0.0,
+                              "compile_s": 0.0,
+                              "details": {"error": str(e)[:500]}}))
+            return 1
         print(json.dumps(report.to_dict()))
         if report.passed:
             status.write("workload", report.to_dict())

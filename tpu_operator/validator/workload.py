@@ -137,14 +137,23 @@ def ici_health_check(matrix_dim: int = 512, devices=None) -> IciCheckReport:
 
 
 def run_multihost(coordinator: str, num_processes: int, process_id: int,
-                  matrix_dim: int = 512) -> IciCheckReport:
+                  matrix_dim: int = 512,
+                  init_timeout: Optional[float] = None) -> IciCheckReport:
     """Slice-wide validation: rendezvous over DCN, then the same sweep over
-    every chip of the slice via ICI (the v5e-16 north-star path)."""
+    every chip of the slice via ICI (the v5e-16 north-star path).
+
+    ``init_timeout`` bounds the rendezvous: a worker that never joins
+    (crashed VM, stuck image pull) must fail this validation closed within
+    the budget, not hang the barrier forever. Raises on rendezvous failure
+    — callers fail closed and retry with a fresh process."""
     import jax
 
+    kwargs = {}
+    if init_timeout:
+        kwargs["initialization_timeout"] = int(init_timeout)
     jax.distributed.initialize(coordinator_address=coordinator,
                                num_processes=num_processes,
-                               process_id=process_id)
+                               process_id=process_id, **kwargs)
     return ici_health_check(matrix_dim=matrix_dim)
 
 
