@@ -26,8 +26,10 @@ import time
 
 def bench_control_plane(n_nodes: int = 4, timeout: float = 115.0,
                         latency_s: float = 0.0, interval: float = 0.05,
-                        rollout_ticks: int = 0) -> float:
+                        rollout_ticks: int = 0):
     """Time node creation -> all nodes schedulable + ClusterPolicy ready.
+    Returns seconds, or None if the budget expired before convergence —
+    a timeout is "did not converge", never published as a measurement.
 
     The default arguments time the raw simulator (in-process apiserver,
     instant DS rollouts) — a regression trend, NOT a real-cluster number.
@@ -80,7 +82,7 @@ def bench_control_plane(n_nodes: int = 4, timeout: float = 115.0,
             if schedulable == n_nodes and cp_ready:
                 return time.monotonic() - t0
             time.sleep(0.05)
-        return float(timeout)
+        return None
     finally:
         app.stop()
         kubelet.stop()
@@ -229,6 +231,13 @@ INJECTED = dict(latency_s=0.02, interval=0.5, rollout_ticks=20)
 def main() -> int:
     control_plane_raw_s = bench_control_plane()
     control_plane_s = bench_control_plane(**INJECTED)
+    cp_timed_out = control_plane_s is None or control_plane_raw_s is None
+    # a saturated budget is a failure to converge, not a 115 s measurement:
+    # flag it, floor the headline at the budget, and fail the exit code
+    if control_plane_s is None:
+        control_plane_s = 115.0
+    if control_plane_raw_s is None:
+        control_plane_raw_s = 115.0
     validation = bench_validation()
     # perf sweep only on a real accelerator: the default sizes are tuned for
     # TPU and would burn the whole timeout on a CPU host for no data
@@ -248,6 +257,7 @@ def main() -> int:
         "control_plane_raw_sim_s": round(control_plane_raw_s, 3),
         "control_plane_sim": {
             "simulated": True,
+            "timed_out": cp_timed_out,
             "request_latency_s": INJECTED["latency_s"],
             "ds_rollout_delay_s": INJECTED["interval"] * INJECTED["rollout_ticks"],
             "note": ("in-process apiserver + kubelet simulator; models "
@@ -270,7 +280,7 @@ def main() -> int:
                            "BENCH_CPU_MESH.json"), "w") as f:
         json.dump(mesh, f, indent=1)
     print(json.dumps(line))
-    return 0 if validation["passed"] else 1
+    return 0 if validation["passed"] and not cp_timed_out else 1
 
 
 if __name__ == "__main__":

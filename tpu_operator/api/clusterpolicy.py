@@ -175,6 +175,52 @@ class SlicePartitionerSpec(ComponentSpec):
 
 
 @dataclasses.dataclass
+class HostPathsSpec(SpecBase):
+    """Host filesystem layout overrides (reference HostPathsSpec,
+    api/nvidia/v1/clusterpolicy_types.go:95-96,153; transformForHostRoot,
+    controllers/object_controls.go:726-729).
+
+    Non-GKE bare-metal nodes lay out libtpu, device nodes, and writable
+    runtime state differently; every operand template, validator flag, and
+    native binary honors these instead of compiled-in defaults. The libtpu
+    install root additionally falls back to ``spec.driver.installDir`` so
+    existing CRs keep working."""
+
+    validation_status_dir: str = spec_field(
+        "/run/tpu/validations",
+        doc="Host directory for the node-local validation status-file "
+            "barriers (<component>-ready files).",
+        pattern=r"^/.*$")
+    libtpu_install_dir: Optional[str] = spec_field(
+        None,
+        doc="Host directory libtpu is installed into; unset defaults to "
+            "spec.driver.installDir.",
+        pattern=r"^/.*$")
+    dev_globs: List[str] = spec_field(
+        lambda: ["/dev/accel*", "/dev/vfio/*"],
+        doc="Glob patterns for TPU device nodes on the host.")
+    extra: Dict[str, Any] = spec_field(dict)
+
+    def validate(self, path: str = "spec.hostPaths") -> List[str]:
+        errors = []
+        for field, value in (("validationStatusDir", self.validation_status_dir),
+                             ("libtpuInstallDir", self.libtpu_install_dir)):
+            if value is not None and not str(value).startswith("/"):
+                errors.append(f"{path}.{field}: must be an absolute path")
+        for g in self.dev_globs:
+            if not str(g).startswith("/"):
+                errors.append(f"{path}.devGlobs: {g!r} must be absolute")
+            if "," in str(g):
+                # the glob list travels as a comma-joined env var
+                # (TPU_DEV_GLOBS) and consumers split on comma — a comma
+                # inside one glob would silently corrupt discovery
+                errors.append(f"{path}.devGlobs: {g!r} must not contain ','")
+        if not self.dev_globs:
+            errors.append(f"{path}.devGlobs: must not be empty")
+        return errors
+
+
+@dataclasses.dataclass
 class CDISpec(SpecBase):
     """Container Device Interface spec generation (reference CDIConfigSpec)."""
 
@@ -200,13 +246,20 @@ class ClusterPolicySpec(SpecBase):
     validator: ValidatorSpec = spec_field(ValidatorSpec)
     slice_partitioner: SlicePartitionerSpec = spec_field(SlicePartitionerSpec)
     cdi: CDISpec = spec_field(CDISpec)
+    host_paths: HostPathsSpec = spec_field(HostPathsSpec)
     extra: Dict[str, Any] = spec_field(dict)
+
+    def libtpu_dir(self) -> str:
+        """Effective libtpu install root: hostPaths override, else the
+        driver spec's installDir."""
+        return self.host_paths.libtpu_install_dir or self.driver.install_dir
 
     def validate(self) -> List[str]:
         errors: List[str] = []
         errors += self.operator.validate()
         errors += self.daemonsets.validate()
         errors += self.driver.validate()
+        errors += self.host_paths.validate()
         for name in ("device_plugin", "feature_discovery", "telemetry",
                      "node_status_exporter", "validator", "slice_partitioner"):
             sub: ComponentSpec = getattr(self, name)

@@ -65,7 +65,8 @@ class StateDriver:
             "namespace": namespace,
             "deploy_label": consts.deploy_label("driver"),
             "tpu_resource": consts.TPU_RESOURCE_NAME,
-            "validation_status_dir": consts.VALIDATION_STATUS_DIR,
+            "validation_status_dir": policy.spec.host_paths.validation_status_dir,
+            "dev_globs": ",".join(policy.spec.host_paths.dev_globs),
             "node_selector": o.node_selector or {},
             "node_affinity": o.node_affinity,
             "extra_labels": o.extra_labels or {},
@@ -85,7 +86,10 @@ class StateDriver:
                 "image": o.image or driver.image_path(),
                 "image_pull_policy": driver.image_pull_policy,
                 "image_pull_secrets": driver.image_pull_secrets,
-                "install_dir": driver.install_dir,
+                # an explicit spec.hostPaths.libtpuInstallDir wins over the
+                # (ClusterPolicy or per-TPUDriver) driver spec's installDir
+                "install_dir": (policy.spec.host_paths.libtpu_install_dir
+                                or driver.install_dir),
                 "libtpu_version": o.libtpu_version or driver.libtpu_version,
                 "env": [e.to_k8s() for e in driver.env],
                 "resources": driver.resources,

@@ -73,7 +73,10 @@ class OperandState:
             "namespace": namespace,
             "deploy_label": consts.deploy_label(self.operand),
             "tpu_resource": consts.TPU_RESOURCE_NAME,
-            "validation_status_dir": consts.VALIDATION_STATUS_DIR,
+            # CR-level host layout (spec.hostPaths) — never the compiled-in
+            # defaults, so bare-metal layouts work end to end
+            "validation_status_dir": policy.spec.host_paths.validation_status_dir,
+            "dev_globs": ",".join(policy.spec.host_paths.dev_globs),
             "validator_image": policy.spec.validator.image_path(),
             "daemonsets": {
                 "update_strategy": policy.spec.daemonsets.update_strategy,
@@ -162,7 +165,7 @@ def validator_extras(policy: ClusterPolicy) -> dict:
         "plugin_env": [e.to_k8s() for e in v.plugin.env],
         "workload_env": [e.to_k8s() for e in v.workload.env],
         "resource_name": policy.spec.device_plugin.resource_name,
-        "install_dir": policy.spec.driver.install_dir,
+        "install_dir": policy.spec.libtpu_dir(),
         # driver.enabled=false -> the platform owns libtpu: the driver
         # validation adopts the host install instead of requiring ours
         # (validateHostDriver analog, reference validator/main.go:694-708)
