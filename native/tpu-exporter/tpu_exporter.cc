@@ -100,6 +100,18 @@ void Gauge(std::string* out, const char* name, const char* help, double value) {
   out->append(line);
 }
 
+// A barrier counts as ready when present AND not recording a failed sweep
+// (validators overwrite the file with "passed": false on regression —
+// matching StatusFiles.is_ready in the Python exporter).
+bool BarrierReady(const std::string& path) {
+  if (!FileExists(path)) return false;
+  const std::string body = ReadFile(path);
+  size_t pos = body.find("\"passed\"");
+  if (pos == std::string::npos) return true;
+  pos = body.find_first_not_of(" \t:", pos + strlen("\"passed\""));
+  return !(pos != std::string::npos && body.compare(pos, 5, "false") == 0);
+}
+
 std::string RenderMetrics(const std::string& status_dir) {
   std::string out;
   for (const char* component : kComponents) {
@@ -109,7 +121,7 @@ std::string RenderMetrics(const std::string& status_dir) {
     char help[160];
     snprintf(help, sizeof(help),
              "1 when the %s validation barrier is present on this node", component);
-    Gauge(&out, name, help, FileExists(path) ? 1 : 0);
+    Gauge(&out, name, help, BarrierReady(path) ? 1 : 0);
   }
   Gauge(&out, "tpu_operator_node_tpu_device_nodes",
         "TPU device nodes visible on this node",

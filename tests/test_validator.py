@@ -320,3 +320,34 @@ def test_info_cli_exit_codes(tmp_path, fake_devs, monkeypatch, capsys):
     assert validator_run(["-c", "info", f"--install-dir={install}", "--json"]) == 0
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out["libtpu"]["valid"] is True
+
+
+def test_failed_sweep_overwrites_stale_pass(tmp_path, monkeypatch, capsys):
+    """A degraded chip must not hide behind its first pass: a FAILED sweep
+    overwrites the workload barrier with passed=false, which flips
+    is_ready (wait gates, exporters) and the device plugin's health gate
+    (code-review r3: no path ever recorded a failure, so the gate was
+    unreachable in production)."""
+    from tpu_operator.validator import workload
+    from tpu_operator.validator.status import StatusFiles
+
+    status = StatusFiles(str(tmp_path))
+    status.write("workload", {"passed": True})
+    assert status.is_ready("workload")
+
+    failed = workload.IciCheckReport(
+        passed=False, n_devices=4, platform="tpu", elapsed_s=0.1,
+        compile_s=0.0, details={"psum": {"passed": False,
+                                         "failed_chips": [2]}})
+    monkeypatch.setattr(workload, "ici_health_check", lambda **kw: failed)
+    rc = validator_run(["-c", "workload-local", "--status-dir", str(tmp_path)])
+    assert rc == 1
+    assert not status.is_ready("workload")         # wait gates now block
+    assert status.read("workload")["passed"] is False
+
+    # recovery: a later passing sweep restores readiness
+    ok = workload.IciCheckReport(passed=True, n_devices=4, platform="tpu",
+                                 elapsed_s=0.1, compile_s=0.0, details={})
+    monkeypatch.setattr(workload, "ici_health_check", lambda **kw: ok)
+    assert validator_run(["-c", "workload-local", "--status-dir", str(tmp_path)]) == 0
+    assert status.is_ready("workload")

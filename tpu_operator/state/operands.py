@@ -148,6 +148,10 @@ def device_plugin_extras(policy: ClusterPolicy) -> dict:
     dp = policy.spec.device_plugin
     return {"resource_name": dp.resource_name,
             "builtin_plugin": dp.builtin_plugin,
+            # the plugin mounts libtpu into workload containers from here;
+            # without the flag it would fall back to the compiled-in
+            # default and silently skip the mount on bare-metal layouts
+            "install_dir": policy.spec.libtpu_dir(),
             "plugin_config": dp.config or {}}
 
 

@@ -144,6 +144,12 @@ def run(argv=None, client=None) -> int:
         if ok:
             status.write("workload", {"mode": "pod"})
             return 0
+        if ok is False:
+            # the pod RAN and failed: a real sweep verdict — record it so
+            # health gates see the regression. ok is None on timeout
+            # (scheduling/image trouble, not a chip verdict): leave the
+            # previous barrier state alone
+            status.write("workload", {"mode": "pod", "passed": False})
         return 1
 
     if component == "workload-local":
@@ -151,8 +157,11 @@ def run(argv=None, client=None) -> int:
 
         report = ici_health_check(matrix_dim=args.matrix_dim)
         print(json.dumps(report.to_dict()))
-        if report.passed:
-            status.write("workload", report.to_dict())
+        # a FAILED sweep is recorded too (passed: false): overwriting a
+        # stale pass is what lets the device plugin's health gate and the
+        # exporters see the regression — without it a chip that degrades
+        # after its first pass keeps taking work forever
+        status.write("workload", report.to_dict())
         return 0 if report.passed else 1
 
     if component == "workload-multihost":
@@ -176,8 +185,10 @@ def run(argv=None, client=None) -> int:
                               "details": {"error": str(e)[:500]}}))
             return 1
         print(json.dumps(report.to_dict()))
-        if report.passed:
-            status.write("workload", report.to_dict())
+        # record failures as well as passes (see workload-local above);
+        # rendezvous exceptions above never reach here, so a written
+        # failure always reflects a real sweep verdict
+        status.write("workload", report.to_dict())
         return 0 if report.passed else 1
 
     if component == "info":
@@ -241,7 +252,8 @@ def run(argv=None, client=None) -> int:
         from ..deviceplugin import TPUDevicePlugin
 
         plugin = TPUDevicePlugin(resource_name=args.resource,
-                                 libtpu_dir=args.install_dir)
+                                 libtpu_dir=args.install_dir,
+                                 status_dir=args.status_dir)
         return plugin.run_forever()
 
     if component == "slice-partitioner":

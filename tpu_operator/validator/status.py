@@ -54,7 +54,13 @@ class StatusFiles:
                 os.remove(os.path.join(self.directory, name))
 
     def is_ready(self, component: str) -> bool:
-        return os.path.exists(self.path(component))
+        """Present AND not recording a failure. Validators write the
+        barrier with ``passed: false`` when a sweep fails (so consumers —
+        wait gates, exporters, the device plugin's health gate — see the
+        regression rather than a stale pass); absence and corruption both
+        read as not-ready."""
+        info = self.read(component)
+        return info is not None and info.get("passed") is not False
 
     def read(self, component: str) -> Optional[dict]:
         try:

@@ -179,9 +179,13 @@ WORKLOAD_POD_TEMPLATE = {
 
 def spawn_workload_pod(client, namespace: str, node_name: str, image: str,
                        resource_name: str = "google.com/tpu", chips: Optional[int] = None,
-                       timeout: float = 300.0, poll: float = 1.0) -> bool:
+                       timeout: float = 300.0, poll: float = 1.0) -> Optional[bool]:
     """Create a validation pod pinned to this node requesting TPU resources
-    through the device plugin, wait for Succeeded (validator/main.go:1180)."""
+    through the device plugin, wait for Succeeded (validator/main.go:1180).
+
+    Returns True on Succeeded, False when the pod RAN and Failed (a real
+    sweep verdict), None on timeout (never scheduled / image trouble — not
+    a verdict about the chips)."""
     import copy
 
     from ..client.errors import NotFoundError
@@ -214,7 +218,7 @@ def spawn_workload_pod(client, namespace: str, node_name: str, image: str,
             if phase == "Failed":
                 return False
             time.sleep(poll)
-        return False
+        return None
     finally:
         try:
             client.delete("v1", "Pod", pod["metadata"]["name"], namespace)
