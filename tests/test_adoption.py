@@ -250,3 +250,34 @@ class TestHostDriverRendering:
         assert "TPU_USE_HOST_DRIVER" not in {e["name"] for e in init["env"]}
         assert "host-root" not in [v["name"] for v in
                                    ds["spec"]["template"]["spec"]["volumes"]]
+
+
+class TestAdoptionSelfRecognition:
+    def test_own_plugin_capacity_is_not_adopted_as_host_stack(self, fake_client):
+        """advisor r2: if deploy labels are wiped (operator reinstall, node
+        re-registration) while OUR device-plugin pod still advertises
+        capacity, the node must not be latched as stack=host — that would
+        gate our own plugin off."""
+        node = mk_gke_node("reinstalled", preloaded=True)  # capacity, no labels
+        fake_client.create(node)
+        fake_client.create({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "tpu-device-plugin-reinstalled",
+                         "namespace": "tpu-operator",
+                         "labels": {"app.kubernetes.io/component":
+                                    "tpu-device-plugin"}},
+            "spec": {"nodeName": "reinstalled"},
+            "status": {"phase": "Running"}})
+        label_tpu_nodes(fake_client, policy_obj())
+        live = fake_client.get("v1", "Node", "reinstalled")
+        labels = live["metadata"]["labels"]
+        assert consts.PLUGIN_STACK_LABEL not in labels
+        assert labels[consts.deploy_label("device-plugin")] == "true"
+
+    def test_foreign_capacity_still_adopts(self, fake_client):
+        """The same wiped-label node WITHOUT our plugin pod really is a
+        host stack — adoption must still latch."""
+        fake_client.create(mk_gke_node("gke-pre", preloaded=True))
+        label_tpu_nodes(fake_client, policy_obj())
+        labels = fake_client.get("v1", "Node", "gke-pre")["metadata"]["labels"]
+        assert labels[consts.PLUGIN_STACK_LABEL] == "host"

@@ -180,3 +180,60 @@ def test_schema_admission_covers_every_write_path(fake_client):
     final = fake_client.get("tpu.ai/v1alpha1", "TPUDriver", "ok")
     assert final["spec"].get("driverType", "standard") == "standard"
     assert "status" not in final or not final["status"].get("state")
+
+
+# -- eviction PDB semantics (advisor r2: empty selector, maxUnavailable) ------
+
+def _mk_pod(name, ns="ns1", labels=None, phase="Running"):
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "namespace": ns,
+                         "labels": labels or {}},
+            "spec": {}, "status": {"phase": phase}}
+
+
+def _mk_pdb(name, ns="ns1", selector=None, **spec):
+    return {"apiVersion": "policy/v1", "kind": "PodDisruptionBudget",
+            "metadata": {"name": name, "namespace": ns},
+            "spec": {"selector": {"matchLabels": selector or {}}, **spec}}
+
+
+def test_empty_selector_pdb_matches_all_pods(fake_client):
+    """policy/v1: an empty selector selects every pod in the namespace —
+    skipping it would permit evictions a real apiserver rejects with 429."""
+    from tpu_operator.client.errors import TooManyRequestsError
+    import pytest
+
+    fake_client.create(_mk_pod("w", labels={"app": "x"}))
+    fake_client.create(_mk_pdb("all", selector={}, minAvailable=1))
+    with pytest.raises(TooManyRequestsError):
+        fake_client.evict("w", "ns1")
+
+
+def test_max_unavailable_headroom(fake_client):
+    from tpu_operator.client.errors import TooManyRequestsError
+    import pytest
+
+    for i in range(3):
+        fake_client.create(_mk_pod(f"w{i}", labels={"app": "x"}))
+    fake_client.create(_mk_pdb("pdb", selector={"app": "x"}, maxUnavailable=1))
+    fake_client.evict("w0", "ns1")  # one disruption allowed
+    # w0 gone -> 2 matching, all healthy, but 1 is already disrupted
+    # relative to the original 3... the controller recomputes from current
+    # state: 2 matching, 2 healthy, maxUnavailable=1 -> headroom 1
+    fake_client.evict("w1", "ns1")
+    # now only w2 remains; an unhealthy pod consumes the headroom
+    fake_client.create(_mk_pod("w3", labels={"app": "x"}, phase="Failed"))
+    with pytest.raises(TooManyRequestsError):
+        fake_client.evict("w2", "ns1")
+
+
+def test_pdb_with_neither_bound_blocks(fake_client):
+    """A PDB without minAvailable or maxUnavailable (invalid upstream, but
+    representable) fails closed."""
+    from tpu_operator.client.errors import TooManyRequestsError
+    import pytest
+
+    fake_client.create(_mk_pod("w", labels={"app": "x"}))
+    fake_client.create(_mk_pdb("pdb", selector={"app": "x"}))
+    with pytest.raises(TooManyRequestsError):
+        fake_client.evict("w", "ns1")

@@ -626,3 +626,89 @@ def test_pdb_ignores_unhealthy_pods(fake_client):
     import pytest
     with pytest.raises(TooManyRequestsError):
         fake_client.evict("running", NS)
+
+
+# -- stuck-terminating pods count toward the drain budget ---------------------
+# (advisor r2 medium: eviction accepted but the pod never finishes
+# terminating — stuck finalizer, dead kubelet — must not wedge the node in
+# pod-deletion/drain-required forever)
+
+def _accept_without_deleting(fake_client):
+    """Simulate a real apiserver: an accepted Eviction only stamps
+    deletionTimestamp; the pod stays listed until the kubelet finishes."""
+    def evict(name, namespace=None):
+        pod = fake_client.get("v1", "Pod", name, namespace)
+        pod["metadata"]["deletionTimestamp"] = "2026-01-01T00:00:00Z"
+    fake_client.evict = evict
+
+
+def test_stuck_terminating_force_deleted_after_budget(fake_client):
+    setup(fake_client)
+    fake_client.create(mk_pod("workload", "tpu-0", None, "user:1", tpu_limit=4))
+    _accept_without_deleting(fake_client)
+
+    clock = [1000.0]
+    sm = machine_at(fake_client, clock,
+                    podDeletion={"timeoutSeconds": 60, "force": True})
+    sm.process(fresh_nodes(fake_client))
+    sm.process(fresh_nodes(fake_client))   # evicted (accepted), still listed
+    assert fake_client.get("v1", "Pod", "workload", NS)
+    assert node_upgrade_state(fake_client.get("v1", "Node", "tpu-0")) \
+        == m.POD_DELETION_REQUIRED
+
+    clock[0] += 120.0                      # budget exceeded
+    sm.process(fresh_nodes(fake_client))
+    names = [p["metadata"]["name"] for p in fake_client.list("v1", "Pod", NS)]
+    assert "workload" not in names, \
+        "stuck-terminating pod must be force-deleted after the budget"
+    assert node_upgrade_state(fake_client.get("v1", "Node", "tpu-0")) \
+        != m.FAILED
+
+
+def test_stuck_terminating_fails_node_without_force(fake_client):
+    setup(fake_client)
+    fake_client.create(mk_pod("workload", "tpu-0", None, "user:1", tpu_limit=4))
+    _accept_without_deleting(fake_client)
+
+    clock = [1000.0]
+    sm = machine_at(fake_client, clock,
+                    podDeletion={"timeoutSeconds": 60, "force": False})
+    sm.process(fresh_nodes(fake_client))
+    sm.process(fresh_nodes(fake_client))
+    clock[0] += 120.0
+    sm.process(fresh_nodes(fake_client))
+    assert node_upgrade_state(fake_client.get("v1", "Node", "tpu-0")) == m.FAILED
+    assert fake_client.get("v1", "Pod", "workload", NS)  # never bare-deleted
+    evs = [e for e in fake_client.list("v1", "Event", NS)
+           if e.get("reason") == "UpgradeDrainFailed"]
+    assert any("stuck" in e.get("message", "") or "terminating"
+               in e.get("message", "") for e in evs)
+
+
+def test_finalizer_held_pod_fails_past_double_budget(fake_client):
+    """Force-delete is attempted past the budget; if a finalizer keeps the
+    pod alive anyway, the node must go FAILED past 2x the budget instead of
+    re-force-deleting forever."""
+    setup(fake_client)
+    fake_client.create(mk_pod("workload", "tpu-0", None, "user:1", tpu_limit=4))
+    _accept_without_deleting(fake_client)
+    original_delete = fake_client.delete
+    def delete(api_version, kind, name, namespace=None, **kw):
+        if kind == "Pod" and name == "workload":
+            return None  # finalizer: delete accepted, object stays
+        return original_delete(api_version, kind, name, namespace, **kw)
+    fake_client.delete = delete
+
+    clock = [1000.0]
+    sm = machine_at(fake_client, clock,
+                    podDeletion={"timeoutSeconds": 60, "force": True})
+    sm.process(fresh_nodes(fake_client))
+    sm.process(fresh_nodes(fake_client))
+    clock[0] += 90.0                       # past budget: force attempted
+    sm.process(fresh_nodes(fake_client))
+    assert fake_client.get("v1", "Pod", "workload", NS)  # finalizer holds
+    assert node_upgrade_state(fake_client.get("v1", "Node", "tpu-0")) \
+        != m.FAILED
+    clock[0] += 60.0                       # past 2x budget: stop looping
+    sm.process(fresh_nodes(fake_client))
+    assert node_upgrade_state(fake_client.get("v1", "Node", "tpu-0")) == m.FAILED

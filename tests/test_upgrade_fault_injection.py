@@ -1,0 +1,214 @@
+"""Fault-injection e2e for the upgrade label machine.
+
+The reference has no fault-injection tests at all (SURVEY.md 5.3). Two
+injections here, both asserting the machine converges to a finished
+upgrade WITHOUT manual label surgery:
+
+1. **Operator killed at every state**: the machine's only durable state is
+   the node label + state-since annotation, so "operator died right after
+   recording state X" is exactly "cluster where a node carries label X
+   mid-upgrade". A fresh operator must resume each of them to completion.
+2. **Chaos pod deletion**: a background thread randomly deletes driver /
+   validator / workload pods during a rolling upgrade; the kubelet
+   simulator recreates them per DS semantics and the machine must still
+   converge with every node on the new driver.
+"""
+
+import random
+import threading
+import time
+
+import pytest
+
+from tpu_operator import consts
+from tpu_operator.api.clusterpolicy import new_cluster_policy
+from tpu_operator.client import FakeClient, NotFoundError
+from tpu_operator.controllers.clusterpolicy_controller import (
+    ClusterPolicyReconciler,
+    setup_clusterpolicy_controller,
+)
+from tpu_operator.controllers.runtime import Request
+from tpu_operator.controllers.upgrade_controller import (
+    UpgradeReconciler,
+    setup_upgrade_controller,
+)
+from tpu_operator.testing.kubelet import KubeletSimulator
+from tpu_operator.upgrade import machine as m
+from tpu_operator.upgrade import node_upgrade_state
+from tpu_operator.utils import deep_get
+
+NS = "tpu-operator"
+TPU_LABELS = {consts.GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice"}
+OLD = "gcr.io/tpu/tpu-validator:1.0"
+NEW = "gcr.io/tpu/tpu-validator:2.0"
+
+#: every resumable mid-upgrade state (FAILED is terminal by design — its
+#: recovery paths are covered in test_upgrade.py)
+RESUMABLE_STATES = (
+    m.UPGRADE_REQUIRED, m.CORDON_REQUIRED, m.WAIT_FOR_JOBS_REQUIRED,
+    m.POD_DELETION_REQUIRED, m.DRAIN_REQUIRED, m.POD_RESTART_REQUIRED,
+    m.VALIDATION_REQUIRED, m.UNCORDON_REQUIRED, m.DONE,
+)
+
+
+@pytest.fixture(autouse=True)
+def default_images(monkeypatch):
+    for env in ("DRIVER_IMAGE", "VALIDATOR_IMAGE", "FEATURE_DISCOVERY_IMAGE",
+                "TELEMETRY_EXPORTER_IMAGE", "SLICE_PARTITIONER_IMAGE"):
+        monkeypatch.setenv(env, "gcr.io/tpu/tpu-validator:0.1.0")
+    monkeypatch.setenv("DEVICE_PLUGIN_IMAGE", "gcr.io/tpu/device-plugin:0.1.0")
+
+
+def wait_for(predicate, timeout=45.0, interval=0.05, message="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {message}")
+
+
+def driver_pod_images(client):
+    return {deep_get(p, "spec", "nodeName"): p["spec"]["containers"][0]["image"]
+            for p in client.list(
+                "v1", "Pod", NS,
+                label_selector={"app.kubernetes.io/component": "tpu-driver"})}
+
+
+def start_stack(client):
+    cp = setup_clusterpolicy_controller(
+        client, ClusterPolicyReconciler(client, requeue_after=0.1))
+    up = setup_upgrade_controller(
+        client, UpgradeReconciler(client, requeue_after=0.1))
+    kubelet = KubeletSimulator(client, interval=0.03, create_pods=True).start()
+    cp.start(client)
+    up.start(client)
+    cp.queue.add(Request(name="cluster-policy"))
+    return cp, up, kubelet
+
+
+def stop_stack(cp, up, kubelet):
+    cp.stop()
+    up.stop()
+    kubelet.stop()
+
+
+def mk_cluster(client, version="1.0", auto_upgrade=True):
+    client.create({"apiVersion": "v1", "kind": "Node",
+                   "metadata": {"name": "tpu-0", "labels": dict(TPU_LABELS)},
+                   "spec": {}, "status": {}})
+    client.create(new_cluster_policy(spec={
+        "driver": {"repository": "gcr.io/tpu", "image": "tpu-validator",
+                   "version": version,
+                   "upgradePolicy": {"autoUpgrade": auto_upgrade,
+                                     "maxParallelUpgrades": 1}},
+    }))
+
+
+def upgrade_settled(client):
+    node = client.get("v1", "Node", "tpu-0")
+    return (node_upgrade_state(node) in (m.UNKNOWN, m.DONE)
+            and not node["spec"].get("unschedulable")
+            and driver_pod_images(client).get("tpu-0") == NEW)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("killed_at", RESUMABLE_STATES)
+def test_operator_killed_at_state_resumes(killed_at):
+    """Simulate the operator dying the instant after it recorded
+    ``killed_at`` on the node: build the exact durable cluster state a
+    crash would leave behind, start a FRESH operator, and require it to
+    finish the upgrade unaided."""
+    client = FakeClient()
+    mk_cluster(client, version="2.0")  # desired state: driver 2.0
+
+    # durable mid-upgrade wreckage a crash at `killed_at` leaves behind:
+    # node labeled, cordoned from CORDON_REQUIRED onward, old-image driver
+    # pod still present until POD_RESTART_REQUIRED completed
+    cordoned = killed_at not in (m.UPGRADE_REQUIRED, m.DONE)
+    old_pod_present = killed_at in (
+        m.UPGRADE_REQUIRED, m.CORDON_REQUIRED, m.WAIT_FOR_JOBS_REQUIRED,
+        m.POD_DELETION_REQUIRED, m.DRAIN_REQUIRED)
+    node = client.get("v1", "Node", "tpu-0")
+    node["metadata"].setdefault("labels", {})[consts.UPGRADE_STATE_LABEL] = killed_at
+    node["metadata"].setdefault("annotations", {})[
+        consts.UPGRADE_STATE_SINCE_ANNOTATION] = str(time.time())
+    if cordoned:
+        node["spec"]["unschedulable"] = True
+    client.update(node)
+    if old_pod_present or killed_at == m.DONE:
+        client.create({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "drv-tpu-0", "namespace": NS,
+                         "labels": {"app.kubernetes.io/component": "tpu-driver",
+                                    "tpu.ai/kubelet-sim-ds": "libtpu-driver"},
+                         "ownerReferences": []},
+            "spec": {"nodeName": "tpu-0",
+                     "containers": [{"name": "c",
+                                     "image": NEW if killed_at == m.DONE else OLD,
+                                     "args": ["-c", "driver-daemon"]}]},
+            "status": {"phase": "Running",
+                       "conditions": [{"type": "Ready", "status": "True"}]}})
+
+    cp, up, kubelet = start_stack(client)
+    try:
+        wait_for(lambda: upgrade_settled(client),
+                 message=f"resume from {killed_at} to settled upgrade")
+    finally:
+        stop_stack(cp, up, kubelet)
+
+
+@pytest.mark.slow
+def test_chaos_pod_deletion_during_rolling_upgrade():
+    """Randomly delete operand pods while the upgrade runs; the machine +
+    DS semantics must still converge every node to the new driver."""
+    client = FakeClient()
+    for i in range(3):
+        client.create({"apiVersion": "v1", "kind": "Node",
+                       "metadata": {"name": f"tpu-{i}", "labels": dict(TPU_LABELS)},
+                       "spec": {}, "status": {}})
+    client.create(new_cluster_policy(spec={
+        "driver": {"repository": "gcr.io/tpu", "image": "tpu-validator",
+                   "version": "1.0",
+                   "upgradePolicy": {"autoUpgrade": True,
+                                     "maxParallelUpgrades": 2}},
+    }))
+    cp, up, kubelet = start_stack(client)
+    stop_chaos = threading.Event()
+    rng = random.Random(1729)  # deterministic chaos
+
+    def chaos():
+        while not stop_chaos.wait(0.05):
+            pods = client.list("v1", "Pod", NS)
+            if not pods:
+                continue
+            victim = rng.choice(pods)
+            try:
+                client.delete("v1", "Pod", victim["metadata"]["name"], NS)
+            except NotFoundError:
+                pass
+
+    try:
+        wait_for(lambda: deep_get(
+            client.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy"),
+            "status", "state") == "ready", message="initial install")
+
+        live = client.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy")
+        live["spec"]["driver"]["version"] = "2.0"
+        client.update(live)
+        chaos_thread = threading.Thread(target=chaos, daemon=True)
+        chaos_thread.start()
+        time.sleep(3.0)           # let the carnage overlap the rollout
+        stop_chaos.set()
+        chaos_thread.join(timeout=5)
+
+        wait_for(lambda: set(driver_pod_images(client).values()) == {NEW},
+                 timeout=90, message="all driver pods rolled to 2.0")
+        wait_for(lambda: all(
+            node_upgrade_state(n) in (m.UNKNOWN, m.DONE)
+            and not n["spec"].get("unschedulable")
+            for n in client.list("v1", "Node")),
+            timeout=90, message="labels settled, nodes uncordoned")
+    finally:
+        stop_chaos.set()
+        stop_stack(cp, up, kubelet)

@@ -271,10 +271,21 @@ class FakeClient(Client):
             ns = pod["metadata"].get("namespace")
             labels = deep_get(pod, "metadata", "labels", default={}) or {}
             for pdb in self.list("policy/v1", "PodDisruptionBudget", ns):
+                if deep_get(pdb, "spec", "selector", "matchExpressions"):
+                    # fail loudly rather than simulate wrong semantics:
+                    # treating an expressions-only selector as match-all
+                    # (or skipping it) both diverge from a real apiserver
+                    raise ApiError(
+                        f"PDB {pdb['metadata']['name']}: selector."
+                        f"matchExpressions is not supported by the "
+                        f"simulator — use matchLabels", 501)
                 selector = deep_get(pdb, "spec", "selector", "matchLabels",
                                     default={}) or {}
-                if not selector or not all(
-                        labels.get(k) == v for k, v in selector.items()):
+                # policy/v1: an empty/missing selector matches EVERY pod in
+                # the namespace (all() is vacuously true), so no `continue`
+                # guard on emptiness — skipping would permit evictions a
+                # real apiserver 429s
+                if not all(labels.get(k) == v for k, v in selector.items()):
                     continue
                 allowed = deep_get(pdb, "status", "disruptionsAllowed")
                 if allowed is None:
@@ -283,15 +294,31 @@ class FakeClient(Client):
                     # Succeeded/Failed pods provide no availability
                     matching = [
                         p for p in self.list("v1", "Pod", ns)
-                        if deep_get(p, "status", "phase",
-                                    default="Running") == "Running"
-                        and all((deep_get(p, "metadata", "labels", k)) == v
-                                for k, v in selector.items())]
-                    min_avail = deep_get(pdb, "spec", "minAvailable",
-                                         default=0) or 0
-                    if isinstance(min_avail, str) and min_avail.endswith("%"):
-                        min_avail = -(-len(matching) * int(min_avail[:-1]) // 100)
-                    allowed = len(matching) - int(min_avail)
+                        if all((deep_get(p, "metadata", "labels", k)) == v
+                               for k, v in selector.items())]
+                    healthy = [p for p in matching
+                               if deep_get(p, "status", "phase",
+                                           default="Running") == "Running"]
+                    min_avail = deep_get(pdb, "spec", "minAvailable")
+                    max_unavail = deep_get(pdb, "spec", "maxUnavailable")
+                    if min_avail is not None:
+                        if isinstance(min_avail, str) and min_avail.endswith("%"):
+                            min_avail = -(-len(matching)
+                                          * int(min_avail[:-1]) // 100)
+                        allowed = len(healthy) - int(min_avail)
+                    elif max_unavail is not None:
+                        # disruption-controller bookkeeping: maxUnavailable
+                        # bounds total disruption, so already-unhealthy pods
+                        # consume headroom. Percentages round DOWN — the
+                        # conservative direction for a simulator (erring
+                        # toward 429 exercises callers' retry paths)
+                        if isinstance(max_unavail, str) and max_unavail.endswith("%"):
+                            max_unavail = (len(matching)
+                                           * int(max_unavail[:-1]) // 100)
+                        allowed = (int(max_unavail)
+                                   - (len(matching) - len(healthy)))
+                    else:
+                        allowed = 0  # neither bound set: nothing evictable
                 if allowed <= 0:
                     raise TooManyRequestsError(
                         f"Cannot evict pod {ns}/{name}: disruption budget "

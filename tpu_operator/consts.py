@@ -48,6 +48,11 @@ UPGRADE_STATE_SINCE_ANNOTATION = "tpu.ai/tpu-driver-upgrade-state-since"
 #: upgrade-failed stays sticky until the template actually changes, so a
 #: drain timeout can't loop cordon->evict->fail forever
 UPGRADE_FAILED_TEMPLATE_ANNOTATION = "tpu.ai/tpu-driver-upgrade-failed-template"
+#: set when the drain budget expired and force-delete ran; its presence is
+#: what licenses the escalation to FAILED if pods STILL remain afterwards
+#: (age alone can't distinguish "force already tried" from "operator was
+#: down past the budget")
+UPGRADE_FORCE_ATTEMPTED_ANNOTATION = "tpu.ai/tpu-driver-upgrade-force-attempted"
 
 # -- labels read from the platform (GKE / device discovery) -------------------
 GKE_TPU_ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"

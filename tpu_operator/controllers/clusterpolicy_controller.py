@@ -103,7 +103,7 @@ class ClusterPolicyReconciler(Reconciler):
             return Result()
 
         # node labeling sweep (state_manager.go:857 labelGPUNodes analog)
-        label_result = label_tpu_nodes(self.client, policy)
+        label_result = label_tpu_nodes(self.client, policy, self.namespace)
         self.metrics.tpu_nodes_total.set(label_result.tpu_nodes)
 
         catalog = InfoCatalog()

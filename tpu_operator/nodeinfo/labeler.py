@@ -53,7 +53,9 @@ def desired_state_labels(policy: ClusterPolicy) -> Dict[str, str]:
     return labels
 
 
-def adoption_labels(policy: ClusterPolicy, node: dict) -> Dict[str, Optional[str]]:
+def adoption_labels(policy: ClusterPolicy, node: dict,
+                    our_plugin_nodes: frozenset = frozenset()
+                    ) -> Dict[str, Optional[str]]:
     """Host-stack adoption (VERDICT r1 #7; validateHostDriver analog).
 
     GKE TPU nodes arrive with libtpu preinstalled and Google's device
@@ -89,7 +91,12 @@ def adoption_labels(policy: ClusterPolicy, node: dict) -> Dict[str, Optional[str
         plugin_auto
         and plugin_gate not in labels
         and deep_get(node, "status", "capacity",
-                     consts.TPU_RESOURCE_NAME) is not None)
+                     consts.TPU_RESOURCE_NAME) is not None
+        # the advertised capacity must not come from OUR plugin: if deploy
+        # labels were wiped (operator reinstall, node re-registration)
+        # while our plugin pod still runs, adopting would gate our own
+        # plugin off as a phantom "host stack"
+        and node["metadata"]["name"] not in our_plugin_nodes)
     if plugin_auto and (preloaded or already_adopted):
         out[plugin_gate] = "false"
         out[consts.PLUGIN_STACK_LABEL] = "host"
@@ -112,15 +119,27 @@ def _apply_label_patch(node: dict, patch: Dict[str, Optional[str]]) -> None:
             labels[key] = value
 
 
-def label_tpu_nodes(client: Client, policy: ClusterPolicy) -> LabelResult:
+def label_tpu_nodes(client: Client, policy: ClusterPolicy,
+                    namespace: Optional[str] = None) -> LabelResult:
     result = LabelResult(nodes=client.list("v1", "Node"))
+    # OUR plugin pods only: scoped to the operator namespace and Running
+    # phase — a third-party/host plugin chart in kube-system can carry the
+    # same recommended component label, and a leftover Succeeded pod of
+    # ours no longer advertises anything
+    our_plugin_nodes = frozenset(
+        deep_get(p, "spec", "nodeName")
+        for p in client.list(
+            "v1", "Pod", namespace or consts.DEFAULT_NAMESPACE,
+            label_selector={"app.kubernetes.io/component": "tpu-device-plugin"})
+        if deep_get(p, "spec", "nodeName")
+        and deep_get(p, "status", "phase", default="Running") == "Running")
     for node in result.nodes:
         name = node["metadata"]["name"]
         labels = deep_get(node, "metadata", "labels", default={}) or {}
         if is_tpu_node(node):
             result.tpu_nodes += 1
             patch: Dict[str, Optional[str]] = {}
-            adopt = adoption_labels(policy, node)
+            adopt = adoption_labels(policy, node, our_plugin_nodes)
             for key, value in desired_state_labels(policy).items():
                 if key in adopt:
                     continue  # adoption owns this key (applied below)

@@ -229,6 +229,31 @@ class UpgradeStateMachine:
         return any("emptyDir" in v for v in
                    deep_get(pod, "spec", "volumes", default=[]) or [])
 
+    def _present_of(self, candidates: List[dict]) -> set:
+        """(name, namespace) of candidates still known to the apiserver —
+        one LIST per distinct namespace, not one GET per pod."""
+        present = set()
+        for ns in {p["metadata"].get("namespace") for p in candidates}:
+            for live in self.client.list("v1", "Pod", ns):
+                present.add((live["metadata"]["name"], ns))
+        return present
+
+    def _force_annotation(self, node: dict, value: Optional[str]) -> None:
+        name = node["metadata"]["name"]
+        current = deep_get(node, "metadata", "annotations",
+                           consts.UPGRADE_FORCE_ATTEMPTED_ANNOTATION)
+        if current == value:
+            return
+        self.client.patch("v1", "Node", name, {"metadata": {"annotations": {
+            consts.UPGRADE_FORCE_ATTEMPTED_ANNOTATION: value}}})
+        node.setdefault("metadata", {}).setdefault("annotations", {})
+        if value is None:
+            node["metadata"]["annotations"].pop(
+                consts.UPGRADE_FORCE_ATTEMPTED_ANNOTATION, None)
+        else:
+            node["metadata"]["annotations"][
+                consts.UPGRADE_FORCE_ATTEMPTED_ANNOTATION] = value
+
     def _evict_with_budget(self, node: dict, pods: List[dict], *,
                            timeout_s: int, force: bool,
                            delete_empty_dir: bool, what: str,
@@ -236,19 +261,33 @@ class UpgradeStateMachine:
         """Shared drain core (reference drain_manager wrapping kubectl's
         eviction helper): evict every target; when the budget expires,
         force-delete if allowed, else fail the node's upgrade. Returns None
-        when all targets are gone (advance), the current-state sentinel
-        ``"wait"`` to retry next sweep, or FAILED."""
+        when every target is gone or force-escalated (advance), the
+        current-state sentinel ``"wait"`` to retry next sweep, or FAILED.
+
+        Accepted-but-stuck evictions count toward the budget too: a pod
+        whose eviction was accepted but which never finishes terminating
+        (stuck finalizer, dead kubelet) must not wedge the node in
+        drain-required forever — past the budget it is force-deleted when
+        force=true, and past 2x the budget (force already attempted and
+        the pod is still there) the node goes FAILED rather than looping."""
         from .. import events
 
+        name = node["metadata"]["name"]
         blocked_empty = [p for p in pods
                          if self._uses_empty_dir(p) and not delete_empty_dir]
         candidates = [p for p in pods if p not in blocked_empty]
         pdb_blocked = [p for p in candidates if not self._evict_pod(p)]
-        remaining = blocked_empty + pdb_blocked
-        if not remaining:
+        # eviction accepted != pod gone: still-present accepted targets are
+        # terminating (deletionTimestamp stamped) and consume budget
+        present = self._present_of(candidates) if candidates else set()
+        terminating = [p for p in candidates
+                       if p not in pdb_blocked
+                       and (p["metadata"]["name"],
+                            p["metadata"].get("namespace")) in present]
+        if not blocked_empty and not pdb_blocked and not terminating:
+            self._force_annotation(node, None)  # drain settled cleanly
             return None
         if timeout_s > 0 and self._state_age(node) > timeout_s:
-            name = node["metadata"]["name"]
             if blocked_empty:
                 # force never implies data loss: emptyDir pods need the
                 # explicit deleteEmptyDir permission (kubectl drain's
@@ -260,19 +299,41 @@ class UpgradeStateMachine:
                 self._mark_failed(node, ds)
                 return FAILED
             if force:
-                for pod in pdb_blocked:
+                force_attempted = deep_get(
+                    node, "metadata", "annotations",
+                    consts.UPGRADE_FORCE_ATTEMPTED_ANNOTATION) == what
+                if terminating and force_attempted \
+                        and self._state_age(node) > 2 * timeout_s:
+                    # force was ACTUALLY attempted (annotation, not age
+                    # inference — the operator may have been down past the
+                    # budget) a while ago and the pod still exists
+                    # (finalizer held by a dead component): repeating the
+                    # delete forever is a wedge with extra steps — surface
+                    # it as a failed upgrade instead
+                    events.record(
+                        self.client, self.namespace, node, events.WARNING,
+                        "UpgradeDrainFailed",
+                        f"{what} on {name}: {len(terminating)} pod(s) "
+                        f"still terminating {2 * timeout_s}s after drain "
+                        f"began despite force-delete")
+                    self._mark_failed(node, ds)
+                    return FAILED
+                for pod in pdb_blocked + terminating:
                     self._delete_pod(pod)
+                self._force_annotation(node, what)
                 events.record(self.client, self.namespace, node,
                               events.WARNING, "UpgradeDrainForced",
-                              f"{what} on {name}: {len(pdb_blocked)} pod(s) "
+                              f"{what} on {name}: "
+                              f"{len(pdb_blocked) + len(terminating)} pod(s) "
                               f"force-deleted after {timeout_s}s budget "
-                              f"(PodDisruptionBudget overridden)")
+                              f"(PDB overridden / termination stuck)")
                 return None
             events.record(self.client, self.namespace, node, events.WARNING,
                           "UpgradeDrainFailed",
-                          f"{what} on {name}: {len(pdb_blocked)} pod(s) "
-                          f"still blocked by PodDisruptionBudget after "
-                          f"{timeout_s}s and force=false")
+                          f"{what} on {name}: "
+                          f"{len(pdb_blocked) + len(terminating)} pod(s) "
+                          f"still present (PDB-blocked or stuck "
+                          f"terminating) after {timeout_s}s and force=false")
             self._mark_failed(node, ds)
             return FAILED
         return "wait"
