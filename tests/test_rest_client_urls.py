@@ -70,3 +70,32 @@ def test_eviction_over_the_wire():
             client.get("v1", "Pod", "w", "ns1")
     finally:
         srv.stop()
+
+
+def test_all_namespaces_list_url():
+    c = RestClient(base_url="https://apiserver:6443")
+    # nameless + namespaceless = the cluster-wide list/watch form
+    assert c.resource_url("v1", "Pod") == "https://apiserver:6443/api/v1/pods"
+    # named operations still default the namespace
+    assert c.resource_url("v1", "Pod", None, "p1") == \
+        "https://apiserver:6443/api/v1/namespaces/default/pods/p1"
+
+
+def test_all_namespaces_list_over_the_wire():
+    """Cluster-wide drain sweeps depend on list(namespace=None) really
+    returning every namespace's pods from a real apiserver URL (it used to
+    silently scope to 'default', making the sweeps vacuous in prod)."""
+    from tpu_operator.testing import MiniApiServer
+
+    srv = MiniApiServer()
+    try:
+        client = RestClient(base_url=srv.start())
+        for ns in ("default", "ml-team"):
+            client.create({"apiVersion": "v1", "kind": "Pod",
+                           "metadata": {"name": f"p-{ns}", "namespace": ns},
+                           "spec": {"nodeName": "n0"}})
+        names = {p["metadata"]["name"] for p in client.list("v1", "Pod")}
+        assert names == {"p-default", "p-ml-team"}
+        assert len(client.list("v1", "Pod", "ml-team")) == 1
+    finally:
+        srv.stop()

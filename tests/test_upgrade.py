@@ -712,3 +712,21 @@ def test_finalizer_held_pod_fails_past_double_budget(fake_client):
     clock[0] += 60.0                       # past 2x budget: stop looping
     sm.process(fresh_nodes(fake_client))
     assert node_upgrade_state(fake_client.get("v1", "Node", "tpu-0")) == m.FAILED
+
+
+def test_drain_covers_user_namespaces(fake_client):
+    """User TPU workloads live in arbitrary namespaces; the pod-deletion
+    sweep must evict them all — the reference's drain helper (kubectl
+    drain) is cluster-wide, and an upgrade that restarts the driver under
+    a still-running workload in another namespace corrupts it."""
+    setup(fake_client)
+    user_pod = mk_pod("train-0", "tpu-0", None, "user:1", tpu_limit=4)
+    user_pod["metadata"]["namespace"] = "ml-team"
+    fake_client.create(user_pod)
+
+    sm = machine(fake_client, podDeletion={"timeoutSeconds": 300, "force": False})
+    sm.process(fresh_nodes(fake_client))
+    sm.process(fresh_nodes(fake_client))
+    names = [p["metadata"]["name"] for p in fake_client.list("v1", "Pod", "ml-team")]
+    assert "train-0" not in names, \
+        "TPU consumer in a user namespace must be evicted before restart"

@@ -351,3 +351,107 @@ def test_failed_sweep_overwrites_stale_pass(tmp_path, monkeypatch, capsys):
     monkeypatch.setattr(workload, "ici_health_check", lambda **kw: ok)
     assert validator_run(["-c", "workload-local", "--status-dir", str(tmp_path)]) == 0
     assert status.is_ready("workload")
+
+
+class TestPeriodicRevalidation:
+    """sleep-mode periodic local sweeps keep the workload barrier — and the
+    device plugin's health gate reading it — current for chips that degrade
+    after their first pass."""
+
+    def _canned(self, monkeypatch, stdout, stderr="", raise_timeout=False):
+        import subprocess
+
+        class R:
+            pass
+
+        def fake_run(argv, **kw):
+            if raise_timeout:
+                raise subprocess.TimeoutExpired(argv, kw.get("timeout", 0))
+            r = R()
+            r.stdout, r.stderr = stdout, stderr
+            return r
+        monkeypatch.setattr(subprocess, "run", fake_run)
+
+    def test_passing_sweep_refreshes_barrier(self, tmp_path, monkeypatch):
+        from tpu_operator.validator.main import revalidate_local
+        from tpu_operator.validator.status import StatusFiles
+
+        status = StatusFiles(str(tmp_path))
+        self._canned(monkeypatch, '{"passed": true, "n_devices": 4}\n')
+        assert revalidate_local(status, 64) is True
+        assert status.is_ready("workload")
+
+    def test_failing_sweep_flips_barrier(self, tmp_path, monkeypatch):
+        from tpu_operator.validator.main import revalidate_local
+        from tpu_operator.validator.status import StatusFiles
+
+        status = StatusFiles(str(tmp_path))
+        status.write("workload", {"passed": True})  # stale pass
+        self._canned(monkeypatch,
+                     '{"passed": false, "n_devices": 4, '
+                     '"details": {"psum": {"failed_chips": [1]}}}\n')
+        assert revalidate_local(status, 64) is False
+        assert not status.is_ready("workload")
+        assert status.read("workload")["passed"] is False
+
+    def test_busy_chips_skip_without_touching_barrier(self, tmp_path, monkeypatch):
+        """libtpu init crashing (chips held by a workload) is not a
+        verdict: the existing barrier must survive untouched."""
+        from tpu_operator.validator.main import revalidate_local
+        from tpu_operator.validator.status import StatusFiles
+
+        status = StatusFiles(str(tmp_path))
+        status.write("workload", {"passed": True})
+        self._canned(monkeypatch, "", stderr="libtpu: device already in use")
+        assert revalidate_local(status, 64) is None
+        assert status.is_ready("workload")
+
+    def test_timeout_skips_without_touching_barrier(self, tmp_path, monkeypatch):
+        from tpu_operator.validator.main import revalidate_local
+        from tpu_operator.validator.status import StatusFiles
+
+        status = StatusFiles(str(tmp_path))
+        status.write("workload", {"passed": True})
+        self._canned(monkeypatch, "", raise_timeout=True)
+        assert revalidate_local(status, 64) is None
+        assert status.is_ready("workload")
+
+    def test_template_wires_revalidation(self):
+        """revalidateIntervalS plumbs env + device mounts into the sleep
+        container; off by default leaves the container unprivileged."""
+        from tpu_operator.api.clusterpolicy import ClusterPolicy, new_cluster_policy
+        from tpu_operator.state.operands import cluster_policy_states
+
+        def render(spec):
+            policy = ClusterPolicy.from_obj(new_cluster_policy(spec=spec))
+            state = next(s for s in cluster_policy_states(client=None)
+                         if s.name == "state-operator-validation")
+            ds = [o for o in state.render_objects(policy, "ns")
+                  if o.get("kind") == "DaemonSet"][0]
+            return ds["spec"]["template"]["spec"]["containers"][0]
+
+        base = {"validator": {"repository": "g", "image": "i", "version": "1"},
+                "driver": {"repository": "g", "image": "i", "version": "1"}}
+        ctr = render(base)
+        assert not ctr.get("securityContext", {}).get("privileged")
+        assert "TPU_REVALIDATE_INTERVAL" not in [
+            e["name"] for e in ctr.get("env", [])]
+
+        base["validator"]["revalidateIntervalS"] = 600
+        ctr = render(base)
+        env = {e["name"]: e.get("value") for e in ctr["env"]}
+        assert env["TPU_REVALIDATE_INTERVAL"] == "600"
+        assert ctr["securityContext"]["privileged"] is True
+        assert any(m["mountPath"] == "/dev" for m in ctr["volumeMounts"])
+
+    def test_log_noise_json_line_is_skipped(self, tmp_path, monkeypatch):
+        """A '{'-prefixed runtime log line that is not valid JSON must be
+        skipped (not crash the sleep loop) and treated as no-report."""
+        from tpu_operator.validator.main import revalidate_local
+        from tpu_operator.validator.status import StatusFiles
+
+        status = StatusFiles(str(tmp_path))
+        status.write("workload", {"passed": True})
+        self._canned(monkeypatch, '{truncated-or-log-noise\n')
+        assert revalidate_local(status, 64) is None
+        assert status.is_ready("workload")

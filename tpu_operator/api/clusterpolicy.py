@@ -157,6 +157,16 @@ class ValidatorSpec(ComponentSpec):
     driver: ValidatorComponentEnv = spec_field(ValidatorComponentEnv)
     plugin: ValidatorComponentEnv = spec_field(ValidatorComponentEnv)
     workload: ValidatorComponentEnv = spec_field(ValidatorComponentEnv)
+    #: sleep-mode periodic re-run of the LOCAL ICI sweep, refreshing the
+    #: workload barrier (and with it the device plugin's health gate) for
+    #: chips that degrade after their first pass. 0 = off. Busy chips
+    #: (held by a workload) skip the cycle without touching the barrier.
+    revalidate_interval_s: int = spec_field(
+        0, doc="Re-run the local ICI sweep every N seconds in the "
+               "validator's sleep container, refreshing the workload "
+               "barrier (0 = off). Chips held by a workload skip the "
+               "cycle.",
+        minimum=0, maximum=86400)
 
 
 @dataclasses.dataclass

@@ -71,7 +71,12 @@ class RestClient(Client):
         info = self.scheme.info(api_version, kind)
         prefix = "/api" if "/" not in api_version else "/apis"
         parts = [self.base_url, prefix.lstrip("/"), api_version]
-        if info.namespaced:
+        if info.namespaced and not (namespace is None and name is None):
+            # named operations default to "default"; a nameless URL with no
+            # namespace is the ALL-namespaces list/watch form
+            # (/api/v1/pods), matching FakeClient.list(namespace=None) —
+            # the two clients disagreeing here made cluster-wide sweeps
+            # work in tests but silently scope to "default" in production
             parts += ["namespaces", namespace or "default"]
         parts.append(info.plural)
         if name:

@@ -170,6 +170,7 @@ def validator_extras(policy: ClusterPolicy) -> dict:
         "workload_env": [e.to_k8s() for e in v.workload.env],
         "resource_name": policy.spec.device_plugin.resource_name,
         "install_dir": policy.spec.libtpu_dir(),
+        "revalidate_interval_s": v.revalidate_interval_s,
         # driver.enabled=false -> the platform owns libtpu: the driver
         # validation adopts the host install instead of requiring ours
         # (validateHostDriver analog, reference validator/main.go:694-708)

@@ -94,9 +94,15 @@ class UpgradeStateMachine:
         self._now = now  # injectable clock for timeout tests
 
     # -- cluster inspection ---------------------------------------------------
-    def _pods_on(self, node_name: str, component: Optional[str] = None) -> List[dict]:
+    def _pods_on(self, node_name: str, component: Optional[str] = None,
+                 all_namespaces: bool = False) -> List[dict]:
+        """Pods on the node. Component-scoped calls target OUR operand pods
+        (operator namespace); drain/wait/consumer sweeps must be
+        cluster-wide — user TPU workloads live in arbitrary namespaces and
+        kubectl drain (the reference's helper) drains them all."""
         label_selector = {"app.kubernetes.io/component": component} if component else None
-        return self.client.list("v1", "Pod", self.namespace,
+        return self.client.list("v1", "Pod",
+                                None if all_namespaces else self.namespace,
                                 label_selector=label_selector,
                                 field_selector={"spec.nodeName": node_name})
 
@@ -194,7 +200,7 @@ class UpgradeStateMachine:
 
     def _tpu_consumer_pods(self, node_name: str) -> List[dict]:
         out = []
-        for pod in self._pods_on(node_name):
+        for pod in self._pods_on(node_name, all_namespaces=True):
             if deep_get(pod, "metadata", "labels", "app.kubernetes.io/component"):
                 continue  # our own operands
             for ctr in deep_get(pod, "spec", "containers", default=[]):
@@ -432,7 +438,7 @@ class UpgradeStateMachine:
             wait_spec = self.policy.wait_for_completion
             if wait_spec.pod_selector:
                 key, _, value = wait_spec.pod_selector.partition("=")
-                waiting = [p for p in self._pods_on(name)
+                waiting = [p for p in self._pods_on(name, all_namespaces=True)
                            if deep_get(p, "metadata", "labels", key) == (value or None)
                            and deep_get(p, "status", "phase") in ("Running", "Pending")]
                 if waiting:
@@ -476,7 +482,7 @@ class UpgradeStateMachine:
                 def drain_targets() -> List[dict]:
                     sel_key, _, sel_value = drain.pod_selector.partition("=")
                     targets = []
-                    for pod in self._pods_on(name):
+                    for pod in self._pods_on(name, all_namespaces=True):
                         if deep_get(pod, "metadata", "labels",
                                     "app.kubernetes.io/component"):
                             continue  # operand DS pods stay (kubectl drain ignores DS)

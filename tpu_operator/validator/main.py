@@ -58,6 +58,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--timeout", type=float, default=300.0)
     p.add_argument("--port", type=int, default=8000)
     p.add_argument("--sleep-interval", type=float, default=60.0)
+    p.add_argument("--revalidate-interval", type=float,
+                   default=float(os.environ.get("TPU_REVALIDATE_INTERVAL", "0")),
+                   help="sleep mode: re-run the local ICI sweep every N "
+                        "seconds and refresh the workload barrier "
+                        "(0 = off). Busy chips (held by a workload) skip "
+                        "the cycle without touching the barrier.")
     p.add_argument("--matrix-dim", type=int, default=512)
     p.add_argument("--metrics-config",
                    default=os.environ.get("TPU_TELEMETRY_CONFIG"),
@@ -93,6 +99,49 @@ def make_client():
     from ..client.rest import RestClient
 
     return RestClient(base_url=os.environ.get("KUBE_API_URL"))
+
+
+def revalidate_local(status, matrix_dim: int, timeout: float = 600.0):
+    """Re-run the local ICI sweep in a subprocess and refresh the workload
+    barrier with its verdict. A subprocess because libtpu access is
+    exclusive: when a workload holds the chips the init fails outright —
+    that is NOT a health verdict, so the cycle is skipped (returns None)
+    and the barrier is left alone. Only a sweep that actually ran writes.
+    Busy-skip is safe: chips held by a running workload are demonstrably
+    serving traffic."""
+    import subprocess
+    import sys
+
+    script = (
+        "import json\n"
+        "from tpu_operator.validator.workload import ici_health_check\n"
+        f"print(json.dumps(ici_health_check(matrix_dim={int(matrix_dim)})"
+        ".to_dict()))\n")
+    try:
+        result = subprocess.run([sys.executable, "-c", script],
+                                capture_output=True, text=True,
+                                timeout=timeout)
+    except subprocess.TimeoutExpired:
+        log.warning("revalidation timed out after %ss; barrier untouched",
+                    timeout)
+        return None
+    report = None
+    for line in reversed(result.stdout.splitlines()):
+        if line.startswith("{"):
+            try:
+                report = json.loads(line)
+            except ValueError:
+                pass  # runtime log noise / truncated write — keep looking
+            else:
+                break
+    if not isinstance(report, dict):
+        log.info("revalidation skipped — sweep never produced a report "
+                 "(chips busy?): %s", result.stderr[-200:])
+        return None
+    status.write("workload", report)
+    if not report.get("passed"):
+        log.error("periodic revalidation FAILED: %s", report.get("details"))
+    return bool(report.get("passed"))
 
 
 def run(argv=None, client=None) -> int:
@@ -221,6 +270,25 @@ def run(argv=None, client=None) -> int:
     if component == "sleep":
         import time
 
+        if args.revalidate_interval > 0:
+            # Periodic health: the one-shot init-container sweep only
+            # certifies the chips at pod start, so a chip that degrades
+            # afterwards keeps its stale pass until something restarts the
+            # pod. Re-running the LOCAL sweep here (direct device access,
+            # no scheduling) keeps the barrier — and the device plugin's
+            # health gate reading it — current, without the
+            # allocation-deadlock a pod-spawning re-check would have.
+            log.info("validations complete; revalidating every %ss",
+                     args.revalidate_interval)
+            while True:
+                time.sleep(args.revalidate_interval)
+                try:
+                    revalidate_local(status, args.matrix_dim)
+                except Exception:
+                    # never crash-loop the validator DS over a revalidation
+                    # hiccup: its pods gate upgrades (VALIDATION_REQUIRED)
+                    log.exception("revalidation cycle failed; retrying "
+                                  "next interval")
         log.info("all validations complete; sleeping")
         while True:
             time.sleep(args.sleep_interval)
