@@ -175,3 +175,36 @@ def test_validate_csv_fails_when_crds_absent(tmp_path, capsys):
     shutil.copy(src, dst)  # CSV alone, no CRD files next to it
     assert run(["validate-csv", str(dst)]) == 1
     assert "NOT shipped" in capsys.readouterr().out
+
+
+def test_rbac_rules_identical_across_install_channels(rendered):
+    """The operator ClusterRole exists in three hand-maintained copies
+    (chart rbac.yaml, deploy/operator.yaml, OLM CSV clusterPermissions);
+    a rule added to one and not the others ships an install channel whose
+    operator gets Forbidden at runtime (pods/eviction nearly did)."""
+    chart_rules = next(o for o in rendered
+                       if o["kind"] == "ClusterRole"
+                       and o["metadata"]["name"] == "tpu-operator")["rules"]
+
+    deploy_rules = None
+    with open(os.path.join(REPO, "deploy", "operator.yaml")) as f:
+        for doc in yaml.safe_load_all(f):
+            if (doc and doc.get("kind") == "ClusterRole"
+                    and doc["metadata"]["name"] == "tpu-operator"):
+                deploy_rules = doc["rules"]
+    assert deploy_rules is not None
+
+    csv_path = os.path.join(REPO, "bundle", "manifests",
+                            "tpu-operator.clusterserviceversion.yaml")
+    with open(csv_path) as f:
+        csv = yaml.safe_load(f)
+    csv_rules = csv["spec"]["install"]["spec"]["clusterPermissions"][0]["rules"]
+
+    def norm(rules):
+        return sorted(
+            (tuple(sorted(r.get("apiGroups", []))),
+             tuple(sorted(r.get("resources", []))),
+             tuple(sorted(r.get("verbs", []))))
+            for r in rules)
+
+    assert norm(chart_rules) == norm(deploy_rules) == norm(csv_rules)

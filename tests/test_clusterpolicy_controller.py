@@ -132,3 +132,47 @@ def test_controller_loop_end_to_end(fake_client):
     finally:
         controller.stop()
         kubelet.stop()
+
+
+def test_psa_labels_operator_namespace(fake_client):
+    """spec.psa.enabled labels the operator namespace privileged for Pod
+    Security Admission (reference setPodSecurityLabelsForNamespace,
+    state_manager.go:600-648); disabled leaves it untouched."""
+    from tpu_operator.api.clusterpolicy import new_cluster_policy
+    from tpu_operator.controllers.clusterpolicy_controller import ClusterPolicyReconciler
+    from tpu_operator.controllers.runtime import Request
+
+    fake_client.create({"apiVersion": "v1", "kind": "Namespace",
+                        "metadata": {"name": "tpu-operator"}})
+    fake_client.create(new_cluster_policy(spec={"psa": {"enabled": True}}))
+    r = ClusterPolicyReconciler(fake_client, namespace="tpu-operator")
+    r.reconcile(Request(name="cluster-policy"))
+    labels = fake_client.get("v1", "Namespace", "tpu-operator")["metadata"]["labels"]
+    for mode in ("enforce", "audit", "warn"):
+        assert labels[f"pod-security.kubernetes.io/{mode}"] == "privileged"
+
+    # idempotent: second sweep patches nothing (no spurious writes)
+    writes = []
+    original = fake_client.patch
+    def counting_patch(*a, **kw):
+        if a[1] == "Namespace":
+            writes.append(a)
+        return original(*a, **kw)
+    fake_client.patch = counting_patch
+    r.reconcile(Request(name="cluster-policy"))
+    assert not writes
+
+
+def test_psa_disabled_leaves_namespace_alone(fake_client):
+    from tpu_operator.api.clusterpolicy import new_cluster_policy
+    from tpu_operator.controllers.clusterpolicy_controller import ClusterPolicyReconciler
+    from tpu_operator.controllers.runtime import Request
+
+    fake_client.create({"apiVersion": "v1", "kind": "Namespace",
+                        "metadata": {"name": "tpu-operator"}})
+    fake_client.create(new_cluster_policy())
+    ClusterPolicyReconciler(fake_client, namespace="tpu-operator").reconcile(
+        Request(name="cluster-policy"))
+    labels = fake_client.get("v1", "Namespace",
+                             "tpu-operator")["metadata"].get("labels", {})
+    assert not any(k.startswith("pod-security") for k in labels)

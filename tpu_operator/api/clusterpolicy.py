@@ -185,6 +185,23 @@ class SlicePartitionerSpec(ComponentSpec):
 
 
 @dataclasses.dataclass
+class PSASpec(SpecBase):
+    """Pod Security Admission (reference PSASpec,
+    api/nvidia/v1/clusterpolicy_types.go:208-211;
+    setPodSecurityLabelsForNamespace, controllers/state_manager.go:600-648).
+
+    Operand pods are privileged (device nodes, hostPaths); on clusters
+    enforcing PSA the operator namespace must carry the privileged
+    pod-security labels or every operand is rejected at admission."""
+
+    enabled: bool = spec_field(
+        False, doc="Label the operator namespace with "
+                   "pod-security.kubernetes.io/{enforce,audit,warn}="
+                   "privileged.")
+    extra: Dict[str, Any] = spec_field(dict)
+
+
+@dataclasses.dataclass
 class HostPathsSpec(SpecBase):
     """Host filesystem layout overrides (reference HostPathsSpec,
     api/nvidia/v1/clusterpolicy_types.go:95-96,153; transformForHostRoot,
@@ -257,6 +274,7 @@ class ClusterPolicySpec(SpecBase):
     slice_partitioner: SlicePartitionerSpec = spec_field(SlicePartitionerSpec)
     cdi: CDISpec = spec_field(CDISpec)
     host_paths: HostPathsSpec = spec_field(HostPathsSpec)
+    psa: PSASpec = spec_field(PSASpec)
     extra: Dict[str, Any] = spec_field(dict)
 
     def libtpu_dir(self) -> str:

@@ -54,6 +54,7 @@ def default_scheme() -> Scheme:
         ("Event", "events", True),
         ("Endpoints", "endpoints", True),
         ("PersistentVolumeClaim", "persistentvolumeclaims", True),
+        ("Namespace", "namespaces", False),
     ]
     for kind, plural, namespaced in core:
         s.register("v1", kind, plural, namespaced)

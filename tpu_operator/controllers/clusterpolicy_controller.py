@@ -84,6 +84,30 @@ class ClusterPolicyReconciler(Reconciler):
             # requeue re-reads and self-heals (reference relies on the same)
             pass
 
+    def _ensure_psa_labels(self, policy: ClusterPolicy) -> None:
+        """spec.psa.enabled: label the operator namespace privileged for
+        Pod Security Admission — operand pods need device nodes and
+        hostPaths, so a PSA-enforcing cluster rejects them all otherwise
+        (reference setPodSecurityLabelsForNamespace,
+        controllers/state_manager.go:600-648)."""
+        if not policy.spec.psa.enabled:
+            return
+        want = {f"pod-security.kubernetes.io/{mode}": "privileged"
+                for mode in ("enforce", "audit", "warn")}
+        try:
+            ns = self.client.get("v1", "Namespace", self.namespace)
+        except NotFoundError:
+            # simulator clusters often carry no Namespace objects; a real
+            # cluster always has one for a running operator
+            log.debug("psa: namespace object %s absent; skipping", self.namespace)
+            return
+        labels = deep_get(ns, "metadata", "labels", default={}) or {}
+        patch = {k: v for k, v in want.items() if labels.get(k) != v}
+        if patch:
+            log.info("psa: labeling namespace %s: %s", self.namespace, patch)
+            self.client.patch("v1", "Namespace", self.namespace,
+                              {"metadata": {"labels": patch}})
+
     def reconcile(self, request: Request) -> Result:
         self.metrics.reconciliation_total.inc()
         try:
@@ -101,6 +125,8 @@ class ClusterPolicyReconciler(Reconciler):
             policy = None
         if policy is None:
             return Result()
+
+        self._ensure_psa_labels(policy)
 
         # node labeling sweep (state_manager.go:857 labelGPUNodes analog)
         label_result = label_tpu_nodes(self.client, policy, self.namespace)
