@@ -216,8 +216,13 @@ def setup_clusterpolicy_controller(client: Client,
 
     controller.watches("tpu.ai/v1", "ClusterPolicy", map_policy)
     controller.watches("v1", "Node", map_node)
-    controller.watches("apps/v1", "DaemonSet", map_owned)
+    # namespaced kinds are watched ONLY in the operator namespace: the
+    # owned DaemonSets and validation pods live there, and an unscoped
+    # watch against a real apiserver is a cluster-wide pod firehose
+    controller.watches("apps/v1", "DaemonSet", map_owned,
+                       namespace=reconciler.namespace)
     controller.watches("tpu.ai/v1alpha1", "TPUDriver", map_tpudriver)
-    controller.watches("v1", "Pod", map_validation_pod)
+    controller.watches("v1", "Pod", map_validation_pod,
+                       namespace=reconciler.namespace)
     controller.resyncs(lambda: _all_policy_requests(client), period=10.0)
     return controller

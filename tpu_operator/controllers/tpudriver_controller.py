@@ -191,6 +191,7 @@ def setup_tpudriver_controller(client: Client, reconciler: TPUDriverReconciler) 
     controller.watches("tpu.ai/v1alpha1", "TPUDriver", map_instance)
     # heartbeat-only node updates must not re-reconcile every instance
     controller.watches("v1", "Node", filtered_node_mapper(all_instances))
-    controller.watches("apps/v1", "DaemonSet", map_owned)
+    controller.watches("apps/v1", "DaemonSet", map_owned,
+                       namespace=reconciler.namespace)
     controller.resyncs(lambda: all_instances(None), period=10.0)
     return controller

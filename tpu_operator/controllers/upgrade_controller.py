@@ -150,6 +150,10 @@ def setup_upgrade_controller(client: Client, reconciler: UpgradeReconciler) -> C
     controller.watches("tpu.ai/v1alpha1", "TPUDriver", singleton)
     # heartbeat-only node updates carry no upgrade signal
     controller.watches("v1", "Node", filtered_node_mapper(singleton))
-    controller.watches("v1", "Pod", map_pod)
+    # only OUR operand pods (driver restarts, validator completion) are a
+    # wake-up signal; user-pod drain progress rides the periodic resync —
+    # an unscoped pod watch on a real apiserver is a cluster-wide firehose
+    controller.watches("v1", "Pod", map_pod,
+                       namespace=reconciler.namespace)
     controller.resyncs(lambda: [SINGLETON_REQUEST], period=30.0)
     return controller
