@@ -19,6 +19,7 @@ OVERRIDES = {
         "validationStatusDir": "/var/lib/tpu/validations",
         "libtpuInstallDir": "/opt/tpu/libtpu",
         "devGlobs": ["/dev/tpu*"],
+        "partitionHandoffDir": "/srv/tpu/handoff",
     },
     "driver": {"repository": "gcr.io/tpu", "image": "tpu-validator",
                "version": "0.1.0"},
@@ -57,8 +58,10 @@ def test_no_default_paths_survive_in_rendered_manifests():
     rendered = yaml.dump_all(_render_all(policy))
     assert "/run/tpu/validations" not in rendered
     assert "/home/kubernetes/bin/libtpu\n" not in rendered
+    assert "/var/lib/tpu-partitions" not in rendered
     assert "/var/lib/tpu/validations" in rendered
     assert "/opt/tpu/libtpu" in rendered
+    assert "/srv/tpu/handoff" in rendered
 
 
 def test_host_env_carries_overrides_into_every_barrier_consumer():
@@ -113,3 +116,32 @@ def test_host_paths_validation_rejects_relative_paths():
     # would silently corrupt device discovery
     policy = _policy({"hostPaths": {"devGlobs": ["/dev/tpu{0,1}*"]}})
     assert any("','" in e for e in policy.spec.validate())
+
+
+def test_partition_handoff_crosses_pod_boundaries():
+    """The partitioner writes the applied partition and the device plugin
+    reads it from a DIFFERENT pod: both DaemonSets must mount the same
+    hostPath (without it the handoff file never leaves the partitioner's
+    container filesystem and partitions silently don't take effect)."""
+    policy = _policy()
+    host_paths = {}
+    consumers = ("tpu-device-plugin", "tpu-slice-partitioner",
+                 "tpu-telemetry-exporter")  # RecordsSource reads it too
+    for obj in _render_all(policy):
+        if obj.get("kind") != "DaemonSet":
+            continue
+        name = obj["metadata"]["name"]
+        if name not in consumers:
+            continue
+        spec_tpl = obj["spec"]["template"]["spec"]
+        vols = {v["name"]: v for v in spec_tpl["volumes"]}
+        assert vols["handoff"]["hostPath"]["path"] == "/srv/tpu/handoff", name
+        ctr = spec_tpl["containers"][0]
+        mounts = {m["name"]: m["mountPath"] for m in ctr["volumeMounts"]}
+        assert mounts["handoff"] == "/srv/tpu/handoff", name
+        env = {e["name"]: e.get("value") for e in ctr.get("env", [])}
+        assert ("--handoff-dir=/srv/tpu/handoff" in " ".join(ctr["args"])
+                or env.get("TPU_HANDOFF_DIR") == "/srv/tpu/handoff"), name
+        host_paths[name] = vols["handoff"]["hostPath"]["path"]
+    assert set(host_paths) == set(consumers), \
+        f"every handoff consumer must mount it: {host_paths}"

@@ -226,12 +226,18 @@ class HostPathsSpec(SpecBase):
     dev_globs: List[str] = spec_field(
         lambda: ["/dev/accel*", "/dev/vfio/*"],
         doc="Glob patterns for TPU device nodes on the host.")
+    partition_handoff_dir: str = spec_field(
+        "/var/lib/tpu-partitions",
+        doc="Host directory through which the slice partitioner hands the "
+            "applied partition to the device plugin.",
+        pattern=r"^/.*$")
     extra: Dict[str, Any] = spec_field(dict)
 
     def validate(self, path: str = "spec.hostPaths") -> List[str]:
         errors = []
         for field, value in (("validationStatusDir", self.validation_status_dir),
-                             ("libtpuInstallDir", self.libtpu_install_dir)):
+                             ("libtpuInstallDir", self.libtpu_install_dir),
+                             ("partitionHandoffDir", self.partition_handoff_dir)):
             if value is not None and not str(value).startswith("/"):
                 errors.append(f"{path}.{field}: must be an absolute path")
         for g in self.dev_globs:

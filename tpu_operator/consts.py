@@ -62,6 +62,9 @@ GKE_TPU_TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"
 #: status-file barrier dir (analog of /run/nvidia/validations)
 VALIDATION_STATUS_DIR = "/run/tpu/validations"
 DEFAULT_LIBTPU_DIR = "/home/kubernetes/bin/libtpu"
+#: hostPath through which the slice partitioner hands applied partitions
+#: to the device plugin and telemetry exporter (spec.hostPaths override)
+DEFAULT_HANDOFF_DIR = "/var/lib/tpu-partitions"
 #: TPU device nodes on a TPU VM
 TPU_DEV_GLOBS = ("/dev/accel*", "/dev/vfio/*")
 

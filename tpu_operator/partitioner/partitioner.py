@@ -32,7 +32,7 @@ from ..validator.driver import discover_devices
 
 log = logging.getLogger(__name__)
 
-DEFAULT_HANDOFF_DIR = "/var/lib/tpu-partitions"
+DEFAULT_HANDOFF_DIR = consts.DEFAULT_HANDOFF_DIR
 HANDOFF_FILE = "partition.json"
 
 STATE_PENDING = "pending"

@@ -77,6 +77,7 @@ class OperandState:
             # defaults, so bare-metal layouts work end to end
             "validation_status_dir": policy.spec.host_paths.validation_status_dir,
             "dev_globs": ",".join(policy.spec.host_paths.dev_globs),
+            "handoff_dir": policy.spec.host_paths.partition_handoff_dir,
             "validator_image": policy.spec.validator.image_path(),
             "daemonsets": {
                 "update_strategy": policy.spec.daemonsets.update_strategy,

@@ -89,6 +89,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "(0 = jax default); a worker that never joins "
                         "fails validation closed within this budget")
     p.add_argument("--config", default="/etc/tpu-slice-partitioner/config.yaml")
+    p.add_argument("--handoff-dir",
+                   default=os.environ.get("TPU_HANDOFF_DIR",
+                                          consts.DEFAULT_HANDOFF_DIR),
+                   help="host dir (mounted in both the partitioner and the "
+                        "device plugin) through which applied partitions "
+                        "are handed to the plugin")
     p.add_argument("--no-require-devices", action="store_true",
                    help="skip /dev checks (CI or pre-provisioned nodes)")
     p.add_argument("--log-level", default="info")
@@ -321,14 +327,16 @@ def run(argv=None, client=None) -> int:
 
         plugin = TPUDevicePlugin(resource_name=args.resource,
                                  libtpu_dir=args.install_dir,
-                                 status_dir=args.status_dir)
+                                 status_dir=args.status_dir,
+                                 handoff_dir=args.handoff_dir)
         return plugin.run_forever()
 
     if component == "slice-partitioner":
         from ..partitioner import run as partitioner_run
 
         client = client or make_client()
-        return partitioner_run(client, config_path=args.config)
+        return partitioner_run(client, config_path=args.config,
+                               handoff_dir=args.handoff_dir)
 
     raise AssertionError(f"unhandled component {component}")
 

@@ -212,7 +212,11 @@ class RecordsSource:
 
     def __init__(self, handoff_dir: Optional[str] = None):
         from ..partitioner.partitioner import DEFAULT_HANDOFF_DIR, HANDOFF_FILE
-        self.path = os.path.join(handoff_dir or DEFAULT_HANDOFF_DIR,
+        # TPU_HANDOFF_DIR: set by the telemetry DS from spec.hostPaths so
+        # this source reads the same hostPath the partitioner writes
+        self.path = os.path.join(handoff_dir
+                                 or os.environ.get("TPU_HANDOFF_DIR")
+                                 or DEFAULT_HANDOFF_DIR,
                                  HANDOFF_FILE)
 
     def collect(self) -> List[Tuple[str, Dict[str, str], float]]:
