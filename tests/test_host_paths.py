@@ -145,3 +145,33 @@ def test_partition_handoff_crosses_pod_boundaries():
         host_paths[name] = vols["handoff"]["hostPath"]["path"]
     assert set(host_paths) == set(consumers), \
         f"every handoff consumer must mount it: {host_paths}"
+
+
+def test_every_device_enumerating_container_mounts_dev():
+    """Components that glob host device nodes (discover_devices) must have
+    /dev mounted — a missing mount doesn't error, it just makes the node
+    look chipless (the node-status exporter shipped with exactly this bug:
+    its device-node gauge read 0 forever)."""
+    DEVICE_ENUMERATING = {"driver", "driver-daemon", "driver-probe",
+                          "device-plugin", "metrics", "feature-discovery",
+                          "slice-partitioner", "telemetry"}
+    policy = _policy()
+    checked = set()
+    for obj in _render_all(policy):
+        if obj.get("kind") != "DaemonSet":
+            continue
+        spec_tpl = obj["spec"]["template"]["spec"]
+        for ctr in spec_tpl.get("initContainers", []) + spec_tpl["containers"]:
+            args = ctr.get("args", [])
+            try:
+                component = args[args.index("-c") + 1]
+            except (ValueError, IndexError):
+                continue
+            if component not in DEVICE_ENUMERATING:
+                continue
+            mounts = {m["mountPath"] for m in ctr.get("volumeMounts", [])}
+            assert "/dev" in mounts, (obj["metadata"]["name"], ctr["name"])
+            checked.add(component)
+    # the sweep must have actually seen the device-enumerating components
+    assert {"driver-daemon", "device-plugin", "metrics",
+            "feature-discovery", "telemetry"} <= checked, checked

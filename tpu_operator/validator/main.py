@@ -309,7 +309,8 @@ def run(argv=None, client=None) -> int:
         from . import telemetry
 
         return telemetry.serve(args.port, refresh_interval=min(args.sleep_interval, 60.0),
-                               config_path=args.metrics_config)
+                               config_path=args.metrics_config,
+                               handoff_dir=args.handoff_dir)
 
     if component == "feature-discovery":
         from . import feature_discovery

@@ -290,11 +290,12 @@ class TelemetryMetrics:
 
     def __init__(self, registry: Optional[CollectorRegistry] = None,
                  config: Optional[MetricsConfig] = None,
-                 sources: Optional[list] = None):
+                 sources: Optional[list] = None,
+                 handoff_dir: Optional[str] = None):
         self.config = config or MetricsConfig()
         if sources is None:
             sources = [RuntimeEndpointSource(self.config.runtime_url),
-                       SysfsSource(), RecordsSource()]
+                       SysfsSource(), RecordsSource(handoff_dir)]
         self.sources = sources
         self.families = {name: spec for name, spec in FAMILIES.items()
                          if self.config.allows(name)}
@@ -379,11 +380,12 @@ def serve(port: int, metrics: Optional[TelemetryMetrics] = None,
           refresh_interval: float = REFRESH_INTERVAL,
           ready_event: Optional[threading.Event] = None,
           stop_event: Optional[threading.Event] = None,
-          config_path: Optional[str] = None) -> int:
+          config_path: Optional[str] = None,
+          handoff_dir: Optional[str] = None) -> int:
     if metrics is None:
         config = MetricsConfig.load(
             config_path or os.environ.get("TPU_TELEMETRY_CONFIG"))
-        metrics = TelemetryMetrics(config=config)
+        metrics = TelemetryMetrics(config=config, handoff_dir=handoff_dir)
     metrics.refresh()
     stop = stop_event or threading.Event()
 
