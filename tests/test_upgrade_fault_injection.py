@@ -212,3 +212,81 @@ def test_chaos_pod_deletion_during_rolling_upgrade():
     finally:
         stop_chaos.set()
         stop_stack(cp, up, kubelet)
+
+
+@pytest.mark.slow
+def test_scale_fifty_node_pool_join():
+    """Control-plane scalability: a 50-node pool joins and every node
+    becomes schedulable with the ClusterPolicy ready — the operator's
+    sweep must not degrade super-linearly with node count (the reference
+    is routinely run on clusters this size)."""
+    client = FakeClient()
+    client.create(new_cluster_policy(spec={
+        "driver": {"repository": "gcr.io/tpu", "image": "tpu-validator",
+                   "version": "1.0"},
+    }))
+    cp, up, kubelet = start_stack(client)
+    try:
+        for i in range(50):
+            client.create({"apiVersion": "v1", "kind": "Node",
+                           "metadata": {"name": f"tpu-{i}",
+                                        "labels": dict(TPU_LABELS)},
+                           "spec": {}, "status": {}})
+        # per-phase wait_for timeouts are the (CI-load-tolerant) bound;
+        # a separate wall-clock assert would re-introduce the flake class
+        # commit 31b24b4 fixed
+        wait_for(lambda: sum(
+            1 for n in client.list("v1", "Node")
+            if deep_get(n, "status", "capacity", "google.com/tpu")) == 50,
+            timeout=60, message="50 nodes advertising TPU capacity")
+        wait_for(lambda: deep_get(
+            client.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy"),
+            "status", "state") == "ready",
+            timeout=60, message="ClusterPolicy ready at 50 nodes")
+    finally:
+        stop_stack(cp, up, kubelet)
+
+
+@pytest.mark.slow
+def test_operator_killed_mid_rolling_upgrade_multi_node():
+    """Kill the operator while a 3-node rolling upgrade is in flight
+    (nodes in different states simultaneously), then start a fresh one:
+    it must finish the rollout from whatever mixture it finds."""
+    client = FakeClient()
+    for i in range(3):
+        client.create({"apiVersion": "v1", "kind": "Node",
+                       "metadata": {"name": f"tpu-{i}", "labels": dict(TPU_LABELS)},
+                       "spec": {}, "status": {}})
+    client.create(new_cluster_policy(spec={
+        "driver": {"repository": "gcr.io/tpu", "image": "tpu-validator",
+                   "version": "1.0",
+                   "upgradePolicy": {"autoUpgrade": True,
+                                     "maxParallelUpgrades": 1}},
+    }))
+    cp, up, kubelet = start_stack(client)
+    try:
+        wait_for(lambda: deep_get(
+            client.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy"),
+            "status", "state") == "ready", message="initial install")
+        live = client.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy")
+        live["spec"]["driver"]["version"] = "2.0"
+        client.update(live)
+        # wait until the rollout is demonstrably in flight, then crash
+        wait_for(lambda: any(
+            node_upgrade_state(n) != m.UNKNOWN
+            for n in client.list("v1", "Node")),
+            message="upgrade started")
+    finally:
+        stop_stack(cp, up, kubelet)  # operator "crash" mid-flight
+
+    cp, up, kubelet = start_stack(client)  # fresh operator process
+    try:
+        wait_for(lambda: set(driver_pod_images(client).values()) == {NEW},
+                 timeout=90, message="rollout finished by the new operator")
+        wait_for(lambda: all(
+            node_upgrade_state(n) in (m.UNKNOWN, m.DONE)
+            and not n["spec"].get("unschedulable")
+            for n in client.list("v1", "Node")),
+            timeout=90, message="labels settled")
+    finally:
+        stop_stack(cp, up, kubelet)
