@@ -84,6 +84,16 @@ def test_full_upgrade_flow_single_node(fake_client):
     fake_client.create(mk_pod("drv-0-new", "tpu-0", "tpu-driver", "img:2"))
     counts = sm.process(fresh_nodes(fake_client))
     node = fake_client.get("v1", "Node", "tpu-0")
+    # post-upgrade validation recycles the validator pod so its init-chain
+    # re-runs against the NEW driver — the pre-upgrade pod is gone
+    assert node_upgrade_state(node) == m.VALIDATION_REQUIRED
+    names = [p["metadata"]["name"] for p in fake_client.list("v1", "Pod", NS)]
+    assert "val-0" not in names, "stale validator pod must be recycled"
+    # DS controller recreates the validator; its validations now certify
+    # the new driver
+    fake_client.create(mk_pod("val-0-new", "tpu-0", "tpu-operator-validator", "v:1"))
+    counts = sm.process(fresh_nodes(fake_client))
+    node = fake_client.get("v1", "Node", "tpu-0")
     assert node_upgrade_state(node) == m.DONE
     assert not node["spec"].get("unschedulable")
     assert counts.done == 1
@@ -154,6 +164,8 @@ def test_failed_node_recovers_when_driver_pods_healthy(fake_client):
     sm = _drive_to_failed(fake_client)
     fake_client.delete("v1", "Pod", "drv-0-new", NS)
     fake_client.create(mk_pod("drv-0-fresh", "tpu-0", "tpu-driver", "img:2"))
+    sm.process(fresh_nodes(fake_client))   # recovery -> validation recycle
+    fake_client.create(mk_pod("val-0-new", "tpu-0", "tpu-operator-validator", "v:1"))
     counts = sm.process(fresh_nodes(fake_client))
     node = fake_client.get("v1", "Node", "tpu-0")
     assert node_upgrade_state(node) == m.DONE
@@ -730,3 +742,46 @@ def test_drain_covers_user_namespaces(fake_client):
     names = [p["metadata"]["name"] for p in fake_client.list("v1", "Pod", "ml-team")]
     assert "train-0" not in names, \
         "TPU consumer in a user namespace must be evicted before restart"
+
+
+def test_terminating_validator_never_certifies(fake_client):
+    """Real apiservers keep a deleted pod listed (still Ready) through its
+    grace period: post-upgrade validation must not advance on the
+    terminating PRE-upgrade validator pod (review r3: the fake's instant
+    delete hid this)."""
+    setup(fake_client)
+    sm = machine(fake_client)
+    sm.process(fresh_nodes(fake_client))
+    sm.process(fresh_nodes(fake_client))   # -> pod-restart-required
+    fake_client.create(mk_pod("drv-0-new", "tpu-0", "tpu-driver", "img:2"))
+
+    # make deletes graceful: stamp deletionTimestamp, keep the pod listed
+    original_delete = fake_client.delete
+    def graceful_delete(api_version, kind, name, namespace=None, **kw):
+        if kind == "Pod" and name == "val-0":
+            fake_client.patch("v1", "Pod", name,
+                              {"metadata": {"deletionTimestamp":
+                                            "2026-01-01T00:00:00Z"}},
+                              namespace)
+            return None
+        return original_delete(api_version, kind, name, namespace, **kw)
+    fake_client.delete = graceful_delete
+
+    sm.process(fresh_nodes(fake_client))   # recycle: val-0 now terminating
+    sm.process(fresh_nodes(fake_client))   # must NOT certify on it
+    assert node_upgrade_state(fake_client.get("v1", "Node", "tpu-0")) \
+        == m.VALIDATION_REQUIRED
+
+    # kubelet finishes the termination; DS controller recreates
+    fake_client.delete = original_delete
+    fake_client.delete("v1", "Pod", "val-0", NS)
+    fake_client.create(mk_pod("val-0-new", "tpu-0", "tpu-operator-validator", "v:1"))
+    sm.process(fresh_nodes(fake_client))
+    node = fake_client.get("v1", "Node", "tpu-0")
+    assert node_upgrade_state(node) == m.DONE
+    # leaving the machine drops the revalidation marker so the NEXT
+    # upgrade recycles again
+    sm.process(fresh_nodes(fake_client))   # DONE -> label cleared
+    node = fake_client.get("v1", "Node", "tpu-0")
+    assert consts.UPGRADE_REVALIDATED_ANNOTATION \
+        not in node["metadata"].get("annotations", {})

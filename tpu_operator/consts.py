@@ -53,6 +53,10 @@ UPGRADE_FAILED_TEMPLATE_ANNOTATION = "tpu.ai/tpu-driver-upgrade-failed-template"
 #: (age alone can't distinguish "force already tried" from "operator was
 #: down past the budget")
 UPGRADE_FORCE_ATTEMPTED_ANNOTATION = "tpu.ai/tpu-driver-upgrade-force-attempted"
+#: driver-template fingerprint the node's validator pods were recycled
+#: for: post-upgrade validation must re-run against the NEW driver, not
+#: rubber-stamp pods whose init-chain validations predate it
+UPGRADE_REVALIDATED_ANNOTATION = "tpu.ai/tpu-driver-upgrade-revalidated-for"
 
 # -- labels read from the platform (GKE / device discovery) -------------------
 GKE_TPU_ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"

@@ -142,6 +142,7 @@ class UpgradeStateMachine:
         if not state:
             # leaving the machine entirely: drop failure bookkeeping too
             ann_patch[consts.UPGRADE_FAILED_TEMPLATE_ANNOTATION] = None
+            ann_patch[consts.UPGRADE_REVALIDATED_ANNOTATION] = None
         ann_patch.update(extra_annotations or {})
         self.client.patch("v1", "Node", name, {"metadata": {
             "labels": {consts.UPGRADE_STATE_LABEL: state or None},
@@ -244,21 +245,21 @@ class UpgradeStateMachine:
                 present.add((live["metadata"]["name"], ns))
         return present
 
-    def _force_annotation(self, node: dict, value: Optional[str]) -> None:
-        name = node["metadata"]["name"]
-        current = deep_get(node, "metadata", "annotations",
-                           consts.UPGRADE_FORCE_ATTEMPTED_ANNOTATION)
+    def _annotate(self, node: dict, key: str, value: Optional[str]) -> None:
+        """Idempotent annotation write, mirrored into the local snapshot."""
+        current = deep_get(node, "metadata", "annotations", key)
         if current == value:
             return
-        self.client.patch("v1", "Node", name, {"metadata": {"annotations": {
-            consts.UPGRADE_FORCE_ATTEMPTED_ANNOTATION: value}}})
-        node.setdefault("metadata", {}).setdefault("annotations", {})
+        self.client.patch("v1", "Node", node["metadata"]["name"],
+                          {"metadata": {"annotations": {key: value}}})
+        annotations = node.setdefault("metadata", {}).setdefault("annotations", {})
         if value is None:
-            node["metadata"]["annotations"].pop(
-                consts.UPGRADE_FORCE_ATTEMPTED_ANNOTATION, None)
+            annotations.pop(key, None)
         else:
-            node["metadata"]["annotations"][
-                consts.UPGRADE_FORCE_ATTEMPTED_ANNOTATION] = value
+            annotations[key] = value
+
+    def _force_annotation(self, node: dict, value: Optional[str]) -> None:
+        self._annotate(node, consts.UPGRADE_FORCE_ATTEMPTED_ANNOTATION, value)
 
     def _evict_with_budget(self, node: dict, pods: List[dict], *,
                            timeout_s: int, force: bool,
@@ -414,6 +415,9 @@ class UpgradeStateMachine:
                 from ..state.skel import is_pod_ready
 
                 if all(is_pod_ready(p) for p in driver_pods):
+                    # recovery re-validation must really re-run, too
+                    self._annotate(node, consts.UPGRADE_REVALIDATED_ANNOTATION,
+                                   None)
                     self._set_state(node, VALIDATION_REQUIRED)
                     state = VALIDATION_REQUIRED  # falls to the gate below
                 else:
@@ -425,6 +429,9 @@ class UpgradeStateMachine:
             if in_progress >= max_parallel:
                 return state  # throttled (reference maxParallelUpgrades)
             self._cordon(node, True)
+            # fresh upgrade: any previous revalidation marker belongs to an
+            # older attempt and must not suppress this one's recycle
+            self._annotate(node, consts.UPGRADE_REVALIDATED_ANNOTATION, None)
             self._set_state(node, CORDON_REQUIRED)
             state = CORDON_REQUIRED  # fall through the chain in one sweep
 
@@ -536,7 +543,24 @@ class UpgradeStateMachine:
         if state == VALIDATION_REQUIRED:
             from ..state.skel import is_pod_ready
 
-            validators = self._pods_on(name, VALIDATOR_COMPONENT)
+            # the validator DS pods have been Ready since BEFORE the
+            # upgrade — their init-chain validations certify the OLD
+            # driver. Recycle them once per driver template (annotation =
+            # crash-safe marker) so validation really re-runs against the
+            # new one; only then does pod readiness mean anything.
+            fingerprint = self._template_fingerprint(ds)
+            recycled_for = deep_get(node, "metadata", "annotations",
+                                    consts.UPGRADE_REVALIDATED_ANNOTATION)
+            if recycled_for != fingerprint:
+                for pod in self._pods_on(name, VALIDATOR_COMPONENT):
+                    self._delete_pod(pod)
+                self._annotate(node, consts.UPGRADE_REVALIDATED_ANNOTATION,
+                               fingerprint)
+                return state  # wait for the DS controller to recreate them
+            # a deleted pod on a real apiserver stays listed (Ready!) while
+            # it terminates — only pods NOT being deleted may certify
+            validators = [p for p in self._pods_on(name, VALIDATOR_COMPONENT)
+                          if not deep_get(p, "metadata", "deletionTimestamp")]
             if not validators or not all(is_pod_ready(p) for p in validators):
                 return state  # validator not green yet (reference validation_manager)
             self._set_state(node, UNCORDON_REQUIRED)
