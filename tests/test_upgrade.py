@@ -785,3 +785,43 @@ def test_terminating_validator_never_certifies(fake_client):
     node = fake_client.get("v1", "Node", "tpu-0")
     assert consts.UPGRADE_REVALIDATED_ANNOTATION \
         not in node["metadata"].get("annotations", {})
+
+
+def test_max_unavailable_counts_unhealthy_bystanders(fake_client):
+    """maxUnavailable is an availability floor, not a parallelism knob
+    (reference GetUpgradesAvailable): a node that is down for unrelated
+    reasons consumes the budget, so the machine must not cordon another."""
+    setup(fake_client, n_nodes=3)
+    sick = fake_client.get("v1", "Node", "tpu-2")
+    sick["status"]["conditions"] = [{"type": "Ready", "status": "False"}]
+    fake_client.update_status(sick)
+
+    sm = machine(fake_client, maxParallelUpgrades=0, maxUnavailable="1")
+    sm.process(fresh_nodes(fake_client))   # all -> upgrade-required
+    sm.process(fresh_nodes(fake_client))
+    cordoned = [n["metadata"]["name"] for n in fake_client.list("v1", "Node")
+                if n["spec"].get("unschedulable")]
+    # the sick node may upgrade ITSELF (no additional availability cost —
+    # it might be wedged by the very driver the upgrade replaces); the
+    # healthy nodes must not be cordoned on top of it
+    assert set(cordoned) <= {"tpu-2"}, \
+        f"healthy nodes cordoned past maxUnavailable=1: {cordoned}"
+
+    # the sick node recovering frees the budget
+    sick = fake_client.get("v1", "Node", "tpu-2")
+    sick["status"]["conditions"] = [{"type": "Ready", "status": "True"}]
+    fake_client.update_status(sick)
+    sm.process(fresh_nodes(fake_client))
+    cordoned = [n for n in fake_client.list("v1", "Node")
+                if n["spec"].get("unschedulable")]
+    assert len(cordoned) == 1
+
+
+def test_max_unavailable_percent_rounds_up(fake_client):
+    setup(fake_client, n_nodes=3)
+    sm = machine(fake_client, maxParallelUpgrades=0, maxUnavailable="50%")
+    sm.process(fresh_nodes(fake_client))
+    sm.process(fresh_nodes(fake_client))
+    cordoned = [n for n in fake_client.list("v1", "Node")
+                if n["spec"].get("unschedulable")]
+    assert len(cordoned) == 2  # ceil(3 * 50%) = 2

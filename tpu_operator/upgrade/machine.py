@@ -346,15 +346,44 @@ class UpgradeStateMachine:
         return "wait"
 
     # -- the sweep ------------------------------------------------------------
+    def _resolve_max_unavailable(self, total: int) -> int:
+        """Absolute ceiling from maxUnavailable (int or percent, percent
+        rounds UP like the reference's GetScaledValueFromIntOrPercent);
+        unset means no availability constraint."""
+        raw = self.policy.max_unavailable
+        if not raw:
+            return total
+        raw = str(raw)
+        if raw.endswith("%"):
+            return -(-total * int(raw[:-1]) // 100)
+        return int(raw)
+
+    @staticmethod
+    def _node_unavailable(node: dict) -> bool:
+        """Cordoned or not-Ready (reference GetCurrentUnavailableNodes):
+        nodes unavailable for ANY reason consume the maxUnavailable budget,
+        so upgrades never push a degraded pool below its availability
+        floor. Absent conditions read as Ready (simulators/minimal nodes)."""
+        if deep_get(node, "spec", "unschedulable"):
+            return True
+        for cond in deep_get(node, "status", "conditions", default=[]) or []:
+            if cond.get("type") == "Ready":
+                return cond.get("status") != "True"
+        return False
+
     def process(self, nodes: List[dict]) -> UpgradeStateCounts:
         counts = UpgradeStateCounts()
         in_progress = sum(1 for n in nodes if node_upgrade_state(n) in IN_PROGRESS_STATES)
         max_parallel = self.policy.max_parallel_upgrades or len(nodes)
+        max_unavailable = self._resolve_max_unavailable(len(nodes))
+        unavailable = sum(1 for n in nodes if self._node_unavailable(n))
 
         for node in nodes:
             before = node_upgrade_state(node)
+            was_unavailable = self._node_unavailable(node)
             try:
-                state = self._process_node(node, in_progress, max_parallel)
+                state = self._process_node(node, in_progress, max_parallel,
+                                           unavailable, max_unavailable)
             except ApiError as e:
                 log.warning("upgrade: node %s sweep error: %s", node["metadata"]["name"], e)
                 state = before
@@ -370,9 +399,15 @@ class UpgradeStateMachine:
                 counts.available += 1
             if state in IN_PROGRESS_STATES and before not in IN_PROGRESS_STATES:
                 in_progress += 1
+                if not was_unavailable:
+                    # starting an upgrade cordons the node; an
+                    # already-unavailable node is already in the sum
+                    unavailable += 1
         return counts
 
-    def _process_node(self, node: dict, in_progress: int, max_parallel: int) -> str:
+    def _process_node(self, node: dict, in_progress: int, max_parallel: int,
+                      unavailable: int = 0,
+                      max_unavailable: Optional[int] = None) -> str:
         name = node["metadata"]["name"]
         state = node_upgrade_state(node)
         ds = self._driver_ds_for(node)
@@ -428,6 +463,19 @@ class UpgradeStateMachine:
         if state == UPGRADE_REQUIRED:
             if in_progress >= max_parallel:
                 return state  # throttled (reference maxParallelUpgrades)
+            if (max_unavailable is not None
+                    and unavailable >= max_unavailable
+                    and not self._node_unavailable(node)):
+                # availability floor (reference GetUpgradesAvailable +
+                # ProcessUpgradeRequiredNodes): no NEW cordons while the
+                # pool is at its unavailability ceiling — nodes down for
+                # unrelated reasons consume the budget. Already-unavailable
+                # nodes proceed: upgrading them costs no additional
+                # availability. (The reference exempts only CORDONED nodes;
+                # we also exempt not-Ready ones — a node wedged by the very
+                # driver the upgrade replaces would otherwise block its own
+                # fix, livelocking the pool at a small maxUnavailable.)
+                return state
             self._cordon(node, True)
             # fresh upgrade: any previous revalidation marker belongs to an
             # older attempt and must not suppress this one's recycle
