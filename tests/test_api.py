@@ -12,7 +12,7 @@ def test_empty_spec_gets_full_defaults():
     assert spec.driver.install_dir == "/home/kubernetes/bin/libtpu"
     assert spec.device_plugin.resource_name == "google.com/tpu"
     assert spec.slice_partitioner.is_enabled() is False  # opt-in like MIG
-    assert spec.operator.default_runtime == "containerd"
+    assert spec.operator.runtime_class is None  # no TPU runtime hook
     assert spec.daemonsets.priority_class_name == "system-node-critical"
     assert spec.validate() == []
 
@@ -68,12 +68,10 @@ def test_image_path_error_when_unresolvable(monkeypatch):
 
 def test_validation_catches_bad_values():
     spec = ClusterPolicySpec.from_dict({
-        "operator": {"defaultRuntime": "rkt"},
         "daemonsets": {"updateStrategy": "BlueGreen"},
         "driver": {"imagePullPolicy": "Sometimes", "upgradePolicy": {"maxParallelUpgrades": -1}},
     })
     errors = spec.validate()
-    assert any("defaultRuntime" in e for e in errors)
     assert any("updateStrategy" in e for e in errors)
     assert any("imagePullPolicy" in e for e in errors)
     assert any("maxParallelUpgrades" in e for e in errors)

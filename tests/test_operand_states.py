@@ -184,3 +184,73 @@ def test_monitoring_objects_optional_without_crds():
     results = manager.sync_state(catalog(policy({"nodeStatusExporter": {"enabled": False}})))
     by_name = {r.state_name: r for r in results.results}
     assert by_name["state-node-status-exporter"].status == SyncState.IGNORE
+
+
+class TestOperatorWideMetadata:
+    """Spec fields that were declared but never consumed (audit r3):
+    operator.labels/annotations, daemonsets.labels/annotations,
+    operator.runtimeClass, operator.initContainer, cdi.default."""
+
+    def _policy(self, extra_spec=None):
+        from tpu_operator.api.clusterpolicy import ClusterPolicy, new_cluster_policy
+
+        spec = {
+            "operator": {"runtimeClass": "tpu-rt",
+                         "labels": {"team": "ml"},
+                         "annotations": {"audit": "r3"},
+                         "initContainer": {"repository": "gcr.io/x",
+                                           "image": "waiter",
+                                           "version": "9"}},
+            "daemonsets": {"labels": {"podlbl": "v"},
+                           "annotations": {"podann": "w"}},
+            "driver": {"repository": "g", "image": "i", "version": "1"},
+            "devicePlugin": {"repository": "g", "image": "i", "version": "1"},
+            "validator": {"repository": "g", "image": "i", "version": "1"},
+            "telemetry": {"repository": "g", "image": "i", "version": "1"},
+            "featureDiscovery": {"repository": "g", "image": "i", "version": "1"},
+            "nodeStatusExporter": {"repository": "g", "image": "i", "version": "1"},
+            "cdi": {"enabled": True, "default": True},
+        }
+        spec.update(extra_spec or {})
+        return ClusterPolicy.from_obj(new_cluster_policy(spec=spec))
+
+    def _render(self, state_name):
+        from tpu_operator.state.operands import cluster_policy_states
+
+        state = next(s for s in cluster_policy_states(client=None)
+                     if s.name == state_name)
+        return state.render_objects(self._policy(), "ns")
+
+    def test_operator_meta_stamped_on_every_object(self):
+        for obj in self._render("state-device-plugin"):
+            assert obj["metadata"]["labels"]["team"] == "ml", obj["kind"]
+            assert obj["metadata"]["annotations"]["audit"] == "r3", obj["kind"]
+
+    def test_daemonset_pod_template_gets_extras_and_runtime_class(self):
+        ds = [o for o in self._render("state-telemetry")
+              if o["kind"] == "DaemonSet"][0]
+        tpl = ds["spec"]["template"]
+        assert tpl["metadata"]["labels"]["podlbl"] == "v"
+        assert tpl["metadata"]["annotations"]["podann"] == "w"
+        assert tpl["spec"]["runtimeClassName"] == "tpu-rt"
+
+    def test_init_container_image_override_used_by_wait_inits(self):
+        ds = [o for o in self._render("state-device-plugin")
+              if o["kind"] == "DaemonSet"][0]
+        inits = ds["spec"]["template"]["spec"]["initContainers"]
+        assert inits[0]["image"] == "gcr.io/x/waiter:9"
+
+    def test_cdi_default_switches_plugin_to_cdi(self):
+        ds = [o for o in self._render("state-device-plugin")
+              if o["kind"] == "DaemonSet"][0]
+        env = {e["name"]: e.get("value")
+               for e in ds["spec"]["template"]["spec"]["containers"][0]["env"]}
+        assert env["TPU_USE_CDI"] == "1"
+
+    def test_driver_state_also_stamped(self):
+        from tpu_operator.state.driver import StateDriver
+
+        ds = [o for o in StateDriver(client=None).render_objects(
+                  self._policy(), "ns") if o["kind"] == "DaemonSet"][0]
+        assert ds["metadata"]["labels"]["team"] == "ml"
+        assert ds["spec"]["template"]["spec"]["runtimeClassName"] == "tpu-rt"

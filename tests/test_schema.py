@@ -76,7 +76,7 @@ class TestSchemaCoverage:
 
     def test_fully_populated_spec_roundtrips(self):
         spec = ClusterPolicySpec.from_dict({
-            "operator": {"defaultRuntime": "crio", "runtimeClass": "tpu",
+            "operator": {"runtimeClass": "tpu",
                          "initContainer": {"image": "busybox", "version": "1.36"},
                          "labels": {"a": "b"}, "annotations": {"c": "d"}},
             "daemonsets": {"updateStrategy": "OnDelete",

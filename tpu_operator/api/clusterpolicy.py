@@ -39,15 +39,20 @@ class State:
 
 @dataclasses.dataclass
 class OperatorSpec(SpecBase):
-    """Operator-wide settings (reference OperatorSpec)."""
+    """Operator-wide settings (reference OperatorSpec).
 
-    default_runtime: str = spec_field(
-        "containerd", doc="Container runtime of the cluster nodes.",
-        enum=("containerd", "docker", "crio"))
-    runtime_class: str = spec_field(
-        "tpu", doc="RuntimeClass name stamped on operand pods.")
+    The reference's ``defaultRuntime`` (containerd/docker/crio toolkit
+    config paths) has no TPU analog — there is no container-toolkit layer
+    to configure — and is deliberately absent rather than shipped as a
+    dead knob."""
+
+    runtime_class: Optional[str] = spec_field(
+        None, doc="RuntimeClass name stamped on operand pods (unset: "
+                  "none — TPU operands need no special runtime).")
     init_container: Optional[Dict[str, Any]] = spec_field(
-        None, schema=INIT_CONTAINER)
+        None, schema=INIT_CONTAINER,
+        doc="Image for the barrier-wait init containers injected into "
+            "operand pods (unset: the validator image).")
     labels: Dict[str, str] = spec_field(
         dict, doc="Extra labels for operator-managed objects.")
     annotations: Dict[str, str] = spec_field(
@@ -55,9 +60,18 @@ class OperatorSpec(SpecBase):
     extra: Dict[str, Any] = spec_field(dict)
 
     def validate(self, path: str = "spec.operator") -> List[str]:
-        if self.default_runtime not in ("containerd", "docker", "crio"):
-            return [f"{path}.defaultRuntime: invalid {self.default_runtime!r}"]
         return []
+
+    def init_container_image(self) -> Optional[str]:
+        """Image path from initContainer (repository/image:version, digest
+        aware) — resolved by the same logic as every operand image so
+        partial specs (image+version, digests) assemble correctly."""
+        ic = self.init_container or {}
+        if not ic.get("image"):
+            return None
+        return ComponentSpec.from_dict(
+            {k: ic[k] for k in ("repository", "image", "version")
+             if ic.get(k)}).image_path()
 
 
 @dataclasses.dataclass

@@ -23,7 +23,7 @@ SAMPLE_CLUSTER_POLICY = {
     "kind": "ClusterPolicy",
     "metadata": {"name": "cluster-policy"},
     "spec": {
-        "operator": {"defaultRuntime": "containerd"},
+        "operator": {},
         "daemonsets": {"updateStrategy": "RollingUpdate",
                        "priorityClassName": "system-node-critical"},
         "driver": {"enabled": True, "repository": "gcr.io/my-project",

@@ -3,8 +3,9 @@
 Caches-or-fetches the facts reconciles need: kubernetes version and the
 cluster's container runtime. Runtime detection reads
 ``node.status.nodeInfo.containerRuntimeVersion`` across nodes
-(clusterinfo.go:246-294); the most common runtime wins, with the
-ClusterPolicy's defaultRuntime as fallback.
+(clusterinfo.go:246-294); the most common runtime wins. (The reference
+falls back to the CR's defaultRuntime; that field has no TPU analog — no
+container-toolkit layer to configure — and is deliberately absent here.)
 """
 
 from __future__ import annotations

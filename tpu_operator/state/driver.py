@@ -99,8 +99,12 @@ class StateDriver:
     def render_objects(self, policy: ClusterPolicy, namespace: str,
                        overrides: Optional[DriverRenderOverrides] = None,
                        driver_spec=None) -> List[dict]:
-        return self.renderer.render_objects(
-            self.render_data(policy, namespace, overrides, driver_spec))
+        from .operands import stamp_operator_meta
+
+        return stamp_operator_meta(
+            self.renderer.render_objects(
+                self.render_data(policy, namespace, overrides, driver_spec)),
+            policy)
 
     # -- ClusterPolicy-path sync (one DS for all TPU nodes) -------------------
     def sync(self, catalog: InfoCatalog) -> StateResult:

@@ -32,6 +32,42 @@ from .manager import (
 from .skel import StateSkel, SyncState
 
 
+def stamp_operator_meta(objs: List[dict], policy: ClusterPolicy) -> List[dict]:
+    """Apply operator-wide metadata the CR promises (reference
+    applyCommonDaemonsetConfig / operator metadata handling): extra
+    labels/annotations on every managed object (spec.operator), extra pod
+    labels/annotations on every DaemonSet pod template (spec.daemonsets),
+    and runtimeClassName when spec.operator.runtimeClass is set."""
+    op = policy.spec.operator
+    ds_spec = policy.spec.daemonsets
+
+    def merge(meta: dict, key: str, extras: Dict[str, str]) -> None:
+        # template-authored keys WIN: a CR-level extra must never clobber
+        # e.g. the `app` label the DaemonSet selector matches on (the
+        # apiserver rejects selector/template mismatches outright)
+        target = meta.setdefault(key, {})
+        for k, v in extras.items():
+            target.setdefault(k, v)
+
+    for obj in objs:
+        meta = obj.setdefault("metadata", {})
+        if op.labels:
+            merge(meta, "labels", op.labels)
+        if op.annotations:
+            merge(meta, "annotations", op.annotations)
+        if obj.get("kind") != "DaemonSet":
+            continue
+        tpl = obj.setdefault("spec", {}).setdefault("template", {})
+        tpl_meta = tpl.setdefault("metadata", {})
+        if ds_spec.labels:
+            merge(tpl_meta, "labels", ds_spec.labels)
+        if ds_spec.annotations:
+            merge(tpl_meta, "annotations", ds_spec.annotations)
+        if op.runtime_class:
+            tpl.setdefault("spec", {})["runtimeClassName"] = op.runtime_class
+    return objs
+
+
 def component_data(component: ComponentSpec) -> dict:
     return {
         "image": component.image_path(),
@@ -78,7 +114,10 @@ class OperandState:
             "validation_status_dir": policy.spec.host_paths.validation_status_dir,
             "dev_globs": ",".join(policy.spec.host_paths.dev_globs),
             "handoff_dir": policy.spec.host_paths.partition_handoff_dir,
-            "validator_image": policy.spec.validator.image_path(),
+            # image for the barrier-wait init containers: the operator
+            # initContainer override wins, else the validator image
+            "validator_image": (policy.spec.operator.init_container_image()
+                                or policy.spec.validator.image_path()),
             "daemonsets": {
                 "update_strategy": policy.spec.daemonsets.update_strategy,
                 "rolling_update": policy.spec.daemonsets.rolling_update,
@@ -93,7 +132,9 @@ class OperandState:
         return data
 
     def render_objects(self, policy: ClusterPolicy, namespace: str) -> List[dict]:
-        return self.renderer.render_objects(self.render_data(policy, namespace))
+        return stamp_operator_meta(
+            self.renderer.render_objects(self.render_data(policy, namespace)),
+            policy)
 
     def sync(self, catalog: InfoCatalog) -> StateResult:
         policy: ClusterPolicy = catalog.require(INFO_CLUSTER_POLICY)
@@ -129,7 +170,8 @@ class PrerequisitesState(OperandState):
     def sync(self, catalog: InfoCatalog) -> StateResult:
         policy: ClusterPolicy = catalog.require(INFO_CLUSTER_POLICY)
         namespace: str = catalog.require(INFO_NAMESPACE)
-        objs = self.renderer.render_objects({"namespace": namespace})
+        objs = stamp_operator_meta(
+            self.renderer.render_objects({"namespace": namespace}), policy)
         self.skel.create_or_update_objs(objs, owner=policy.obj)
         return StateResult(self.name, SyncState.READY)
 
@@ -153,6 +195,9 @@ def device_plugin_extras(policy: ClusterPolicy) -> dict:
             # without the flag it would fall back to the compiled-in
             # default and silently skip the mount on bare-metal layouts
             "install_dir": policy.spec.libtpu_dir(),
+            # cdi.default switches Allocate() to CDI device references
+            # (the specs the driver state writes under /etc/cdi)
+            "cdi_default": policy.spec.cdi.enabled and policy.spec.cdi.default,
             "plugin_config": dp.config or {}}
 
 
