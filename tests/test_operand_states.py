@@ -254,3 +254,42 @@ class TestOperatorWideMetadata:
                   self._policy(), "ns") if o["kind"] == "DaemonSet"][0]
         assert ds["metadata"]["labels"]["team"] == "ml"
         assert ds["spec"]["template"]["spec"]["runtimeClassName"] == "tpu-rt"
+
+    def test_feature_discovery_sleep_interval_reaches_args(self):
+        from tpu_operator.api.clusterpolicy import ClusterPolicy, new_cluster_policy
+        from tpu_operator.state.operands import cluster_policy_states
+
+        policy = ClusterPolicy.from_obj(new_cluster_policy(spec={
+            "featureDiscovery": {"repository": "g", "image": "i",
+                                 "version": "1", "sleepInterval": "5m"},
+            "validator": {"repository": "g", "image": "i", "version": "1"},
+        }))
+        state = next(s for s in cluster_policy_states(client=None)
+                     if s.name == "state-feature-discovery")
+        ds = [o for o in state.render_objects(policy, "ns")
+              if o["kind"] == "DaemonSet"][0]
+        args = ds["spec"]["template"]["spec"]["containers"][0]["args"]
+        assert "--sleep-interval=300.0" in args
+
+    def test_device_plugin_config_tunables_consumed(self, tmp_path, monkeypatch):
+        """spec.devicePlugin.config is a real surface for the builtin
+        plugin, not a decorative mount."""
+        import tpu_operator.validator.main as vmain
+        from tpu_operator import deviceplugin
+
+        cfg = tmp_path / "config.yaml"
+        cfg.write_text("healthIntervalS: 3\nabsenceGraceS: 120\n")
+        monkeypatch.setenv("TPU_PLUGIN_CONFIG", str(cfg))
+        captured = {}
+
+        class FakePlugin:
+            def __init__(self, **kw):
+                captured.update(kw)
+
+            def run_forever(self):
+                return 0
+
+        monkeypatch.setattr(deviceplugin, "TPUDevicePlugin", FakePlugin)
+        assert vmain.run(["-c", "device-plugin"]) == 0
+        assert captured["health_interval"] == 3.0
+        assert captured["absence_grace_s"] == 120.0

@@ -73,6 +73,10 @@ class OperatorSpec(SpecBase):
             {k: ic[k] for k in ("repository", "image", "version")
              if ic.get(k)}).image_path()
 
+    def init_container_pull_policy(self) -> str:
+        return (self.init_container or {}).get("imagePullPolicy",
+                                               "IfNotPresent")
+
 
 @dataclasses.dataclass
 class DriverSpec(ComponentSpec):
@@ -123,6 +127,19 @@ class FeatureDiscoverySpec(ComponentSpec):
 
     sleep_interval: str = spec_field(
         "60s", doc="Re-label interval.", pattern=r"^[0-9]+(ms|s|m|h)$")
+
+    def validate(self, path: str = "spec.featureDiscovery") -> List[str]:
+        errors = super().validate(path)
+        # also enforced in Python: CRs arriving via paths that skip the
+        # apiserver pattern check (cfgtool files, tests) must fail here,
+        # not as a render-time ValueError inside the state sweep
+        import re
+
+        if not re.fullmatch(r"[0-9]+(ms|s|m|h)", str(self.sleep_interval)):
+            errors.append(f"{path}.sleepInterval: "
+                          f"{self.sleep_interval!r} is not a duration "
+                          f"(e.g. 500ms, 60s, 5m, 1h)")
+        return errors
 
 
 @dataclasses.dataclass

@@ -189,13 +189,19 @@ class MultihostValidationState:
             return SyncState.NOT_READY
 
         if not pods:
+            from .operands import stamp_operator_meta
+
             log.info("multihost %s: launching %d-way rendezvous", slice_id, n)
             self.skel.create_or_update_objs(
-                [self._service(slice_id, namespace)], owner=policy.obj)
+                stamp_operator_meta([self._service(slice_id, namespace)],
+                                    policy), owner=policy.obj)
             for worker, node in enumerate(members):
                 pod = self._pod(slice_id, worker, node, n, namespace, image,
                                 config_hash, resource)
-                self.skel.create_or_update_objs([pod], owner=policy.obj)
+                # these are the pods that actually run TPU workloads:
+                # operator-wide metadata and runtimeClass apply here too
+                self.skel.create_or_update_objs(
+                    stamp_operator_meta([pod], policy), owner=policy.obj)
             return SyncState.NOT_READY
 
         phases = [deep_get(p, "status", "phase", default="Pending") for p in pods]

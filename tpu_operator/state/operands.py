@@ -49,12 +49,27 @@ def stamp_operator_meta(objs: List[dict], policy: ClusterPolicy) -> List[dict]:
         for k, v in extras.items():
             target.setdefault(k, v)
 
+    # migration guard: the pre-r3 CRD defaulted runtimeClass to "tpu" as a
+    # DEAD knob, so stored CRs carry that value with no RuntimeClass object
+    # ever created — stamping it now would break every operand pod at
+    # admission. The legacy sentinel reads as unset; any other value is an
+    # explicit choice and is honored.
+    runtime_class = op.runtime_class if op.runtime_class != "tpu" else None
+
     for obj in objs:
         meta = obj.setdefault("metadata", {})
         if op.labels:
             merge(meta, "labels", op.labels)
         if op.annotations:
             merge(meta, "annotations", op.annotations)
+        if obj.get("kind") == "Pod":
+            if ds_spec.labels:
+                merge(meta, "labels", ds_spec.labels)
+            if ds_spec.annotations:
+                merge(meta, "annotations", ds_spec.annotations)
+            if runtime_class:
+                obj.setdefault("spec", {})["runtimeClassName"] = runtime_class
+            continue
         if obj.get("kind") != "DaemonSet":
             continue
         tpl = obj.setdefault("spec", {}).setdefault("template", {})
@@ -63,8 +78,8 @@ def stamp_operator_meta(objs: List[dict], policy: ClusterPolicy) -> List[dict]:
             merge(tpl_meta, "labels", ds_spec.labels)
         if ds_spec.annotations:
             merge(tpl_meta, "annotations", ds_spec.annotations)
-        if op.runtime_class:
-            tpl.setdefault("spec", {})["runtimeClassName"] = op.runtime_class
+        if runtime_class:
+            tpl.setdefault("spec", {})["runtimeClassName"] = runtime_class
     return objs
 
 
@@ -118,6 +133,7 @@ class OperandState:
             # initContainer override wins, else the validator image
             "validator_image": (policy.spec.operator.init_container_image()
                                 or policy.spec.validator.image_path()),
+            "wait_pull_policy": policy.spec.operator.init_container_pull_policy(),
             "daemonsets": {
                 "update_strategy": policy.spec.daemonsets.update_strategy,
                 "rolling_update": policy.spec.daemonsets.rolling_update,
@@ -174,6 +190,20 @@ class PrerequisitesState(OperandState):
             self.renderer.render_objects({"namespace": namespace}), policy)
         self.skel.create_or_update_objs(objs, owner=policy.obj)
         return StateResult(self.name, SyncState.READY)
+
+
+def _duration_seconds(value: str) -> float:
+    """'500ms' | '60s' | '5m' | '1h' -> seconds (spec duration strings)."""
+    units = {"ms": 0.001, "s": 1.0, "m": 60.0, "h": 3600.0}
+    for suffix, mult in units.items():
+        if str(value).endswith(suffix) and str(value)[:-len(suffix)].isdigit():
+            return int(str(value)[:-len(suffix)]) * mult
+    return float(value)
+
+
+def feature_discovery_extras(policy: ClusterPolicy) -> dict:
+    return {"sleep_interval_s":
+            _duration_seconds(policy.spec.feature_discovery.sleep_interval)}
 
 
 def telemetry_extras(policy: ClusterPolicy) -> dict:
@@ -245,6 +275,7 @@ def cluster_policy_states(client: Client) -> List:
         MultihostValidationState(client),
         OperandState("state-feature-discovery", "feature-discovery", client,
                      lambda p: p.spec.feature_discovery,
+                     extras=feature_discovery_extras,
                      app_name="tpu-feature-discovery"),
         OperandState("state-telemetry", "telemetry", client,
                      lambda p: p.spec.telemetry, extras=telemetry_extras,

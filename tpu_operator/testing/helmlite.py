@@ -36,7 +36,9 @@ _CONTROL = re.compile(
     r"^(\s*)\{\{-?\s*(if|with)\s+(.+?)\s*-?\}\}\s*$")
 _END = re.compile(r"^\s*\{\{-?\s*end\s*-?\}\}\s*$", re.MULTILINE)
 _TOYAML = re.compile(
-    r"^(\s*)\{\{-?\s*toYaml\s+(\.[A-Za-z0-9_.]*|\.)\s*\|\s*nindent\s+(\d+)\s*-?\}\}\s*$")
+    r"^(\s*)\{\{-?\s*toYaml\s+"
+    r"(?:\((\.[A-Za-z0-9_.]*|\.)\s*\|\s*default\s+dict\)|(\.[A-Za-z0-9_.]*|\.))"
+    r"\s*\|\s*nindent\s+(\d+)\s*-?\}\}\s*$")
 _INCLUDE = re.compile(
     r'^(\s*)\{\{-?\s*include\s+"([^"]+)"\s+\.\s*\|\s*nindent\s+(\d+)\s*-?\}\}\s*$')
 _DEFINE = re.compile(r'\{\{-?\s*define\s+"([^"]+)"\s*-?\}\}')
@@ -142,8 +144,10 @@ class HelmLite:
                 continue
             ty = _TOYAML.match(line)
             if ty:
-                _indent, expr, n = ty.groups()
-                value = self._lookup(expr, scope)
+                _indent, defaulted_expr, plain_expr, n = ty.groups()
+                value = self._lookup(defaulted_expr or plain_expr, scope)
+                if value is None and defaulted_expr:
+                    value = {}  # `| default dict`: nil renders as {}
                 if value is not None:
                     dumped = yaml.safe_dump(value, sort_keys=False,
                                             default_flow_style=False).rstrip()

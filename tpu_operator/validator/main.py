@@ -326,10 +326,31 @@ def run(argv=None, client=None) -> int:
     if component == "device-plugin":
         from ..deviceplugin import TPUDevicePlugin
 
+        # optional tunables from the spec.devicePlugin.config ConfigMap
+        # (mounted by the DS; builtin-plugin surface — external images
+        # read the same mount with their own schema)
+        tunables = {}
+        config_path = os.environ.get("TPU_PLUGIN_CONFIG")
+        if config_path and os.path.exists(config_path):
+            import yaml
+
+            try:
+                raw = yaml.safe_load(open(config_path)) or {}
+                for src, dst in (("healthIntervalS", "health_interval"),
+                                 ("absenceGraceS", "absence_grace_s")):
+                    if src in raw:
+                        tunables[dst] = float(raw[src])
+            except (yaml.YAMLError, TypeError, ValueError) as e:
+                # a ConfigMap typo the schema can't see must degrade to
+                # defaults, never crash-loop the plugin off the kubelet
+                log.error("device-plugin config %s invalid (%s); "
+                          "using defaults", config_path, e)
+                tunables = {}
         plugin = TPUDevicePlugin(resource_name=args.resource,
                                  libtpu_dir=args.install_dir,
                                  status_dir=args.status_dir,
-                                 handoff_dir=args.handoff_dir)
+                                 handoff_dir=args.handoff_dir,
+                                 **tunables)
         return plugin.run_forever()
 
     if component == "slice-partitioner":
