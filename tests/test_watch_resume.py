@@ -1,0 +1,219 @@
+"""Watch-resume fidelity over the wire: 410 Gone / ERROR-event semantics.
+
+Real apiservers retain a bounded watch history; a client resuming from a
+resourceVersion that fell out of it gets an in-stream ``ERROR`` event with a
+410 ``Status`` (or an HTTP 410) and must relist. The reference inherits this
+from client-go reflectors; here the RestClient watch loop owns it.  These
+tests pin both halves: MiniApiServer answering a provably-stale resume with
+ERROR/410, and _RestWatch recovering by relisting without ever forwarding the
+Status object to consumers.
+"""
+
+import json
+import threading
+import time
+
+import requests
+
+from tpu_operator.client.rest import RestClient, _RestWatch
+from tpu_operator.testing import MiniApiServer
+
+
+def _pod(name, ns="ns1"):
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "namespace": ns},
+            "spec": {}, "status": {"phase": "Running"}}
+
+
+def _wait_for(predicate, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_list_envelope_carries_store_rv():
+    srv = MiniApiServer()
+    base = srv.start()
+    try:
+        client = RestClient(base_url=base)
+        client.create(_pod("a"))
+        client.create(_pod("b"))
+        resp = requests.get(f"{base}/api/v1/namespaces/ns1/pods")
+        body = resp.json()
+        assert body["metadata"]["resourceVersion"] == str(srv.backend.current_rv())
+        # envelope rv >= every item rv
+        assert all(int(body["metadata"]["resourceVersion"])
+                   >= int(i["metadata"]["resourceVersion"]) for i in body["items"])
+    finally:
+        srv.stop()
+
+
+def test_stale_resume_gets_in_stream_error_410():
+    srv = MiniApiServer()
+    base = srv.start()
+    try:
+        client = RestClient(base_url=base)
+        client.create(_pod("a"))
+        old_rv = srv.backend.current_rv()
+        client.create(_pod("b"))  # event after old_rv: resume from old_rv missed it
+        resp = requests.get(f"{base}/api/v1/namespaces/ns1/pods",
+                            params={"watch": "true", "resourceVersion": str(old_rv)},
+                            stream=True, timeout=5)
+        first = next(l for l in resp.iter_lines() if l)
+        event = json.loads(first)
+        assert event["type"] == "ERROR"
+        assert event["object"]["code"] == 410
+        assert event["object"]["kind"] == "Status"
+    finally:
+        srv.stop()
+
+
+def test_current_resume_streams_live_events():
+    srv = MiniApiServer()
+    base = srv.start()
+    try:
+        client = RestClient(base_url=base)
+        client.create(_pod("a"))
+        rv = srv.backend.current_rv()
+        got = []
+        done = threading.Event()
+
+        def reader():
+            resp = requests.get(f"{base}/api/v1/namespaces/ns1/pods",
+                                params={"watch": "true", "resourceVersion": str(rv)},
+                                stream=True, timeout=35)
+            for line in resp.iter_lines():
+                if line:
+                    got.append(json.loads(line))
+                    done.set()
+                    return
+
+        t = threading.Thread(target=reader, daemon=True)
+        t.start()
+        time.sleep(0.2)
+        client.create(_pod("b"))
+        assert done.wait(5)
+        assert got[0]["type"] == "ADDED"
+        assert got[0]["object"]["metadata"]["name"] == "b"
+    finally:
+        srv.stop()
+
+
+def test_deleted_event_advances_rv():
+    """DELETED events must advance the store rv so a watcher that missed one
+    cannot silently resume as if nothing happened."""
+    srv = MiniApiServer()
+    base = srv.start()
+    try:
+        client = RestClient(base_url=base)
+        client.create(_pod("a"))
+        rv_before = srv.backend.current_rv()
+        client.delete("v1", "Pod", "a", "ns1")
+        assert srv.backend.current_rv() > rv_before
+        assert srv.backend.last_event_rv("v1", "Pod") == srv.backend.current_rv()
+    finally:
+        srv.stop()
+
+
+def test_clean_stream_end_resumes_without_relist(monkeypatch):
+    """Reflector contract: when the server closes an idle watch, the client
+    reconnects from the last streamed rv — it must NOT relist (one LIST per
+    idle timeout would hammer a real apiserver), and consumers must not see
+    duplicate synthetic ADDED events while nothing changed."""
+    srv = MiniApiServer(watch_idle_timeout_s=0.3)
+    base = srv.start()
+    try:
+        client = RestClient(base_url=base)
+        client.create(_pod("a"))
+
+        relists = {"n": 0}
+        real_relist = _RestWatch._relist
+
+        def counting_relist(self):
+            relists["n"] += 1
+            return real_relist(self)
+
+        monkeypatch.setattr(_RestWatch, "_relist", counting_relist)
+
+        events = []
+        handle = client.watch("v1", "Pod", "ns1", events.append)
+        try:
+            assert _wait_for(lambda: any(
+                e.object.get("metadata", {}).get("name") == "a" for e in events))
+            # sit through >= 2 idle closes + reconnects with no ns1 writes:
+            # every resume point stays valid, so exactly the initial relist
+            # happens. Traffic in OTHER namespaces advances the store rv the
+            # whole time — it must not expire a namespaced watcher's resume
+            # point (that would mean a full LIST + ADDED replay per reconnect
+            # in any busy multi-namespace cluster).
+            for i in range(6):
+                client.create(_pod(f"noise-{i}", ns="ns2"))
+                time.sleep(0.5)
+            assert relists["n"] == 1
+            assert sum(1 for e in events
+                       if e.object.get("metadata", {}).get("name") == "a") == 1
+            # the resumed stream is live: a new write still reaches the handler
+            client.create(_pod("b"))
+            assert _wait_for(lambda: any(
+                e.object.get("metadata", {}).get("name") == "b" for e in events))
+        finally:
+            handle.stop()
+    finally:
+        srv.stop()
+
+
+def test_restwatch_recovers_from_410_without_leaking_status(monkeypatch):
+    """Force the full client loop through a stale resume: the watcher must
+    relist and keep delivering object events, and the consumer must never see
+    the ERROR Status object as if it were a Pod."""
+    srv = MiniApiServer()
+    base = srv.start()
+    try:
+        client = RestClient(base_url=base)
+        client.create(_pod("a"))
+
+        real_relist = _RestWatch._relist
+        forced = {"done": False}
+
+        def stale_relist(self):
+            rv = real_relist(self)
+            if not forced["done"]:
+                forced["done"] = True
+                return "1"  # provably ancient: guarantees ERROR/410 on connect
+            return rv
+
+        monkeypatch.setattr(_RestWatch, "_relist", stale_relist)
+
+        events = []
+        seen_types = set()
+        lock = threading.Lock()
+
+        def handler(ev):
+            with lock:
+                events.append(ev)
+                seen_types.add(ev.type)
+
+        # a later write bumps last_event_rv above the forced stale rv
+        client.create(_pod("b"))
+        handle = client.watch("v1", "Pod", "ns1", handler)
+        try:
+            # after the 410 the loop relists (second, honest relist) and the
+            # handler sees both pods as ADDED
+            assert _wait_for(lambda: forced["done"])
+            assert _wait_for(
+                lambda: {"a", "b"} <= {e.object.get("metadata", {}).get("name")
+                                       for e in events if e.type == "ADDED"})
+            # live events still flow after recovery
+            client.create(_pod("c"))
+            assert _wait_for(
+                lambda: any(e.object.get("metadata", {}).get("name") == "c"
+                            for e in events))
+            assert "ERROR" not in seen_types
+            assert all(e.object.get("kind") != "Status" for e in events)
+        finally:
+            handle.stop()
+    finally:
+        srv.stop()

@@ -110,6 +110,13 @@ class FakeClient(Client):
         self._lock = threading.RLock()
         self._store: Dict[Key, dict] = {}
         self._rv = 0
+        # last rv at which an event was emitted, per (apiVersion, kind,
+        # namespace): lets the HTTP facade answer "did this watcher miss
+        # anything?" the way a real apiserver's watch cache does (410 Gone
+        # on resume from before the retained history). Keyed by namespace so
+        # a namespaced watcher isn't spuriously expired by other namespaces'
+        # traffic on every reconnect.
+        self._last_event_rv: Dict[Tuple[str, str, str], int] = {}
         self._watches: List[_FakeWatch] = []
         # Server-side CRD schema enforcement (VERDICT r1 #2): every write of
         # a tpu.ai CR is validated against the generated openAPIV3Schema the
@@ -140,7 +147,24 @@ class FakeClient(Client):
         self._rv += 1
         return str(self._rv)
 
+    def current_rv(self) -> int:
+        """Store-wide resourceVersion, the List-envelope resume point."""
+        with self._lock:
+            return self._rv
+
+    def last_event_rv(self, api_version: str, kind: str,
+                      namespace: Optional[str] = None) -> int:
+        """rv of the newest event emitted for this kind (0 = never).
+        ``namespace=None`` means the all-namespaces watch scope."""
+        with self._lock:
+            if namespace is not None:
+                return self._last_event_rv.get((api_version, kind, namespace), 0)
+            return max((rv for (av, k, _), rv in self._last_event_rv.items()
+                        if av == api_version and k == kind), default=0)
+
     def _notify(self, event_type: str, obj: dict) -> None:
+        self._last_event_rv[(obj.get("apiVersion"), obj.get("kind"),
+                             obj.get("metadata", {}).get("namespace", ""))] = self._rv
         for w in list(self._watches):
             api_version, kind, ns = w._key
             if api_version != obj.get("apiVersion") or kind != obj.get("kind"):
@@ -241,6 +265,10 @@ class FakeClient(Client):
             obj = self._store.pop(key, None)
             if obj is None:
                 raise NotFoundError(f"{kind} {namespace or ''}/{name} not found")
+            # deletions advance the store rv and the DELETED event carries it,
+            # matching real apiserver semantics (a watcher resuming from
+            # before the delete must be able to tell it missed one)
+            obj["metadata"]["resourceVersion"] = self._next_rv()
             self._notify("DELETED", obj)
             self._collect_orphans(obj["metadata"]["uid"])
 

@@ -119,21 +119,26 @@ class RestClient(Client):
         self._raise_for(resp)
         return resp.json()
 
+    def _list_body(self, api_version, kind, namespace=None, params=None) -> dict:
+        """LIST returning the full List envelope (watch resume needs its
+        ``metadata.resourceVersion``; plain list() discards it)."""
+        resp = self._session.get(self.resource_url(api_version, kind, namespace),
+                                 params=params or {}, timeout=60)
+        self._raise_for(resp)
+        body = resp.json()
+        # list items omit apiVersion/kind; restore them
+        for item in body.get("items", []):
+            item.setdefault("apiVersion", api_version)
+            item.setdefault("kind", kind)
+        return body
+
     def list(self, api_version, kind, namespace=None, label_selector=None, field_selector=None) -> List[dict]:
         params = {}
         if label_selector:
             params["labelSelector"] = self._selector_param(label_selector)
         if field_selector:
             params["fieldSelector"] = ",".join(f"{k}={v}" for k, v in field_selector.items())
-        resp = self._session.get(self.resource_url(api_version, kind, namespace), params=params)
-        self._raise_for(resp)
-        body = resp.json()
-        items = body.get("items", [])
-        # list items omit apiVersion/kind; restore them
-        for item in items:
-            item.setdefault("apiVersion", api_version)
-            item.setdefault("kind", kind)
-        return items
+        return self._list_body(api_version, kind, namespace, params).get("items", [])
 
     def create(self, obj: dict) -> dict:
         ns = obj.get("metadata", {}).get("namespace")
@@ -212,12 +217,15 @@ class _RestWatch(WatchHandle):
             self._queue.put(event)
 
     def _relist(self) -> str:
-        items = self._client.list(self._api_version, self._kind, self._namespace)
+        body = self._client._list_body(self._api_version, self._kind, self._namespace)
         rv = ""
-        for item in items:
+        for item in body.get("items", []):
             rv = item.get("metadata", {}).get("resourceVersion", rv)
             self._emit(WatchEvent(type="ADDED", object=item))
-        return rv
+        # resume from the List ENVELOPE rv: item rvs only say when each item
+        # last changed — resuming from the newest item replays (or, on a
+        # strict server, 410s over) every other kind's interleaved writes
+        return body.get("metadata", {}).get("resourceVersion") or rv
 
     def _run(self) -> None:
         url = self._client.resource_url(self._api_version, self._kind, self._namespace)
@@ -229,9 +237,19 @@ class _RestWatch(WatchHandle):
                 params = {"watch": "true", "allowWatchBookmarks": "true"}
                 if rv:
                     params["resourceVersion"] = rv
+                expired = False
+                error_code = None
                 with self._client._session.get(url, params=params, stream=True, timeout=330) as resp:
                     if resp.status_code >= 400:
-                        self._stopped.wait(2.0)
+                        # any rejected watch connect falls back to relist: the
+                        # rv itself may be what the server objects to (410
+                        # Gone, 400 invalid rv, 504 rv-too-large after an etcd
+                        # restore), and retrying an identical doomed rv would
+                        # stall the watcher forever. 410 relists promptly (but
+                        # never in a tight LIST loop — a server whose history
+                        # window is shorter than the list RTT would otherwise
+                        # be hammered); other errors back off first.
+                        self._stopped.wait(0.2 if resp.status_code == 410 else 2.0)
                         rv = ""
                         continue
                     for line in resp.iter_lines():
@@ -241,22 +259,40 @@ class _RestWatch(WatchHandle):
                             continue
                         event = json.loads(line)
                         etype, obj = event.get("type"), event.get("object", {})
+                        if etype == "ERROR":
+                            # in-stream Status (410 Gone et al.): NOT an object
+                            # event — never forward to consumers; resync state
+                            # via relist. Only a true 410 earns the prompt
+                            # retry; other codes (500 'etcdserver timed out'…)
+                            # back off like the HTTP path so a struggling
+                            # server isn't hammered with full LISTs.
+                            expired = True
+                            error_code = obj.get("code")
+                            break
                         rv = obj.get("metadata", {}).get("resourceVersion", rv)
                         if etype == "BOOKMARK":
                             continue
                         obj.setdefault("apiVersion", self._api_version)
                         obj.setdefault("kind", self._kind)
                         self._emit(WatchEvent(type=etype, object=obj))
-                # clean stream end: the server may not support resuming from
-                # our resourceVersion, and anything changed in the reconnect
-                # gap would be lost — re-LIST so consumers see current state.
+                if expired:
+                    self._stopped.wait(0.2 if error_code == 410 else 2.0)
+                    rv = ""
+                    continue
+                # clean stream end (apiservers close watches periodically):
+                # resume from the last streamed rv — NO relist. If that resume
+                # point has fallen out of the server's history it answers
+                # 410/ERROR and the paths above relist; this is client-go's
+                # reflector contract and avoids a full LIST per idle timeout.
                 # Brief pause so a server that closes watches immediately
-                # doesn't get hammered with a full LIST per iteration.
+                # isn't hammered with a reconnect per iteration.
                 self._stopped.wait(1.0)
-                rv = ""
-            except (requests.RequestException, json.JSONDecodeError, ValueError):
+            except (requests.RequestException, json.JSONDecodeError, ValueError, ApiError):
+                # transient transport/LIST failure (429/500, mid-stream JSON
+                # truncation): back off and retry from the last good resume
+                # point — a stale one surfaces as 410, never silent loss; and
+                # never let an ApiError kill the watch thread
                 self._stopped.wait(2.0)
-                rv = ""
 
     def stop(self) -> None:
         self._stopped.set()

@@ -74,10 +74,13 @@ class MiniApiServer:
     fast next to a real cluster (VERDICT r2 weak-#4)."""
 
     def __init__(self, backend: Optional[FakeClient] = None, scheme: Optional[Scheme] = None,
-                 latency_s: float = 0.0):
+                 latency_s: float = 0.0, watch_idle_timeout_s: float = 30.0):
         self.scheme = scheme or default_scheme()
         self.backend = backend or FakeClient(self.scheme)
         self.latency_s = latency_s
+        # how long an event-less watch stream stays open before the server
+        # closes it — real apiservers do this on a timer; clients must resume
+        self.watch_idle_timeout_s = watch_idle_timeout_s
         self._router = _Router(self.scheme)
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -132,29 +135,76 @@ class MiniApiServer:
                     label_selector = _parse_selector(params["labelSelector"][0]) if "labelSelector" in params else None
                     field_selector = _parse_selector(params["fieldSelector"][0]) if "fieldSelector" in params else None
                     if params.get("watch", ["false"])[0] == "true":
-                        self._watch(api_version, kind, ns)
+                        self._watch(api_version, kind, ns, params)
                         return
+                    # the List envelope carries the store-wide rv — the only
+                    # safe watch-resume point (item rvs can be arbitrarily
+                    # old). Read it BEFORE snapshotting items: a write landing
+                    # between the two then yields an envelope rv OLDER than
+                    # reality, which fails safe (spurious 410 → relist) where
+                    # the opposite order silently loses the interleaved event.
+                    envelope_rv = str(server.backend.current_rv())
                     items = server.backend.list(api_version, kind, ns, label_selector, field_selector)
-                    self._send(200, {"kind": f"{kind}List", "apiVersion": api_version, "items": items})
+                    self._send(200, {"kind": f"{kind}List", "apiVersion": api_version,
+                                     "metadata": {"resourceVersion": envelope_rv},
+                                     "items": items})
                 except ApiError as e:
                     self._fail(e)
 
-            def _watch(self, api_version, kind, ns):
+            def _chunk(self, payload: dict) -> None:
+                line = json.dumps(payload).encode() + b"\n"
+                self.wfile.write(f"{len(line):x}\r\n".encode() + line + b"\r\n")
+                self.wfile.flush()
+
+            def _start_chunked(self) -> None:
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+            def _watch(self, api_version, kind, ns, params):
+                # Real watch-cache semantics: this server keeps NO event
+                # history, so any resume from before the latest event for
+                # this kind has provably missed events — answer with an
+                # in-stream ERROR/410 Status (exactly how a real apiserver
+                # reports "too old resource version") so the client relists.
+                # rv="0" is the k8s "any recent state" idiom (client-go
+                # informers use it routinely) — a real apiserver never answers
+                # it with Expired, so neither do we
+                client_rv = params.get("resourceVersion", [""])[0]
+                if client_rv == "0":
+                    client_rv = ""
+                # register the live watch FIRST, then judge staleness: an
+                # event landing between the check and the registration would
+                # otherwise be neither replayed nor flagged — the exact lost-
+                # event window the 410 machinery exists to close
                 events: "queue.Queue" = queue.Queue()
                 handle = server.backend.watch(api_version, kind, ns, handler=events.put)
+                if client_rv:
+                    try:
+                        stale = int(client_rv) < server.backend.last_event_rv(api_version, kind, ns)
+                    except ValueError:
+                        stale = True
+                    if stale:
+                        handle.stop()
+                        try:
+                            self._start_chunked()
+                            self._chunk({"type": "ERROR", "object": {
+                                "kind": "Status", "apiVersion": "v1",
+                                "status": "Failure", "reason": "Expired", "code": 410,
+                                "message": f"too old resource version: {client_rv}"}})
+                            self.wfile.write(b"0\r\n\r\n")
+                        except (BrokenPipeError, ConnectionResetError):
+                            pass
+                        return
                 try:
-                    self.send_response(200)
-                    self.send_header("Content-Type", "application/json")
-                    self.send_header("Transfer-Encoding", "chunked")
-                    self.end_headers()
+                    self._start_chunked()
                     while True:
                         try:
-                            ev = events.get(timeout=30)
+                            ev = events.get(timeout=server.watch_idle_timeout_s)
                         except queue.Empty:
                             break
-                        line = json.dumps({"type": ev.type, "object": ev.object}).encode() + b"\n"
-                        self.wfile.write(f"{len(line):x}\r\n".encode() + line + b"\r\n")
-                        self.wfile.flush()
+                        self._chunk({"type": ev.type, "object": ev.object})
                     self.wfile.write(b"0\r\n\r\n")
                 except (BrokenPipeError, ConnectionResetError):
                     pass
