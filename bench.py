@@ -26,17 +26,21 @@ import time
 
 def bench_control_plane(n_nodes: int = 4, timeout: float = 115.0,
                         latency_s: float = 0.0, interval: float = 0.05,
-                        rollout_ticks: int = 0):
+                        rollout_ticks: int = 0, cached: bool = True):
     """Time node creation -> all nodes schedulable + ClusterPolicy ready.
-    Returns seconds, or None if the budget expired before convergence —
-    a timeout is "did not converge", never published as a measurement.
+    Returns ``(seconds, operator_api_requests)``; seconds is None if the
+    budget expired before convergence — a timeout is "did not converge",
+    never published as a measurement.
 
     The default arguments time the raw simulator (in-process apiserver,
     instant DS rollouts) — a regression trend, NOT a real-cluster number.
     ``latency_s``/``interval``/``rollout_ticks`` inject per-request
     apiserver latency and a DS rollout delay (image pull + container
     start stand-in) for the honest variant (VERDICT r2 weak-#4: real node
-    join includes VM boot, image pulls, and apiserver latency)."""
+    join includes VM boot, image pulls, and apiserver latency).
+    ``cached`` runs the operator behind the informer read cache, the
+    production default; False measures direct apiserver reads for the
+    read-amplification comparison."""
     for env, image in (
         ("DRIVER_IMAGE", "gcr.io/tpu/tpu-validator:0.1.0"),
         ("VALIDATOR_IMAGE", "gcr.io/tpu/tpu-validator:0.1.0"),
@@ -59,11 +63,21 @@ def bench_control_plane(n_nodes: int = 4, timeout: float = 115.0,
     base = srv.start()
     seed = RestClient(base_url=base)
     seed.create(new_cluster_policy())
-    app = OperatorApp(RestClient(base_url=base))
+    op_client = RestClient(base_url=base)
+    if cached:
+        from tpu_operator.client.cache import CachedClient
+        op_client = CachedClient(op_client)
+    app = OperatorApp(op_client)
     kubelet = KubeletSimulator(seed, interval=interval,
                                rollout_ticks=rollout_ticks)
     app.start()
     kubelet.start()
+
+    # only the operator's traffic is the measurement: the seed client and
+    # kubelet share the server, so count from a dedicated baseline captured
+    # while they are the only talkers and subtract their steady-state share
+    # — simpler and honest: report TOTAL requests over the run, labeled so.
+    t_req0 = srv.request_count
     try:
         t0 = time.monotonic()
         for i in range(n_nodes):
@@ -80,11 +94,13 @@ def bench_control_plane(n_nodes: int = 4, timeout: float = 115.0,
             cp_ready = deep_get(seed.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy"),
                                 "status", "state") == "ready"
             if schedulable == n_nodes and cp_ready:
-                return time.monotonic() - t0
+                return time.monotonic() - t0, srv.request_count - t_req0
             time.sleep(0.05)
-        return None
+        return None, srv.request_count - t_req0
     finally:
         app.stop()
+        if hasattr(op_client, "stop"):
+            op_client.stop()
         kubelet.stop()
         srv.stop()
 
@@ -229,9 +245,14 @@ INJECTED = dict(latency_s=0.02, interval=0.5, rollout_ticks=20)
 
 
 def main() -> int:
-    control_plane_raw_s = bench_control_plane()
-    control_plane_s = bench_control_plane(**INJECTED)
-    cp_timed_out = control_plane_s is None or control_plane_raw_s is None
+    control_plane_raw_s, _ = bench_control_plane()
+    control_plane_s, cp_requests = bench_control_plane(**INJECTED)
+    # same injected scenario without the informer cache: quantifies the
+    # read-amplification the cache removes (requests AND seconds)
+    control_plane_uncached_s, cp_uncached_requests = bench_control_plane(
+        cached=False, **INJECTED)
+    cp_injected_timed_out = control_plane_s is None
+    cp_timed_out = cp_injected_timed_out or control_plane_raw_s is None
     # a saturated budget is a failure to converge, not a 115 s measurement:
     # flag it, floor the headline at the budget, and fail the exit code
     if control_plane_s is None:
@@ -255,6 +276,17 @@ def main() -> int:
         # the raw in-process number is a regression trend only
         "control_plane_s": round(control_plane_s, 3),
         "control_plane_raw_sim_s": round(control_plane_raw_s, 3),
+        # informer-cache effect under the same injected latency: total HTTP
+        # requests to the apiserver during the join (operator + kubelet sim
+        # + bench poller combined — the DELTA between the two runs is the
+        # operator's read amplification). A timed-out run's count is from a
+        # truncated, non-converged window — not a measurement, so nulled.
+        "control_plane_api_requests": (None if cp_injected_timed_out
+                                       else cp_requests),
+        "control_plane_uncached_s": (round(control_plane_uncached_s, 3)
+                                     if control_plane_uncached_s is not None else None),
+        "control_plane_uncached_api_requests": (
+            cp_uncached_requests if control_plane_uncached_s is not None else None),
         "control_plane_sim": {
             "simulated": True,
             "timed_out": cp_timed_out,

@@ -1,0 +1,290 @@
+"""Informer-backed CachedClient: controller-runtime's cached-read contract.
+
+Covers both backends: FakeClient (atomic snapshot at watch registration) and
+RestClient→MiniApiServer over the wire (initial relist sync, 410-resync
+replace purging entries deleted during a missed-event window, and the
+read-amplification win: one LIST per kind instead of a GET per object).
+"""
+
+import time
+
+import pytest
+
+from tpu_operator.client.cache import CachedClient
+from tpu_operator.client.errors import ConflictError, NotFoundError
+from tpu_operator.client.fake import FakeClient
+from tpu_operator.client.rest import RestClient
+from tpu_operator.testing import MiniApiServer
+
+
+def _pod(name, ns="default", labels=None):
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "namespace": ns,
+                         **({"labels": labels} if labels else {})},
+            "spec": {}, "status": {"phase": "Running"}}
+
+
+def _node(name, labels=None):
+    return {"apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": name, **({"labels": labels} if labels else {})},
+            "spec": {}, "status": {}}
+
+
+def _wait_for(predicate, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+# -- FakeClient backend ------------------------------------------------------
+
+def test_cache_serves_preexisting_and_live_objects():
+    backend = FakeClient()
+    backend.create(_pod("a"))
+    cached = CachedClient(backend)
+    assert cached.get("v1", "Pod", "a")["metadata"]["name"] == "a"
+    backend.create(_pod("b"))  # out-of-band write arrives via the event stream
+    assert _wait_for(lambda: any(
+        p["metadata"]["name"] == "b" for p in cached.list("v1", "Pod", "default")))
+
+
+def test_cache_get_missing_raises_not_found():
+    cached = CachedClient(FakeClient())
+    with pytest.raises(NotFoundError):
+        cached.get("v1", "Pod", "nope")
+
+
+def test_cache_list_selectors_and_scoping():
+    backend = FakeClient()
+    backend.create(_pod("a", ns="ns1", labels={"app": "x"}))
+    backend.create(_pod("b", ns="ns1", labels={"app": "y"}))
+    backend.create(_pod("c", ns="ns2", labels={"app": "x"}))
+    cached = CachedClient(backend)
+    all_ns = cached.list("v1", "Pod")  # all-namespaces informer
+    assert {p["metadata"]["name"] for p in all_ns} == {"a", "b", "c"}
+    scoped = cached.list("v1", "Pod", "ns1", label_selector={"app": "x"})
+    assert [p["metadata"]["name"] for p in scoped] == ["a"]
+    by_field = cached.list("v1", "Pod", "ns2",
+                           field_selector={"metadata.name": "c"})
+    assert [p["metadata"]["name"] for p in by_field] == ["c"]
+
+
+def test_cache_write_through_and_delete():
+    backend = FakeClient()
+    cached = CachedClient(backend)
+    cached.create(_node("n1"))
+    # visible immediately (write-through), not just eventually
+    assert cached.get("v1", "Node", "n1")["metadata"]["name"] == "n1"
+    got = cached.get("v1", "Node", "n1")
+    got["metadata"].setdefault("labels", {})["x"] = "1"
+    cached.update(got)
+    assert cached.get("v1", "Node", "n1")["metadata"]["labels"]["x"] == "1"
+    cached.delete("v1", "Node", "n1")
+    with pytest.raises(NotFoundError):
+        cached.get("v1", "Node", "n1")
+
+
+def test_cache_read_mutation_does_not_poison_store():
+    backend = FakeClient()
+    backend.create(_node("n1"))
+    cached = CachedClient(backend)
+    cached.get("v1", "Node", "n1")["metadata"]["name"] = "mutated"
+    assert cached.get("v1", "Node", "n1")["metadata"]["name"] == "n1"
+
+
+def test_stale_cached_rv_write_surfaces_conflict():
+    """The documented staleness contract: writing with a cached (stale) rv
+    must fail loudly with 409, never clobber silently."""
+    backend = FakeClient()
+    backend.create(_node("n1"))
+    cached = CachedClient(backend)
+    stale = cached.get("v1", "Node", "n1")
+    fresh = backend.get("v1", "Node", "n1")
+    fresh["metadata"].setdefault("labels", {})["winner"] = "yes"
+    backend.update(fresh)
+    stale["metadata"].setdefault("labels", {})["winner"] = "no"
+    with pytest.raises(ConflictError):
+        cached.update(stale)
+
+
+def test_out_of_order_events_do_not_regress_cache():
+    backend = FakeClient()
+    cached = CachedClient(backend)
+    cached.create(_node("n1"))
+    newer = cached.get("v1", "Node", "n1")
+    newer["metadata"].setdefault("labels", {})["v"] = "2"
+    cached.update(newer)
+    informer = next(iter(cached._informers.values()))
+    # a late-delivered older event must not overwrite the newer state
+    informer.apply("MODIFIED", {"apiVersion": "v1", "kind": "Node",
+                                "metadata": {"name": "n1",
+                                             "resourceVersion": "1"}})
+    assert cached.get("v1", "Node", "n1")["metadata"]["labels"]["v"] == "2"
+
+
+def test_shared_informer_watch_replays_and_streams():
+    backend = FakeClient()
+    backend.create(_node("pre"))
+    cached = CachedClient(backend)
+    events = []
+    handle = cached.watch("v1", "Node", handler=events.append)
+    # initial replay of pre-existing state (informer list-then-watch contract)
+    assert _wait_for(lambda: any(
+        e.object["metadata"]["name"] == "pre" and e.type == "ADDED" for e in events))
+    backend.create(_node("live"))
+    assert _wait_for(lambda: any(
+        e.object["metadata"]["name"] == "live" for e in events))
+    backend.delete("v1", "Node", "live")
+    assert _wait_for(lambda: any(e.type == "DELETED" for e in events))
+    handle.stop()
+    backend.create(_node("after-stop"))
+    time.sleep(0.1)
+    assert not any(e.object["metadata"]["name"] == "after-stop" for e in events)
+
+
+def test_shared_informer_one_stream_many_watchers():
+    """N controller watches on one kind must not open N server-side streams."""
+    srv = MiniApiServer()
+    base = srv.start()
+    try:
+        writer = RestClient(base_url=base)
+        writer.create(_node("n1"))
+        cached = CachedClient(RestClient(base_url=base))
+        try:
+            sinks = [[] for _ in range(3)]
+            handles = [cached.watch("v1", "Node", handler=s.append) for s in sinks]
+            time.sleep(0.3)
+            t0 = srv.request_count
+            writer.create(_node("n2"))
+            assert _wait_for(lambda: all(
+                any(e.object["metadata"]["name"] == "n2" for e in s) for s in sinks))
+            # the event reached all 3 watchers through the informer's single
+            # stream: no extra watch/list requests beyond the writer's create
+            assert srv.request_count - t0 <= 1
+            # a subscriber mutating its event must not poison its siblings
+            sinks[0][0].object["metadata"]["name"] = "mutated"
+            assert sinks[1][0].object["metadata"]["name"] != "mutated"
+            for h in handles:
+                h.stop()
+        finally:
+            cached.stop()
+    finally:
+        srv.stop()
+
+
+def test_scoped_watch_from_superset_informer_is_filtered():
+    """A namespaced watch routed onto the all-namespaces superset informer
+    must not become a cluster-wide firehose."""
+    backend = FakeClient()
+    backend.create(_pod("pre-ns1", ns="ns1"))
+    backend.create(_pod("pre-ns2", ns="ns2"))
+    cached = CachedClient(backend)
+    cached.list("v1", "Pod")  # creates the all-namespaces informer
+    events = []
+    handle = cached.watch("v1", "Pod", "ns1", handler=events.append)
+    assert _wait_for(lambda: any(
+        e.object["metadata"]["name"] == "pre-ns1" for e in events))
+    backend.create(_pod("live-ns1", ns="ns1"))
+    backend.create(_pod("live-ns2", ns="ns2"))
+    assert _wait_for(lambda: any(
+        e.object["metadata"]["name"] == "live-ns1" for e in events))
+    names = {e.object["metadata"]["name"] for e in events}
+    assert "pre-ns2" not in names and "live-ns2" not in names
+    handle.stop()
+
+
+# -- RestClient backend over the wire ----------------------------------------
+
+def test_cache_over_the_wire_sync_and_events():
+    srv = MiniApiServer()
+    base = srv.start()
+    try:
+        writer = RestClient(base_url=base)
+        writer.create(_pod("a", ns="ns1"))
+        cached = CachedClient(RestClient(base_url=base))
+        try:
+            assert cached.get("v1", "Pod", "a", "ns1")["metadata"]["name"] == "a"
+            writer.create(_pod("b", ns="ns1"))
+            assert _wait_for(lambda: any(
+                p["metadata"]["name"] == "b"
+                for p in cached.list("v1", "Pod", "ns1")))
+            writer.delete("v1", "Pod", "a", "ns1")
+            def gone():
+                try:
+                    cached.get("v1", "Pod", "a", "ns1")
+                    return False
+                except NotFoundError:
+                    return True
+            assert _wait_for(gone)
+        finally:
+            cached.stop()
+    finally:
+        srv.stop()
+
+
+def test_cache_410_resync_purges_entry_deleted_in_the_gap():
+    """The tombstone case an ADDED-replay cache gets wrong: an object deleted
+    while the informer's watch stream is down must vanish from the cache
+    after the 410-triggered relist, not linger forever."""
+    srv = MiniApiServer(watch_idle_timeout_s=0.3)
+    base = srv.start()
+    try:
+        writer = RestClient(base_url=base)
+        writer.create(_pod("doomed", ns="ns1"))
+        writer.create(_pod("stays", ns="ns1"))
+        cached = CachedClient(RestClient(base_url=base))
+        try:
+            assert cached.get("v1", "Pod", "doomed", "ns1")
+            events = []
+            handle = cached.watch("v1", "Pod", "ns1", handler=events.append)
+            # wait for the idle close, then delete + churn during the gap so
+            # the resume rv is provably stale -> server 410s -> full relist
+            time.sleep(0.5)
+            writer.delete("v1", "Pod", "doomed", "ns1")
+            writer.create(_pod("churn", ns="ns1"))
+
+            def doomed_gone():
+                try:
+                    cached.get("v1", "Pod", "doomed", "ns1")
+                    return False
+                except NotFoundError:
+                    return True
+            assert _wait_for(doomed_gone)
+            assert cached.get("v1", "Pod", "stays", "ns1")
+            # subscribers got a tombstone DELETED for the object removed in
+            # the gap (Replace semantics), not just a silent cache purge
+            assert _wait_for(lambda: any(
+                e.type == "DELETED" and e.object["metadata"]["name"] == "doomed"
+                for e in events))
+            handle.stop()
+        finally:
+            cached.stop()
+    finally:
+        srv.stop()
+
+
+def test_cache_read_amplification_one_list_per_kind():
+    """N cached GETs cost one LIST + one watch connect, not N round-trips."""
+    srv = MiniApiServer()
+    base = srv.start()
+    try:
+        writer = RestClient(base_url=base)
+        for i in range(20):
+            writer.create(_node(f"n{i}"))
+        cached = CachedClient(RestClient(base_url=base))
+        try:
+            cached.get("v1", "Node", "n0")  # starts the informer (1 LIST)
+            time.sleep(0.5)  # let the async watch connect land before counting
+            t0 = srv.request_count
+            for i in range(20):
+                cached.get("v1", "Node", f"n{i}")
+            cached.list("v1", "Node")
+            assert srv.request_count == t0, (
+                "cached reads must not generate apiserver requests")
+        finally:
+            cached.stop()
+    finally:
+        srv.stop()

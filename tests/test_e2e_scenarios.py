@@ -29,16 +29,33 @@ def default_images(monkeypatch):
     monkeypatch.setenv("DEVICE_PLUGIN_IMAGE", "gcr.io/tpu/device-plugin:0.1.0")
 
 
-@pytest.fixture
-def cluster():
+@pytest.fixture(params=["direct", "cached"])
+def cluster(request):
+    """Every scenario runs twice: operator reads straight from the apiserver,
+    and through the informer cache (the production default) — the cache's
+    staleness contract must never change observable convergence."""
     srv = MiniApiServer()
     base = srv.start()
     client = RestClient(base_url=base)
     kubelet = KubeletSimulator(client, interval=0.03).start()
-    app = OperatorApp(RestClient(base_url=base))
-    state = {"srv": srv, "base": base, "client": client, "kubelet": kubelet, "app": app}
+    op_clients = []
+
+    def make_op_client():
+        op = RestClient(base_url=base)
+        if request.param == "cached":
+            from tpu_operator.client.cache import CachedClient
+            op = CachedClient(op)
+        op_clients.append(op)
+        return op
+
+    app = OperatorApp(make_op_client())
+    state = {"srv": srv, "base": base, "client": client, "kubelet": kubelet,
+             "app": app, "make_op_client": make_op_client}
     yield state
     state["app"].stop()
+    for op in op_clients:  # incl. restart-scenario clients: informer threads
+        if hasattr(op, "stop"):  # must not outlive the server they watch
+            op.stop()
     kubelet.stop()
     srv.stop()
 
@@ -82,10 +99,12 @@ def test_install_verify_update_restart_uninstall(cluster):
         assert ds["status"]["numberAvailable"] == 2, name
 
     # -- update-clusterpolicy.sh analog: bump driver version ------------------
-    cp = client.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy")
-    cp["spec"]["driver"] = {"repository": "gcr.io/tpu", "image": "tpu-validator",
-                            "version": "0.2.0"}
-    client.update(cp)
+    # merge-patch, not read-modify-write: the operator updates CR status
+    # concurrently, so a carried resourceVersion races it into a 409
+    client.patch("tpu.ai/v1", "ClusterPolicy", "cluster-policy",
+                 {"spec": {"driver": {"repository": "gcr.io/tpu",
+                                      "image": "tpu-validator",
+                                      "version": "0.2.0"}}})
 
     def driver_updated():
         ds = client.get("apps/v1", "DaemonSet", "libtpu-driver", "tpu-operator")
@@ -100,7 +119,7 @@ def test_install_verify_update_restart_uninstall(cluster):
     client.create({"apiVersion": "v1", "kind": "Node",
                    "metadata": {"name": "tpu-late", "labels": dict(TPU_LABELS)},
                    "status": {}})
-    cluster["app"] = app2 = OperatorApp(RestClient(base_url=cluster["base"]))
+    cluster["app"] = app2 = OperatorApp(cluster["make_op_client"]())
     app2.start()
     wait_for(lambda: deep_get(client.get("v1", "Node", "tpu-late"), "status",
                               "capacity", consts.TPU_RESOURCE_NAME) == "4",
@@ -108,9 +127,8 @@ def test_install_verify_update_restart_uninstall(cluster):
     wait_for(lambda: policy_state(client) == "ready", message="ready after restart")
 
     # -- disable/enable operand ----------------------------------------------
-    cp = client.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy")
-    cp["spec"]["telemetry"] = {"enabled": False}
-    client.update(cp)
+    client.patch("tpu.ai/v1", "ClusterPolicy", "cluster-policy",
+                 {"spec": {"telemetry": {"enabled": False}}})
 
     def telemetry_gone():
         try:
@@ -124,9 +142,8 @@ def test_install_verify_update_restart_uninstall(cluster):
              (client.get("v1", "Node", "tpu-0")["metadata"].get("labels") or {}),
              message="telemetry deploy label removed")
 
-    cp = client.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy")
-    cp["spec"]["telemetry"] = {"enabled": True}
-    client.update(cp)
+    client.patch("tpu.ai/v1", "ClusterPolicy", "cluster-policy",
+                 {"spec": {"telemetry": {"enabled": True}}})
     wait_for(lambda: not telemetry_gone(), message="telemetry DS recreated")
 
     # -- uninstall: delete CR -> ownerRef GC removes all operands -------------
@@ -219,9 +236,10 @@ def test_tpudriver_e2e_over_wire(cluster):
             return True
         return False
     wait_for(base_ds_gone, message="base driver DS handover cleanup")
-    # update rolls the per-pool DSes
-    live["spec"]["version"] = "2.0"
-    client.update(live)
+    # update rolls the per-pool DSes (merge-patch: the TPUDriver controller
+    # updates status concurrently; a carried rv would race it into a 409)
+    client.patch("tpu.ai/v1alpha1", "TPUDriver", live["metadata"]["name"],
+                 {"spec": {"version": "2.0"}})
 
     def rolled():
         ds = client.get("apps/v1", "DaemonSet",

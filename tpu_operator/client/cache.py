@@ -1,0 +1,338 @@
+"""Informer-backed read cache: controller-runtime's cached client, TPU-side.
+
+The reference never GETs objects from the apiserver in its hot loop — every
+``r.Client.Get/List`` inside a reconcile is served from shared informer
+caches kept fresh by watches (controller-runtime manager cache, wired at
+cmd/gpu-operator/main.go:111-117); only writes hit the wire. Without this,
+a full DAG sweep costs one round-trip per owned object per reconcile —
+at real apiserver latencies that dominates reconcile time and generates
+the exact read-storm controller-runtime exists to prevent.
+
+:class:`CachedClient` wraps any :class:`~.interface.Client`. The first read
+of a (apiVersion, kind, scope) lazily starts an informer: a watch whose
+``relist_handler`` delivers full LIST snapshots (initial sync and every
+410 resync — the replace-boundary is what makes deletions-during-an-outage
+safe; an ADDED-replay can never express that tombstone) and whose event
+stream applies rv-monotonic upserts. Reads are then served locally;
+writes pass through to the inner client and their responses are applied
+back to the cache (write-through), shrinking the staleness window that
+pure controller-runtime accepts.
+
+Staleness contract (same as the reference): a cached read may lag the
+server by one event delivery. Reconcilers already tolerate this — stale
+``resourceVersion`` on a write surfaces as 409 Conflict and the runtime
+requeues; a missed object surfaces as AlreadyExists on create.
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .errors import NotFoundError
+from .fake import match_field_selector, match_label_selector
+from .interface import Client, WatchEvent, WatchHandle
+from .scheme import Scheme, default_scheme
+
+log = logging.getLogger(__name__)
+
+#: how long a read waits for an informer's initial LIST before falling back
+#: to a direct read (a dead watch must degrade to slow, never to wrong)
+SYNC_TIMEOUT_S = 30.0
+
+
+def _rv_int(obj: dict) -> int:
+    try:
+        return int(obj.get("metadata", {}).get("resourceVersion", 0))
+    except (TypeError, ValueError):
+        return -1  # non-numeric rv: treat as unknown → always apply
+
+
+class _Subscription(WatchHandle):
+    """A controller's watch served from a shared informer (controller-runtime
+    shares one informer per kind between the cache and all event sources —
+    a second server-side stream per controller would double watch load).
+    ``namespace`` filters delivery when the subscription is narrower than
+    the informer (a scoped watch served from the all-namespaces superset
+    must not become a cluster-wide firehose)."""
+
+    def __init__(self, informer: "_Informer",
+                 handler: Callable[[WatchEvent], None],
+                 namespace: Optional[str] = None):
+        self._informer = informer
+        self.handler = handler
+        self.namespace = namespace
+        # live events are buffered until the initial snapshot replay is done:
+        # interleaving them could deliver a stale snapshot ADDED *after* the
+        # live DELETED for the same object — an ordering no direct apiserver
+        # watch can produce
+        self.buffering = True
+        self.buffer: List[WatchEvent] = []
+
+    def wants(self, obj: dict) -> bool:
+        if not self.namespace:
+            return True
+        return obj.get("metadata", {}).get("namespace", "") == self.namespace
+
+    def stop(self) -> None:
+        self._informer.unsubscribe(self)
+
+
+class _Informer:
+    """One kind+scope cache: store replaced wholesale on every relist,
+    rv-monotonically upserted per event in between. Subscribers receive the
+    live event stream plus synthetic ADDED replays on (re)sync — the same
+    contract a direct watch gives them."""
+
+    def __init__(self, inner: Client, api_version: str, kind: str,
+                 namespace: Optional[str]):
+        self.api_version = api_version
+        self.kind = kind
+        self.namespace = namespace
+        self._store: Dict[Tuple[str, str], dict] = {}
+        self._lock = threading.Lock()
+        self.synced = threading.Event()
+        self._subscribers: List[_Subscription] = []
+        self._handle = inner.watch(api_version, kind, namespace,
+                                   handler=self._on_event,
+                                   relist_handler=self._on_relist)
+
+    @staticmethod
+    def _key(obj: dict) -> Tuple[str, str]:
+        meta = obj.get("metadata", {})
+        return (meta.get("namespace", ""), meta.get("name", ""))
+
+    def subscribe(self, handler: Callable[[WatchEvent], None],
+                  namespace: Optional[str] = None) -> _Subscription:
+        sub = _Subscription(self, handler, namespace)
+        with self._lock:
+            snapshot = [copy.deepcopy(o) for o in self._store.values()
+                        if sub.wants(o)]
+            self._subscribers.append(sub)
+        # initial replay, like an informer's list-then-watch: level-driven
+        # consumers treat a duplicate ADDED as a no-op reconcile
+        for obj in snapshot:
+            self._deliver(sub, WatchEvent(type="ADDED", object=obj))
+        # drain events that arrived during the replay (they postdate the
+        # snapshot, so replay-then-buffer preserves true order), then go live
+        while True:
+            with self._lock:
+                if not sub.buffer:
+                    sub.buffering = False
+                    break
+                pending, sub.buffer = sub.buffer, []
+            for event in pending:
+                self._deliver(sub, event)
+        return sub
+
+    def unsubscribe(self, sub: _Subscription) -> None:
+        with self._lock:
+            if sub in self._subscribers:
+                self._subscribers.remove(sub)
+
+    @staticmethod
+    def _deliver(sub: _Subscription, event: WatchEvent) -> None:
+        try:
+            sub.handler(event)
+        except Exception:
+            log.exception("informer subscriber failed")
+
+    def _fanout(self, event_type: str, obj: dict) -> None:
+        deliver_now = []
+        with self._lock:
+            for sub in self._subscribers:
+                if not sub.wants(obj):
+                    continue
+                # per-subscriber copy: a mapper mutating its event must
+                # poison neither the cache store nor sibling subscribers
+                event = WatchEvent(type=event_type, object=copy.deepcopy(obj))
+                if sub.buffering:
+                    sub.buffer.append(event)
+                else:
+                    deliver_now.append((sub, event))
+        for sub, event in deliver_now:
+            self._deliver(sub, event)
+
+    def _on_relist(self, items: List[dict], rv: str) -> None:
+        with self._lock:
+            old = self._store
+            self._store = {self._key(o): o for o in items}
+            vanished = [obj for key, obj in old.items()
+                        if key not in self._store]
+        self.synced.set()
+        # controller-runtime Replace semantics: subscribers get ADDED for the
+        # surviving set AND tombstone DELETEDs for objects removed during the
+        # missed-event window — without the tombstones, a deletion that fell
+        # in a watch outage would only ever surface via periodic resync
+        for obj in vanished:
+            self._fanout("DELETED", obj)
+        for item in items:
+            self._fanout("ADDED", item)
+
+    def _on_event(self, event: WatchEvent) -> None:
+        self.apply(event.type, event.object)
+        self._fanout(event.type, event.object)
+
+    def apply(self, event_type: str, obj: dict) -> None:
+        key = self._key(obj)
+        with self._lock:
+            if event_type == "DELETED":
+                self._store.pop(key, None)
+                return
+            current = self._store.get(key)
+            rv = _rv_int(obj)
+            if current is None or rv < 0 or rv >= _rv_int(current):
+                self._store[key] = obj
+
+    def get(self, name: str, namespace: str) -> Optional[dict]:
+        with self._lock:
+            obj = self._store.get((namespace, name))
+            return copy.deepcopy(obj) if obj is not None else None
+
+    def list(self, namespace: Optional[str], label_selector: Optional[dict],
+             field_selector: Optional[dict]) -> List[dict]:
+        out = []
+        with self._lock:
+            for (ns, _), obj in sorted(self._store.items()):
+                if namespace and ns != namespace:
+                    continue
+                if not match_label_selector(
+                        obj.get("metadata", {}).get("labels"), label_selector):
+                    continue
+                if not match_field_selector(obj, field_selector):
+                    continue
+                out.append(copy.deepcopy(obj))
+        return out
+
+    def stop(self) -> None:
+        self._handle.stop()
+
+
+class CachedClient(Client):
+    def __init__(self, inner: Client, scheme: Optional[Scheme] = None):
+        self.inner = inner
+        self.scheme = scheme or getattr(inner, "scheme", None) or default_scheme()
+        self._informers: Dict[Tuple[str, str, Optional[str]], _Informer] = {}
+        self._lock = threading.Lock()
+
+    # -- informer plumbing ---------------------------------------------------
+    def _scope(self, api_version: str, kind: str, namespace: Optional[str],
+               for_name: bool) -> Optional[str]:
+        """Effective watch scope for a read. Named reads on namespaced kinds
+        default to "default" exactly like the URL layout does."""
+        if not self.scheme.is_namespaced(api_version, kind):
+            return None
+        if namespace is None and not for_name:
+            return None  # all-namespaces list
+        return namespace or "default"
+
+    def _informer_for(self, api_version: str, kind: str,
+                      scope: Optional[str]) -> _Informer:
+        with self._lock:
+            # an all-namespaces informer is a superset of every scoped one
+            # (for scope=None the two keys coincide)
+            informer = (self._informers.get((api_version, kind, None))
+                        or self._informers.get((api_version, kind, scope)))
+            if informer is None:
+                informer = _Informer(self.inner, api_version, kind, scope)
+                self._informers[(api_version, kind, scope)] = informer
+        if not informer.synced.wait(SYNC_TIMEOUT_S):
+            log.warning("informer %s/%s scope=%s not synced after %ss",
+                        api_version, kind, scope, SYNC_TIMEOUT_S)
+        return informer
+
+    def _apply_write(self, obj: dict) -> dict:
+        """Write-through: fold a write response into any matching informer."""
+        api_version, kind = obj.get("apiVersion"), obj.get("kind")
+        ns = obj.get("metadata", {}).get("namespace", "")
+        with self._lock:
+            informers = [
+                informer for (av, k, scope), informer in self._informers.items()
+                if av == api_version and k == kind and scope in (None, ns or None)
+            ]
+        for informer in informers:
+            informer.apply("MODIFIED", copy.deepcopy(obj))
+        return obj
+
+    def stop(self) -> None:
+        with self._lock:
+            informers = list(self._informers.values())
+            self._informers.clear()
+        for informer in informers:
+            informer.stop()
+
+    # -- reads (cache) -------------------------------------------------------
+    def get(self, api_version, kind, name, namespace=None) -> dict:
+        scope = self._scope(api_version, kind, namespace, for_name=True)
+        informer = self._informer_for(api_version, kind, scope)
+        if not informer.synced.is_set():
+            return self.inner.get(api_version, kind, name, namespace)
+        obj = informer.get(name, scope or "")
+        if obj is None:
+            raise NotFoundError(f"{kind} {namespace or ''}/{name} not found (cache)")
+        return obj
+
+    def list(self, api_version, kind, namespace=None, label_selector=None,
+             field_selector=None) -> List[dict]:
+        scope = self._scope(api_version, kind, namespace, for_name=False)
+        informer = self._informer_for(api_version, kind, scope)
+        if not informer.synced.is_set():
+            return self.inner.list(api_version, kind, namespace,
+                                   label_selector, field_selector)
+        # a scoped read served from the all-namespaces superset still filters
+        want_ns = namespace if self.scheme.is_namespaced(api_version, kind) else None
+        return informer.list(want_ns, label_selector, field_selector)
+
+    # -- writes (pass through + write-through) -------------------------------
+    def create(self, obj: dict) -> dict:
+        return self._apply_write(self.inner.create(obj))
+
+    def update(self, obj: dict) -> dict:
+        return self._apply_write(self.inner.update(obj))
+
+    def patch(self, api_version, kind, name, patch, namespace=None) -> dict:
+        return self._apply_write(self.inner.patch(api_version, kind, name, patch, namespace))
+
+    def update_status(self, obj: dict) -> dict:
+        return self._apply_write(self.inner.update_status(obj))
+
+    def delete(self, api_version, kind, name, namespace=None) -> None:
+        self.inner.delete(api_version, kind, name, namespace)
+        ns = (namespace or "default") if self.scheme.is_namespaced(api_version, kind) else ""
+        self._apply_delete(api_version, kind, name, ns)
+
+    def _apply_delete(self, api_version: str, kind: str, name: str, ns: str) -> None:
+        with self._lock:
+            informers = [
+                informer for (av, k, scope), informer in self._informers.items()
+                if av == api_version and k == kind and scope in (None, ns or None)
+            ]
+        for informer in informers:
+            informer.apply("DELETED", {"metadata": {"namespace": ns, "name": name}})
+
+    def evict(self, name: str, namespace: Optional[str] = None) -> None:
+        # no optimistic remove: eviction starts graceful termination — the
+        # pod lingers Terminating and the DELETED event arrives when real
+        self.inner.evict(name, namespace)
+
+    # -- watches (shared informers) ------------------------------------------
+    def watch(self, api_version, kind, namespace=None, handler=None,
+              relist_handler=None) -> WatchHandle:
+        """Handler watches are served from the shared informer for the kind —
+        one server-side stream feeds the cache and every controller (the
+        controller-runtime shared-informer model). Raw handles (no handler)
+        and external cache consumers (relist_handler) pass through."""
+        if relist_handler is not None or handler is None:
+            return self.inner.watch(api_version, kind, namespace, handler,
+                                    relist_handler=relist_handler)
+        scope = self._scope(api_version, kind, namespace, for_name=False)
+        informer = self._informer_for(api_version, kind, scope)
+        # the informer may be the all-namespaces superset: keep the
+        # subscription filtered to what the caller actually asked for
+        want_ns = namespace if self.scheme.is_namespaced(api_version, kind) else None
+        return informer.subscribe(handler, namespace=want_ns)
+
+    def server_version(self) -> str:
+        return self.inner.server_version()

@@ -372,8 +372,15 @@ class FakeClient(Client):
         return "v1.31.0-fake"
 
     # -- watches -------------------------------------------------------------
-    def watch(self, api_version, kind, namespace=None, handler=None) -> WatchHandle:
+    def watch(self, api_version, kind, namespace=None, handler=None,
+              relist_handler=None) -> WatchHandle:
+        """``relist_handler(items, rv)``, when given, is called once with an
+        initial snapshot taken atomically with the watch registration (same
+        lock as every write) — cache consumers get a gap-free sync: no event
+        can land between the snapshot and the stream start."""
         with self._lock:
             w = _FakeWatch(self, (api_version, kind, namespace or ""), handler)
             self._watches.append(w)
+            if relist_handler is not None:
+                relist_handler(self.list(api_version, kind, namespace), str(self._rv))
             return w

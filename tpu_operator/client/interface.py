@@ -69,8 +69,16 @@ class Client:
         kind: str,
         namespace: Optional[str] = None,
         handler: Optional[Callable[[WatchEvent], None]] = None,
+        relist_handler: Optional[Callable[[List[dict], str], None]] = None,
     ) -> "WatchHandle":
-        """Subscribe to change events. Returns a handle with .stop()."""
+        """Subscribe to change events. Returns a handle with .stop().
+
+        ``relist_handler(items, rv)``, when given, receives each full LIST
+        snapshot (initial sync and every resync after a lost resume point)
+        INSTEAD of per-item synthetic ADDED events — cache consumers need
+        the replace-boundary to expire entries deleted during a
+        missed-event window. Implementations must accept the kwarg; ones
+        with gap-free streams may call it exactly once at registration."""
         raise NotImplementedError
 
 

@@ -184,8 +184,14 @@ class RestClient(Client):
         return resp.json().get("gitVersion", "unknown")
 
     # -- watch ---------------------------------------------------------------
-    def watch(self, api_version, kind, namespace=None, handler=None) -> WatchHandle:
-        return _RestWatch(self, api_version, kind, namespace, handler)
+    def watch(self, api_version, kind, namespace=None, handler=None,
+              relist_handler=None) -> WatchHandle:
+        """``relist_handler(items, rv)``, when given, receives each full LIST
+        snapshot (initial sync and every 410 resync) INSTEAD of per-item
+        synthetic ADDED events — cache consumers need the replace-boundary to
+        drop entries deleted during a missed-event window (a tombstone an
+        ADDED-replay can never express)."""
+        return _RestWatch(self, api_version, kind, namespace, handler, relist_handler)
 
 
 class _RestWatch(WatchHandle):
@@ -199,12 +205,14 @@ class _RestWatch(WatchHandle):
     """
 
     def __init__(self, client: RestClient, api_version: str, kind: str,
-                 namespace: Optional[str], handler: Optional[Callable[[WatchEvent], None]]):
+                 namespace: Optional[str], handler: Optional[Callable[[WatchEvent], None]],
+                 relist_handler: Optional[Callable[[List[dict], str], None]] = None):
         self._client = client
         self._api_version = api_version
         self._kind = kind
         self._namespace = namespace
         self._handler = handler
+        self._relist_handler = relist_handler
         self._stopped = threading.Event()
         self._queue: "queue.Queue[WatchEvent]" = queue.Queue()
         self._thread = threading.Thread(target=self._run, daemon=True)
@@ -218,14 +226,20 @@ class _RestWatch(WatchHandle):
 
     def _relist(self) -> str:
         body = self._client._list_body(self._api_version, self._kind, self._namespace)
+        items = body.get("items", [])
         rv = ""
-        for item in body.get("items", []):
+        for item in items:
             rv = item.get("metadata", {}).get("resourceVersion", rv)
-            self._emit(WatchEvent(type="ADDED", object=item))
         # resume from the List ENVELOPE rv: item rvs only say when each item
         # last changed — resuming from the newest item replays (or, on a
         # strict server, 410s over) every other kind's interleaved writes
-        return body.get("metadata", {}).get("resourceVersion") or rv
+        rv = body.get("metadata", {}).get("resourceVersion") or rv
+        if self._relist_handler is not None:
+            self._relist_handler(items, rv)
+        else:
+            for item in items:
+                self._emit(WatchEvent(type="ADDED", object=item))
+        return rv
 
     def _run(self) -> None:
         url = self._client.resource_url(self._api_version, self._kind, self._namespace)

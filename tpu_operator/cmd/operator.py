@@ -20,6 +20,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--log-level", default="info", choices=["debug", "info", "warning", "error"])
     p.add_argument("--leader-elect", action="store_true",
                    help="enable Lease-based leader election (multi-replica deployments)")
+    p.add_argument("--no-cache-reads", dest="cache_reads", action="store_false",
+                   help="serve reconcile reads directly from the apiserver "
+                        "instead of informer caches (debugging escape hatch)")
     p.add_argument("--version", action="version", version=f"tpu-operator {__version__}")
     return p
 

@@ -131,6 +131,12 @@ def run_operator(args) -> int:
     log.info("tpu-operator %s starting", __version__)
 
     client = RestClient(base_url=args.api_server, token=args.token)
+    if getattr(args, "cache_reads", True):
+        # reconcile reads come from informer caches, as in controller-runtime
+        # (the reference never GETs in its hot loop; main.go:111-117) —
+        # writes still hit the apiserver directly
+        from ..client.cache import CachedClient
+        client = CachedClient(client)
     app = OperatorApp(client, namespace=args.namespace,
                       metrics_port=args.metrics_port, health_port=args.health_port)
 
@@ -164,4 +170,6 @@ def run_operator(args) -> int:
     if elector is not None:
         elector.release()
     app.stop()
+    if hasattr(client, "stop"):
+        client.stop()  # CachedClient: shut down informer watches
     return exit_code[0]

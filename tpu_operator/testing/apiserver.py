@@ -81,6 +81,10 @@ class MiniApiServer:
         # how long an event-less watch stream stays open before the server
         # closes it — real apiservers do this on a timer; clients must resume
         self.watch_idle_timeout_s = watch_idle_timeout_s
+        #: total HTTP requests served — read-amplification accounting for
+        #: tests and the control-plane bench
+        self.request_count = 0
+        self._count_lock = threading.Lock()
         self._router = _Router(self.scheme)
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -100,6 +104,13 @@ class MiniApiServer:
                 if server.latency_s > 0:
                     time.sleep(server.latency_s)
                 super().handle_one_request()
+
+            def parse_request(self):
+                ok = super().parse_request()
+                if ok:  # count real parsed requests, not keep-alive EOF polls
+                    with server._count_lock:
+                        server.request_count += 1
+                return ok
 
             def _body(self) -> dict:
                 length = int(self.headers.get("Content-Length", 0))
