@@ -73,10 +73,10 @@ def bench_control_plane(n_nodes: int = 4, timeout: float = 115.0,
     app.start()
     kubelet.start()
 
-    # only the operator's traffic is the measurement: the seed client and
-    # kubelet share the server, so count from a dedicated baseline captured
-    # while they are the only talkers and subtract their steady-state share
-    # — simpler and honest: report TOTAL requests over the run, labeled so.
+    # request accounting reports the TOTAL over the run — operator, kubelet
+    # sim, and bench poller combined (the bench JSON labels it so): isolating
+    # the operator's share isn't attempted; the cached-vs-direct DELTA under
+    # identical co-traffic is the meaningful number
     t_req0 = srv.request_count
     try:
         t0 = time.monotonic()
@@ -99,8 +99,7 @@ def bench_control_plane(n_nodes: int = 4, timeout: float = 115.0,
         return None, srv.request_count - t_req0
     finally:
         app.stop()
-        if hasattr(op_client, "stop"):
-            op_client.stop()
+        op_client.stop()
         kubelet.stop()
         srv.stop()
 

@@ -175,6 +175,61 @@ def test_shared_informer_one_stream_many_watchers():
         srv.stop()
 
 
+def test_superset_informer_retires_subscriberless_scoped_ones():
+    """Once an all-namespaces informer exists, scoped informers without
+    subscribers must be stopped — not hold watch streams until process
+    exit — while scoped informers WITH subscribers keep serving them."""
+    backend = FakeClient()
+    backend.create(_pod("a", ns="ns1"))
+    backend.create(_pod("b", ns="ns2"))
+    cached = CachedClient(backend)
+    cached.list("v1", "Pod", "ns1")          # scoped informer, no subscribers
+    events = []
+    cached.watch("v1", "Pod", "ns2", handler=events.append)  # scoped + subscriber
+    assert len(cached._informers) == 2
+    cached.list("v1", "Pod")                 # superset: retires ns1, keeps ns2
+    keys = set(cached._informers)
+    assert ("v1", "Pod", None) in keys
+    assert ("v1", "Pod", "ns1") not in keys
+    assert ("v1", "Pod", "ns2") in keys
+    # the surviving subscription still gets events
+    backend.create(_pod("c", ns="ns2"))
+    assert _wait_for(lambda: any(
+        e.object["metadata"]["name"] == "c" for e in events))
+    # reads for ns1 now come from the superset
+    assert [p["metadata"]["name"]
+            for p in cached.list("v1", "Pod", "ns1")] == ["a"]
+
+
+def test_unsyncable_informer_degrades_to_direct_reads():
+    """A watch that can never sync (unserved kind, RBAC-denied LIST) must
+    cost the sync timeout once, then degrade to per-call direct reads."""
+    from tpu_operator.client import cache as cache_mod
+
+    class NeverSyncs(FakeClient):
+        def watch(self, api_version, kind, namespace=None, handler=None,
+                  relist_handler=None):
+            # stream registers but the relist snapshot never arrives
+            return super().watch(api_version, kind, namespace, handler)
+
+    backend = NeverSyncs()
+    backend.create(_node("n1"))
+    cached = CachedClient(backend)
+    old = cache_mod.SYNC_TIMEOUT_S
+    cache_mod.SYNC_TIMEOUT_S = 0.3
+    try:
+        t0 = time.monotonic()
+        assert cached.get("v1", "Node", "n1")["metadata"]["name"] == "n1"
+        first = time.monotonic() - t0
+        assert first >= 0.3  # paid the timeout once
+        t0 = time.monotonic()
+        for _ in range(5):
+            assert cached.get("v1", "Node", "n1")
+        assert time.monotonic() - t0 < 0.3 * 5  # degraded: no 30s-per-read wedge
+    finally:
+        cache_mod.SYNC_TIMEOUT_S = old
+
+
 def test_scoped_watch_from_superset_informer_is_filtered():
     """A namespaced watch routed onto the all-namespaces superset informer
     must not become a cluster-wide firehose."""
@@ -194,6 +249,67 @@ def test_scoped_watch_from_superset_informer_is_filtered():
     names = {e.object["metadata"]["name"] for e in events}
     assert "pre-ns2" not in names and "live-ns2" not in names
     handle.stop()
+
+
+def test_no_deadlock_mapper_reads_during_event_delivery():
+    """Lock-order regression: FakeClient delivers events inline under its
+    lock, and controller mappers perform cached reads from inside that
+    delivery (clusterpolicy_controller._all_policy_requests). Concurrent
+    first-reads create informers, which call inner.watch(). Holding the
+    CachedClient lock across inner.watch() deadlocks these two paths AB-BA;
+    this test drives both sides hard and must finish, not wedge."""
+    import threading
+
+    backend = FakeClient()
+    cached = CachedClient(backend)
+
+    def mapper(event):
+        # a read from inside event delivery (mapper-style), on a kind whose
+        # informer may not exist yet -> informer creation on this path too
+        cached.list("v1", "ConfigMap", "default")
+        cached.list("v1", "Pod", "default")
+
+    cached.watch("v1", "Pod", "default", handler=mapper)
+
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        i = 0
+        while not stop.is_set() and i < 200:
+            try:
+                backend.create(_pod(f"w{i}"))
+            except Exception as e:  # pragma: no cover - diagnostics only
+                errors.append(e)
+                return
+            i += 1
+
+    def reader():
+        # concurrent first-reads of fresh kinds force informer creation
+        # (CachedClient lock -> inner.watch) racing the writer's deliveries
+        for kind in ("Node", "Service", "Event", "ServiceAccount",
+                     "DaemonSet", "Lease"):
+            try:
+                if kind == "DaemonSet":
+                    cached.list("apps/v1", kind, "default")
+                elif kind == "Lease":
+                    cached.list("coordination.k8s.io/v1", kind, "default")
+                else:
+                    cached.list("v1", kind)
+            except Exception as e:  # pragma: no cover - diagnostics only
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=writer, daemon=True),
+               threading.Thread(target=reader, daemon=True)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    alive = [t for t in threads if t.is_alive()]
+    stop.set()
+    assert not alive, "deadlock: writer/reader wedged against informer creation"
+    assert not errors, errors
 
 
 # -- RestClient backend over the wire ----------------------------------------

@@ -54,10 +54,9 @@ def cluster(request):
     yield state
     state["app"].stop()
     for op in op_clients:  # incl. restart-scenario clients: informer threads
-        if hasattr(op, "stop"):  # must not outlive the server they watch
-            op.stop()
+        op.stop()          # must not outlive the server they watch
     kubelet.stop()
-    srv.stop()
+    state["srv"].stop()  # outage tests may have swapped in a new server
 
 
 def wait_for(predicate, timeout=45.0, interval=0.05, message="condition"):
@@ -172,6 +171,52 @@ def test_manual_operand_deletion_self_heals(cluster):
         return ds.get("status", {}).get("numberAvailable", 0) == 1
     wait_for(recreated, message="device-plugin DS self-healed")
     wait_for(lambda: policy_state(client) == "ready", message="ready again")
+
+
+def test_apiserver_outage_recovery(cluster):
+    """Full control-plane outage mid-flight: the apiserver dies and comes
+    back on the same endpoint, and the cluster state CHANGES while it is
+    down (a node joins; an operand DS is deleted out from under the
+    operator). Watches must reconnect, resume points must expire into
+    410-driven resyncs, and the operator must converge without a restart —
+    the whole reflector/cache stack end to end."""
+    client, app = cluster["client"], cluster["app"]
+    client.create({"apiVersion": "v1", "kind": "Node",
+                   "metadata": {"name": "tpu-0", "labels": dict(TPU_LABELS)},
+                   "status": {}})
+    client.create(new_cluster_policy())
+    app.start()
+    wait_for(lambda: policy_state(client) == "ready", message="install ready")
+
+    port = int(cluster["base"].rsplit(":", 1)[1])
+    backend = cluster["srv"].backend
+    cluster["srv"].stop()
+    time.sleep(0.5)  # let watches + kubelet hit the dead endpoint
+    # mutate "etcd" while the apiserver is down
+    backend.create({"apiVersion": "v1", "kind": "Node",
+                    "metadata": {"name": "tpu-joined-in-outage",
+                                 "labels": dict(TPU_LABELS)},
+                    "status": {}})
+    backend.delete("apps/v1", "DaemonSet", "tpu-device-plugin", "tpu-operator")
+
+    from tpu_operator.testing import MiniApiServer
+    srv2 = MiniApiServer(backend=backend)
+    srv2.start(port)
+    cluster["srv"] = srv2
+
+    def node_schedulable():
+        return deep_get(client.get("v1", "Node", "tpu-joined-in-outage"),
+                        "status", "capacity", consts.TPU_RESOURCE_NAME) == "4"
+    wait_for(node_schedulable, message="outage-joined node schedulable")
+
+    def plugin_healed():
+        try:
+            ds = client.get("apps/v1", "DaemonSet", "tpu-device-plugin", "tpu-operator")
+        except NotFoundError:
+            return False
+        return ds.get("status", {}).get("numberAvailable", 0) == 2
+    wait_for(plugin_healed, message="DS deleted during outage recreated")
+    wait_for(lambda: policy_state(client) == "ready", message="ready after outage")
 
 
 def test_multihost_slice_validation_e2e(cluster):

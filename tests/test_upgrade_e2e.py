@@ -51,7 +51,11 @@ def driver_pod_images(client):
                 label_selector={"app.kubernetes.io/component": "tpu-driver"})}
 
 
-def test_rolling_upgrade_end_to_end():
+@pytest.mark.parametrize("mode", ["direct", "cached"])
+def test_rolling_upgrade_end_to_end(mode):
+    """Also run behind the informer cache: the upgrade machine's drain does
+    cluster-wide pod sweeps and per-node read-modify-write loops — the
+    hardest consumer of the cache's staleness contract."""
     client = FakeClient()
     for i in range(2):
         client.create({"apiVersion": "v1", "kind": "Node",
@@ -63,13 +67,17 @@ def test_rolling_upgrade_end_to_end():
                    "upgradePolicy": {"autoUpgrade": True, "maxParallelUpgrades": 1}},
     }))
 
+    ctl = client
+    if mode == "cached":
+        from tpu_operator.client.cache import CachedClient
+        ctl = CachedClient(client)
     cp = setup_clusterpolicy_controller(
-        client, ClusterPolicyReconciler(client, requeue_after=0.1))
+        ctl, ClusterPolicyReconciler(ctl, requeue_after=0.1))
     up = setup_upgrade_controller(
-        client, UpgradeReconciler(client, requeue_after=0.1))
+        ctl, UpgradeReconciler(ctl, requeue_after=0.1))
     kubelet = KubeletSimulator(client, interval=0.03, create_pods=True).start()
-    cp.start(client)
-    up.start(client)
+    cp.start(ctl)
+    up.start(ctl)
     from tpu_operator.controllers.runtime import Request
     cp.queue.add(Request(name="cluster-policy"))
     try:
@@ -80,10 +88,10 @@ def test_rolling_upgrade_end_to_end():
         ds = client.get("apps/v1", "DaemonSet", "libtpu-driver", "tpu-operator")
         assert ds["spec"]["updateStrategy"]["type"] == "OnDelete"
 
-        # bump the driver version -> upgrade machine takes over
-        live = client.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy")
-        live["spec"]["driver"]["version"] = "2.0"
-        client.update(live)
+        # bump the driver version -> upgrade machine takes over (merge-patch:
+        # read-modify-write races the controllers' status updates into 409s)
+        client.patch("tpu.ai/v1", "ClusterPolicy", "cluster-policy",
+                     {"spec": {"driver": {"version": "2.0"}}})
 
         wait_for(lambda: set(driver_pod_images(client).values())
                  == {"gcr.io/tpu/tpu-validator:2.0"},
@@ -100,3 +108,4 @@ def test_rolling_upgrade_end_to_end():
         cp.stop()
         up.stop()
         kubelet.stop()
+        ctl.stop()

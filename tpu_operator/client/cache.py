@@ -94,10 +94,17 @@ class _Informer:
         self._store: Dict[Tuple[str, str], dict] = {}
         self._lock = threading.Lock()
         self.synced = threading.Event()
+        #: set after a full sync-timeout expired once: later reads stop
+        #: paying the timeout and degrade to direct reads immediately
+        self.sync_wait_failed = False
         self._subscribers: List[_Subscription] = []
         self._handle = inner.watch(api_version, kind, namespace,
                                    handler=self._on_event,
                                    relist_handler=self._on_relist)
+
+    def has_subscribers(self) -> bool:
+        with self._lock:
+            return bool(self._subscribers)
 
     @staticmethod
     def _key(obj: dict) -> Tuple[str, str]:
@@ -229,30 +236,75 @@ class CachedClient(Client):
         return namespace or "default"
 
     def _informer_for(self, api_version: str, kind: str,
-                      scope: Optional[str]) -> _Informer:
+                      scope: Optional[str], wait_sync: bool = True) -> _Informer:
+        # LOCK ORDER INVARIANT: self._lock is never held while calling into
+        # the inner client (watch/stop). FakeClient delivers watch events
+        # inline under ITS lock, and a controller mapper handling such an
+        # event may perform a cached read (wants self._lock) — holding
+        # self._lock across inner.watch()/handle.stop() closes an AB-BA
+        # deadlock cycle with that path.
         with self._lock:
-            # an all-namespaces informer is a superset of every scoped one
-            # (for scope=None the two keys coincide)
             informer = (self._informers.get((api_version, kind, None))
                         or self._informers.get((api_version, kind, scope)))
-            if informer is None:
-                informer = _Informer(self.inner, api_version, kind, scope)
-                self._informers[(api_version, kind, scope)] = informer
-        if not informer.synced.wait(SYNC_TIMEOUT_S):
-            log.warning("informer %s/%s scope=%s not synced after %ss",
-                        api_version, kind, scope, SYNC_TIMEOUT_S)
+        if informer is None:
+            candidate = _Informer(self.inner, api_version, kind, scope)
+            doomed: List[_Informer] = []
+            with self._lock:
+                informer = (self._informers.get((api_version, kind, None))
+                            or self._informers.get((api_version, kind, scope)))
+                if informer is None:
+                    informer = candidate
+                    self._informers[(api_version, kind, scope)] = candidate
+                    if scope is None:
+                        doomed = self._collect_superseded_locked(api_version, kind)
+                else:
+                    doomed = [candidate]  # lost the creation race
+            for stale in doomed:
+                stale.stop()
+        if wait_sync and not informer.synced.is_set():
+            # pay the full sync timeout once; a watch that cannot sync
+            # (RBAC-denied LIST, unserved kind) must degrade to direct
+            # reads per call, not wedge every read for 30 s forever
+            timeout = 0.05 if informer.sync_wait_failed else SYNC_TIMEOUT_S
+            if not informer.synced.wait(timeout) and not informer.sync_wait_failed:
+                informer.sync_wait_failed = True
+                log.warning("informer %s/%s scope=%s not synced after %ss; "
+                            "degrading to direct reads until it recovers",
+                            api_version, kind, scope, SYNC_TIMEOUT_S)
         return informer
 
-    def _apply_write(self, obj: dict) -> dict:
-        """Write-through: fold a write response into any matching informer."""
-        api_version, kind = obj.get("apiVersion"), obj.get("kind")
-        ns = obj.get("metadata", {}).get("namespace", "")
+    def _collect_superseded_locked(self, api_version: str,
+                                   kind: str) -> List[_Informer]:
+        """A new all-namespaces informer supersedes scoped ones for the kind:
+        unregister any without subscribers (reads route to the superset from
+        now on) so their server-side watch streams don't live until process
+        exit — the watch multiplication shared informers exist to prevent.
+        Scoped informers WITH subscribers stay: their subscriptions hold the
+        stream. Returns the informers to stop OUTSIDE the lock."""
+        doomed = []
+        for key, informer in list(self._informers.items()):
+            av, k, scope = key
+            if av == api_version and k == kind and scope is not None \
+                    and not informer.has_subscribers():
+                del self._informers[key]
+                doomed.append(informer)
+        return doomed
+
+    def _matching_informers(self, api_version: str, kind: str,
+                            ns: str) -> List[_Informer]:
+        """Informers that cover an object of this kind in this namespace:
+        the all-namespaces superset plus the exact scope."""
         with self._lock:
-            informers = [
+            return [
                 informer for (av, k, scope), informer in self._informers.items()
                 if av == api_version and k == kind and scope in (None, ns or None)
             ]
-        for informer in informers:
+
+    def _apply_write(self, obj: dict) -> dict:
+        """Write-through: fold a write response into any matching informer."""
+        ns = obj.get("metadata", {}).get("namespace", "")
+        for informer in self._matching_informers(obj.get("apiVersion"),
+                                                 obj.get("kind"), ns):
             informer.apply("MODIFIED", copy.deepcopy(obj))
         return obj
 
@@ -304,12 +356,7 @@ class CachedClient(Client):
         self._apply_delete(api_version, kind, name, ns)
 
     def _apply_delete(self, api_version: str, kind: str, name: str, ns: str) -> None:
-        with self._lock:
-            informers = [
-                informer for (av, k, scope), informer in self._informers.items()
-                if av == api_version and k == kind and scope in (None, ns or None)
-            ]
-        for informer in informers:
+        for informer in self._matching_informers(api_version, kind, ns):
             informer.apply("DELETED", {"metadata": {"namespace": ns, "name": name}})
 
     def evict(self, name: str, namespace: Optional[str] = None) -> None:
@@ -328,11 +375,24 @@ class CachedClient(Client):
             return self.inner.watch(api_version, kind, namespace, handler,
                                     relist_handler=relist_handler)
         scope = self._scope(api_version, kind, namespace, for_name=False)
-        informer = self._informer_for(api_version, kind, scope)
         # the informer may be the all-namespaces superset: keep the
         # subscription filtered to what the caller actually asked for
         want_ns = namespace if self.scheme.is_namespaced(api_version, kind) else None
-        return informer.subscribe(handler, namespace=want_ns)
+        while True:
+            # no sync wait: a subscriber to an unsynced informer receives the
+            # ADDED fanout when the initial relist lands, so blocking here
+            # only stalls controller start — which, under --leader-elect,
+            # runs inline in the lease renew loop where a 30 s wait per
+            # unsyncable kind would forfeit leadership mid-start
+            informer = self._informer_for(api_version, kind, scope,
+                                          wait_sync=False)
+            sub = informer.subscribe(handler, namespace=want_ns)
+            with self._lock:
+                if any(i is informer for i in self._informers.values()):
+                    return sub
+            # a concurrent superset creation retired this scoped informer
+            # between resolve and subscribe; re-resolve onto the superset
+            sub.stop()
 
     def server_version(self) -> str:
         return self.inner.server_version()

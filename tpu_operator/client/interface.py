@@ -20,7 +20,12 @@ class WatchEvent:
 
 
 class Client:
-    """Abstract k8s API client. Implementations: FakeClient, RestClient."""
+    """Abstract k8s API client. Implementations: FakeClient, RestClient,
+    CachedClient."""
+
+    def stop(self) -> None:
+        """Release background resources (informer watches, streams). No-op
+        for stateless clients; callers can invoke unconditionally."""
 
     # -- reads ---------------------------------------------------------------
     def get(self, api_version: str, kind: str, name: str, namespace: Optional[str] = None) -> dict:

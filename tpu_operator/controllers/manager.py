@@ -130,13 +130,14 @@ def run_operator(args) -> int:
         format="%(asctime)s %(levelname)s %(name)s: %(message)s")
     log.info("tpu-operator %s starting", __version__)
 
-    client = RestClient(base_url=args.api_server, token=args.token)
+    direct_client = RestClient(base_url=args.api_server, token=args.token)
+    client = direct_client
     if getattr(args, "cache_reads", True):
         # reconcile reads come from informer caches, as in controller-runtime
         # (the reference never GETs in its hot loop; main.go:111-117) —
         # writes still hit the apiserver directly
         from ..client.cache import CachedClient
-        client = CachedClient(client)
+        client = CachedClient(direct_client)
     app = OperatorApp(client, namespace=args.namespace,
                       metrics_port=args.metrics_port, health_port=args.health_port)
 
@@ -158,7 +159,10 @@ def run_operator(args) -> int:
             exit_code[0] = 1
             stop.set()
 
-        elector = LeaderElector(client, app.clusterpolicy_reconciler.namespace)
+        # leases bypass the cache (controller-runtime does the same): leader
+        # election is correctness-critical and tiny — a Lease informer would
+        # add a watch stream to save nothing
+        elector = LeaderElector(direct_client, app.clusterpolicy_reconciler.namespace)
         elector.run(on_started=app.start, on_stopped=on_lost)
         log.info("leader election enabled; waiting for leadership as %s", elector.identity)
     else:
@@ -170,6 +174,5 @@ def run_operator(args) -> int:
     if elector is not None:
         elector.release()
     app.stop()
-    if hasattr(client, "stop"):
-        client.stop()  # CachedClient: shut down informer watches
+    client.stop()  # CachedClient: shut down informer watches
     return exit_code[0]

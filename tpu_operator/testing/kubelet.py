@@ -18,6 +18,8 @@ import logging
 import threading
 from typing import Optional
 
+import requests
+
 from .. import consts
 from ..client.errors import ApiError
 from ..client.interface import Client
@@ -58,7 +60,9 @@ class KubeletSimulator:
         while not self._stop.wait(self.interval):
             try:
                 self.tick()
-            except ApiError as e:
+            except (ApiError, requests.RequestException) as e:
+                # a real kubelet rides out apiserver outages; transport
+                # errors must not kill the loop mid-test
                 log.debug("kubelet sim tick error: %s", e)
 
     # one scheduling pass; public so tests can drive it deterministically
