@@ -106,6 +106,17 @@ class _Informer:
         with self._lock:
             return bool(self._subscribers)
 
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "apiVersion": self.api_version, "kind": self.kind,
+                "scope": self.namespace or "all-namespaces",
+                "synced": self.synced.is_set(),
+                "degraded": self.sync_wait_failed and not self.synced.is_set(),
+                "objects": len(self._store),
+                "subscribers": len(self._subscribers),
+            }
+
     @staticmethod
     def _key(obj: dict) -> Tuple[str, str]:
         meta = obj.get("metadata", {})
@@ -396,3 +407,11 @@ class CachedClient(Client):
 
     def server_version(self) -> str:
         return self.inner.server_version()
+
+    # -- introspection -------------------------------------------------------
+    def stats(self) -> List[dict]:
+        """Cache state for the /debug/informers endpoint: one row per
+        informer with scope, sync state, and cached object count."""
+        with self._lock:
+            informers = list(self._informers.values())
+        return [informer.stats() for informer in informers]

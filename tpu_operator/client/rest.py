@@ -64,6 +64,9 @@ class RestClient(Client):
         if token:
             self._session.headers["Authorization"] = f"Bearer {token}"
         self._session.verify = verify if verify is not None else True
+        #: optional telemetry hook called (method, status_code) per response
+        #: (client-go's rest_client_requests_total analog)
+        self.on_response: Optional[Callable[[str, int], None]] = None
 
     # -- url building --------------------------------------------------------
     def resource_url(self, api_version: str, kind: str, namespace: Optional[str] = None,
@@ -95,6 +98,11 @@ class RestClient(Client):
         return ",".join(terms)
 
     def _raise_for(self, resp: requests.Response) -> None:
+        if self.on_response is not None:
+            try:
+                self.on_response(resp.request.method or "?", resp.status_code)
+            except Exception:  # telemetry must never break the request path
+                pass
         if resp.status_code < 400:
             return
         try:
@@ -254,6 +262,14 @@ class _RestWatch(WatchHandle):
                 expired = False
                 error_code = None
                 with self._client._session.get(url, params=params, stream=True, timeout=330) as resp:
+                    if self._client.on_response is not None:
+                        # watch connects (incl. 410 rejections / relist
+                        # storms) must show up in rest_client_requests_total
+                        # — they bypass _raise_for by design
+                        try:
+                            self._client.on_response("WATCH", resp.status_code)
+                        except Exception:
+                            pass
                     if resp.status_code >= 400:
                         # any rejected watch connect falls back to relist: the
                         # rv itself may be what the server objects to (410

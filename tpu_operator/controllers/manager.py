@@ -23,7 +23,7 @@ log = logging.getLogger(__name__)
 
 
 def serve_health_and_metrics(metrics: OperatorMetrics, metrics_port: int,
-                             health_port: int):
+                             health_port: int, client=None):
     servers = []
 
     class MetricsHandler(BaseHTTPRequestHandler):
@@ -48,6 +48,16 @@ def serve_health_and_metrics(metrics: OperatorMetrics, metrics_port: int,
 
         def do_GET(self):
             path = self.path.rstrip("/")
+            if path == "/debug/informers":
+                # cache introspection: which kinds are cached, synced, sizes
+                stats = client.stats() if hasattr(client, "stats") else []
+                body = json.dumps(stats, indent=1).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
             if path == "/debug/threads":
                 # pprof-style goroutine-dump analog for the threaded runtime
                 import sys
@@ -106,13 +116,20 @@ class OperatorApp:
                                                     metrics=self.metrics)
         self.upgrade_controller = self.manager.add(
             setup_upgrade_controller(client, self.upgrade_reconciler))
+        for controller in self.manager.controllers:
+            controller.instrument(self.metrics)
+        # rest_client_requests_total rides the innermost RestClient (the
+        # cache wrapper forwards reads it serves itself, which is the point)
+        rest = getattr(client, "inner", client)
+        if hasattr(rest, "on_response"):
+            rest.on_response = self.metrics.observe_rest_response
         self._metrics_port = metrics_port
         self._health_port = health_port
         self._servers: list = []
 
     def start(self) -> None:
         self._servers = serve_health_and_metrics(
-            self.metrics, self._metrics_port, self._health_port)
+            self.metrics, self._metrics_port, self._health_port, self.client)
         self.manager.start()
         # kick an initial reconcile even if no watch event ever fires
         for policy in self.client.list("tpu.ai/v1", "ClusterPolicy"):

@@ -1,12 +1,22 @@
 """Operator Prometheus metrics (reference: controllers/operator_metrics.go:29-201).
 
-Same metric vocabulary, ``gpu`` -> ``tpu``. Registered on a dedicated
-registry so tests can scrape without global-state collisions.
+Same metric vocabulary, ``gpu`` -> ``tpu``, plus the workqueue and REST
+traffic families the reference inherits from controller-runtime/client-go
+(workqueue_depth, workqueue_adds_total, rest_client_requests_total, …) —
+our runtime owns the queue and client, so it must own their telemetry too.
+Registered on a dedicated registry so tests can scrape without
+global-state collisions.
 """
 
 from __future__ import annotations
 
-from prometheus_client import CollectorRegistry, Counter, Gauge, generate_latest
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
 
 
 class OperatorMetrics:
@@ -46,6 +56,42 @@ class OperatorMetrics:
         self.upgrades_available = Gauge(
             "tpu_operator_nodes_upgrades_available",
             "Nodes available for driver upgrade", registry=self.registry)
+
+        # controller-runtime/client-go equivalents (workqueue + rest client)
+        self.workqueue_depth = Gauge(
+            "tpu_operator_workqueue_depth",
+            "Current number of pending requests in a controller workqueue",
+            ["name"], registry=self.registry)
+        self.workqueue_adds = Counter(
+            "tpu_operator_workqueue_adds_total",
+            "Total requests enqueued to a controller workqueue",
+            ["name"], registry=self.registry)
+        self.workqueue_retries = Counter(
+            "tpu_operator_workqueue_retries_total",
+            "Total rate-limited (backoff) re-enqueues",
+            ["name"], registry=self.registry)
+        self.workqueue_queue_duration = Histogram(
+            "tpu_operator_workqueue_queue_duration_seconds",
+            "Time a request waited in the queue before being picked up",
+            ["name"], registry=self.registry,
+            buckets=(.001, .01, .1, 1, 5, 10, 60))
+        self.reconcile_duration = Histogram(
+            "tpu_operator_reconcile_duration_seconds",
+            "Wall-clock duration of a single reconcile call",
+            ["name"], registry=self.registry,
+            buckets=(.001, .01, .1, 1, 5, 10, 60))
+        self.reconcile_errors = Counter(
+            "tpu_operator_reconcile_errors_total",
+            "Reconcile calls that raised (and were requeued with backoff)",
+            ["name"], registry=self.registry)
+        self.rest_requests = Counter(
+            "tpu_operator_rest_client_requests_total",
+            "HTTP requests issued to the apiserver, by method and code",
+            ["method", "code"], registry=self.registry)
+
+    def observe_rest_response(self, method: str, code: int) -> None:
+        """RestClient.on_response hook target."""
+        self.rest_requests.labels(method=method, code=str(code)).inc()
 
     def scrape(self) -> bytes:
         return generate_latest(self.registry)

@@ -52,6 +52,13 @@ class RateLimitingQueue:
         self._failures: Dict[Request, int] = {}
         self._seq = 0
         self._shutdown = False
+        self._metrics = None  # OperatorMetrics, set via instrument()
+        self._name = ""
+
+    def instrument(self, metrics, name: str) -> None:
+        """Attach workqueue metrics (controller-runtime's workqueue family)."""
+        self._metrics = metrics
+        self._name = name
 
     def add(self, request: Request, delay: float = 0.0) -> None:
         """Enqueue; re-adding a pending request keeps the EARLIER due time
@@ -63,14 +70,30 @@ class RateLimitingQueue:
             current = self._due.get(request)
             if current is not None and current <= due:
                 return
+            if request not in self._due and self._metrics is not None:
+                self._metrics.workqueue_adds.labels(name=self._name).inc()
             self._due[request] = due
             self._seq += 1
             heapq.heappush(self._heap, (due, self._seq, request))
+            self._set_depth_locked()
             self._cond.notify()
+
+    def _set_depth_locked(self) -> None:
+        """client-go semantics: depth counts only the ACTIVE queue. Items
+        sleeping out a requeue_after/backoff delay are not backlog — a
+        healthy idle operator with periodic resyncs must read depth 0, not
+        one per controller forever (any depth>0 alert would never clear)."""
+        if self._metrics is None:
+            return
+        now = time.monotonic()
+        depth = sum(1 for d in self._due.values() if d <= now)
+        self._metrics.workqueue_depth.labels(name=self._name).set(depth)
 
     def add_rate_limited(self, request: Request) -> None:
         failures = self._failures.get(request, 0)
         self._failures[request] = failures + 1
+        if self._metrics is not None:
+            self._metrics.workqueue_retries.labels(name=self._name).inc()
         self.add(request, min(BASE_BACKOFF * (2 ** failures), MAX_BACKOFF))
 
     def forget(self, request: Request) -> None:
@@ -88,6 +111,14 @@ class RateLimitingQueue:
                     if self._due.get(request) != due:
                         continue  # stale entry superseded by an earlier add
                     del self._due[request]
+                    if self._metrics is not None:
+                        # queue latency = time spent READY but unserved (a
+                        # deliberate 120 s requeue delay is scheduling, not
+                        # queueing — timing it would peg the histogram at
+                        # +Inf on a healthy system)
+                        self._metrics.workqueue_queue_duration.labels(
+                            name=self._name).observe(max(0.0, now - due))
+                        self._set_depth_locked()
                     return request
                 wait = self._heap[0][0] - now if self._heap else None
                 if deadline is not None:
@@ -119,6 +150,7 @@ class Controller:
     def __init__(self, reconciler: Reconciler):
         self.reconciler = reconciler
         self.queue = RateLimitingQueue()
+        self._metrics = None  # OperatorMetrics, set via instrument()
         self.watch_specs: List[_WatchSpec] = []
         self._handles: list = []
         self._thread: Optional[threading.Thread] = None
@@ -171,17 +203,30 @@ class Controller:
             except Exception:
                 log.exception("%s: resync failed", self.reconciler.name)
 
+    def instrument(self, metrics) -> None:
+        """Attach workqueue + reconcile metrics for this controller."""
+        self._metrics = metrics
+        self.queue.instrument(metrics, self.reconciler.name)
+
     def _worker(self) -> None:
         while True:
             request = self.queue.get()
             if request is None:
                 return
+            started = time.monotonic()
             try:
                 result = self.reconciler.reconcile(request)
             except Exception:
                 log.exception("%s: reconcile %s failed", self.reconciler.name, request)
+                if self._metrics is not None:
+                    self._metrics.reconcile_errors.labels(
+                        name=self.reconciler.name).inc()
                 self.queue.add_rate_limited(request)
                 continue
+            finally:
+                if self._metrics is not None:
+                    self._metrics.reconcile_duration.labels(
+                        name=self.reconciler.name).observe(time.monotonic() - started)
             self.queue.forget(request)
             if result and result.requeue_after is not None:
                 self.queue.add(request, result.requeue_after)
