@@ -219,6 +219,64 @@ def test_apiserver_outage_recovery(cluster):
     wait_for(lambda: policy_state(client) == "ready", message="ready after outage")
 
 
+def test_leader_failover_e2e(cluster):
+    """HA: two full operator replicas share one cluster via Lease-based
+    leader election. Only the leader reconciles; when it crashes WITHOUT a
+    clean hand-off (no lease release), the standby must take over after
+    lease expiry and reconcile state that changed in the interregnum."""
+    from tpu_operator.controllers.leader import LeaderElector
+
+    client = cluster["client"]
+    client.create({"apiVersion": "v1", "kind": "Node",
+                   "metadata": {"name": "tpu-0", "labels": dict(TPU_LABELS)},
+                   "status": {}})
+    client.create(new_cluster_policy())
+
+    def replica(ident):
+        app = OperatorApp(cluster["make_op_client"]())
+        elector = LeaderElector(RestClient(base_url=cluster["base"]),
+                                "tpu-operator", identity=ident,
+                                lease_duration=2.0, renew_period=0.5,
+                                retry_period=0.3)
+        elector.run(on_started=app.start, on_stopped=app.stop)
+        return app, elector
+
+    app_a, elector_a = replica("replica-a")
+    app_b, elector_b = replica("replica-b")
+    try:
+        wait_for(lambda: policy_state(client) == "ready", message="leader installed")
+        leaders = [e for e in (elector_a, elector_b) if e.is_leader.is_set()]
+        assert len(leaders) == 1, "exactly one replica must hold the lease"
+        crashed = app_a if leaders[0] is elector_a else app_b
+        survivor = elector_b if leaders[0] is elector_a else elector_a
+
+        # hard crash: stop renewing WITHOUT releasing the lease (release()
+        # is the clean path; a SIGKILL never runs it)
+        leaders[0]._stop.set()
+        crashed.stop()
+        # the world changes during the interregnum
+        client.patch("tpu.ai/v1", "ClusterPolicy", "cluster-policy",
+                     {"spec": {"telemetry": {"enabled": False}}})
+
+        wait_for(survivor.is_leader.is_set, message="standby takes over")
+
+        def telemetry_gone():
+            try:
+                client.get("apps/v1", "DaemonSet", "tpu-telemetry-exporter",
+                           "tpu-operator")
+                return False
+            except NotFoundError:
+                return True
+        wait_for(telemetry_gone, message="standby reconciled interregnum change")
+        wait_for(lambda: policy_state(client) == "ready",
+                 message="ready under new leader")
+    finally:
+        elector_a.release()
+        elector_b.release()
+        app_a.stop()
+        app_b.stop()
+
+
 def test_multihost_slice_validation_e2e(cluster):
     """A 4-VM slice converges: operands up -> rendezvous pods -> all nodes
     stamped -> ready (the v5e-16 north-star flow on the harness)."""

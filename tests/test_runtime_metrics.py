@@ -77,14 +77,16 @@ def test_queue_duration_excludes_deliberate_delay():
     controller.instrument(metrics)
     controller.start(FakeClient())
     try:
-        controller.queue.add(Request(name="a"), delay=0.3)
+        controller.queue.add(Request(name="a"), delay=1.0)
         deadline = time.monotonic() + 10
         while recon.calls < 1 and time.monotonic() < deadline:
             time.sleep(0.02)
         assert recon.calls == 1
         total = _sample(metrics, "tpu_operator_workqueue_queue_duration_seconds_sum",
                         name="test-recon")
-        assert total < 0.25, f"delay leaked into queue duration: {total}"
+        # generous margin: only scheduler jitter should be observed, never
+        # the deliberate 1.0 s delay itself
+        assert total < 0.5, f"delay leaked into queue duration: {total}"
     finally:
         controller.stop()
 

@@ -97,12 +97,15 @@ class RestClient(Client):
             terms.append(k if v is None else f"{k}={v}")
         return ",".join(terms)
 
-    def _raise_for(self, resp: requests.Response) -> None:
+    def _notify_response(self, method: str, code: int) -> None:
         if self.on_response is not None:
             try:
-                self.on_response(resp.request.method or "?", resp.status_code)
+                self.on_response(method, code)
             except Exception:  # telemetry must never break the request path
                 pass
+
+    def _raise_for(self, resp: requests.Response) -> None:
+        self._notify_response(resp.request.method or "?", resp.status_code)
         if resp.status_code < 400:
             return
         try:
@@ -262,14 +265,10 @@ class _RestWatch(WatchHandle):
                 expired = False
                 error_code = None
                 with self._client._session.get(url, params=params, stream=True, timeout=330) as resp:
-                    if self._client.on_response is not None:
-                        # watch connects (incl. 410 rejections / relist
-                        # storms) must show up in rest_client_requests_total
-                        # — they bypass _raise_for by design
-                        try:
-                            self._client.on_response("WATCH", resp.status_code)
-                        except Exception:
-                            pass
+                    # watch connects (incl. 410 rejections / relist storms)
+                    # must show up in rest_client_requests_total — they
+                    # bypass _raise_for by design
+                    self._client._notify_response("WATCH", resp.status_code)
                     if resp.status_code >= 400:
                         # any rejected watch connect falls back to relist: the
                         # rv itself may be what the server objects to (410
