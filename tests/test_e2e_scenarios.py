@@ -234,10 +234,13 @@ def test_leader_failover_e2e(cluster):
 
     def replica(ident):
         app = OperatorApp(cluster["make_op_client"]())
+        # lease comfortably longer than plausible CI scheduler stalls: a
+        # starved renew thread must not cause a spurious takeover while
+        # both electors are healthy (2 s leases flaked that way)
         elector = LeaderElector(RestClient(base_url=cluster["base"]),
                                 "tpu-operator", identity=ident,
-                                lease_duration=2.0, renew_period=0.5,
-                                retry_period=0.3)
+                                lease_duration=6.0, renew_period=1.5,
+                                retry_period=0.5)
         elector.run(on_started=app.start, on_stopped=app.stop)
         return app, elector
 

@@ -149,3 +149,62 @@ def test_must_gather_degrades_on_unreachable_endpoints(harness):
     index = gather.run()
     assert "scrape-0.error.txt" in index["sections"]["telemetry"]
     assert "barriers/README.txt" in index["sections"]["validation"]
+
+
+def test_must_gather_operator_section(harness):
+    """Operator self-diagnostics: scrapes a live operator pod's /metrics,
+    /debug/threads, and /debug/informers; unreachable pods degrade to
+    recorded errors instead of crashing the bundle."""
+    srv, base, client, status_dir, tmp_path = harness
+    # an operator pod with an IP that serves nothing (connection refused)
+    client.create({"apiVersion": "v1", "kind": "Pod",
+                   "metadata": {"name": "tpu-operator-abc",
+                                "namespace": "tpu-operator",
+                                "labels": {"app": "tpu-operator"}},
+                   "spec": {"containers": []},
+                   "status": {"phase": "Running", "podIP": "127.0.0.1"}})
+    out = str(tmp_path / "bundle3")
+    gather = MustGather(client, "tpu-operator", out,
+                        operator_metrics_port=1, operator_health_port=1)
+    index = gather.run()
+    files = index["sections"]["operator"]
+    assert any("metrics.prom.error" in f for f in files)
+    assert any("threads.txt.error" in f for f in files)
+    assert any("informers.json.error" in f for f in files)
+
+    # with a real operator serving, the scrapes land as content
+    import socket
+
+    from tpu_operator.controllers.manager import OperatorApp
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    mport, hport = free_port(), free_port()
+    app = OperatorApp(RestClient(base_url=base),
+                      metrics_port=mport, health_port=hport)
+    app.start()
+    try:
+        out2 = str(tmp_path / "bundle4")
+        gather = MustGather(client, "tpu-operator", out2,
+                            operator_metrics_port=mport,
+                            operator_health_port=hport)
+        index = gather.run()
+        files = index["sections"]["operator"]
+        assert "tpu-operator-abc/metrics.prom" in files
+        assert "tpu-operator-abc/threads.txt" in files
+        assert "tpu-operator-abc/informers.json" in files
+        with open(os.path.join(out2, "operator",
+                               "tpu-operator-abc", "metrics.prom")) as f:
+            assert "tpu_operator_workqueue" in f.read()
+        # informers.json must stay machine-parseable (no comment prefix)
+        with open(os.path.join(out2, "operator",
+                               "tpu-operator-abc", "informers.json")) as f:
+            assert isinstance(json.load(f), list)
+        assert "tpu-operator-abc/sources.txt" in files
+    finally:
+        app.stop()

@@ -1,0 +1,174 @@
+"""Seeded chaos soak: random cluster churn against the full operator stack.
+
+The reference has no fault-injection tests at all (SURVEY.md 5.3); the
+directed e2es here cover each failure mode in isolation. This soak composes
+them: nodes join and leave, operands get disabled/enabled, operand
+DaemonSets are deleted out from under the operator, the ClusterPolicy
+driver version flips, and the apiserver occasionally dies and comes back
+on the same endpoint — all interleaved by a SEEDED RNG (failures
+reproduce), with the operator running behind the informer cache (the
+production default). When the chaos stops, the cluster must converge:
+every surviving TPU node schedulable, ClusterPolicy ready, operand
+DaemonSets present and healthy.
+"""
+
+import os
+import random
+import time
+
+import pytest
+
+from tpu_operator import consts
+from tpu_operator.api.clusterpolicy import new_cluster_policy
+from tpu_operator.client.cache import CachedClient
+from tpu_operator.client.errors import ApiError, NotFoundError
+from tpu_operator.client.rest import RestClient
+from tpu_operator.controllers.manager import OperatorApp
+from tpu_operator.testing import MiniApiServer
+from tpu_operator.testing.kubelet import KubeletSimulator
+from tpu_operator.utils import deep_get
+
+TPU_LABELS = {
+    consts.GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice",
+    consts.GKE_TPU_TOPOLOGY_LABEL: "2x4",
+}
+
+SOAK_SECONDS = float(os.environ.get("SOAK_SECONDS", "12"))
+SEED = int(os.environ.get("SOAK_SEED", "20260730"))
+
+
+@pytest.fixture(autouse=True)
+def default_images(monkeypatch):
+    for env in ("DRIVER_IMAGE", "VALIDATOR_IMAGE", "FEATURE_DISCOVERY_IMAGE",
+                "TELEMETRY_EXPORTER_IMAGE", "SLICE_PARTITIONER_IMAGE",
+                "DEVICE_PLUGIN_IMAGE"):
+        monkeypatch.setenv(env, "gcr.io/tpu/x:0.1.0")
+
+
+def wait_for(predicate, timeout=60.0, interval=0.05, message="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            if predicate():
+                return
+        except (ApiError, Exception):
+            pass
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {message}")
+
+
+def test_chaos_soak_converges():
+    rng = random.Random(SEED)
+    backend_holder = {}
+    srv = MiniApiServer()
+    base = srv.start()
+    backend_holder["srv"] = srv
+    port = int(base.rsplit(":", 1)[1])
+    chaos = RestClient(base_url=base)
+    op_client = CachedClient(RestClient(base_url=base))
+    kubelet = KubeletSimulator(chaos, interval=0.05).start()
+    app = OperatorApp(op_client)
+
+    node_ids = iter(range(10_000))
+    live_nodes = []
+
+    def add_node():
+        name = f"tpu-{next(node_ids)}"
+        chaos.create({"apiVersion": "v1", "kind": "Node",
+                      "metadata": {"name": name, "labels": dict(TPU_LABELS)},
+                      "status": {}})
+        live_nodes.append(name)
+
+    def remove_node():
+        if len(live_nodes) <= 1:
+            return
+        name = live_nodes.pop(rng.randrange(len(live_nodes)))
+        chaos.delete("v1", "Node", name)
+
+    def flip_operand():
+        operand = rng.choice(["telemetry", "featureDiscovery",
+                              "nodeStatusExporter"])
+        enabled = rng.random() < 0.5
+        chaos.patch("tpu.ai/v1", "ClusterPolicy", "cluster-policy",
+                    {"spec": {operand: {"enabled": enabled}}})
+
+    def delete_random_ds():
+        dses = chaos.list("apps/v1", "DaemonSet", "tpu-operator")
+        if dses:
+            victim = rng.choice(dses)["metadata"]["name"]
+            chaos.delete("apps/v1", "DaemonSet", victim, "tpu-operator")
+
+    def bump_driver():
+        version = f"0.1.{rng.randrange(10)}"
+        chaos.patch("tpu.ai/v1", "ClusterPolicy", "cluster-policy",
+                    {"spec": {"driver": {"repository": "gcr.io/tpu",
+                                         "image": "x", "version": version}}})
+
+    def restart_apiserver():
+        old = backend_holder["srv"]
+        backend = old.backend
+        old.stop()
+        time.sleep(0.3)
+        fresh = MiniApiServer(backend=backend)
+        fresh.start(port)
+        backend_holder["srv"] = fresh
+
+    actions = [add_node] * 3 + [remove_node] * 2 + [flip_operand] * 3 + \
+        [delete_random_ds] * 2 + [bump_driver] * 2 + [restart_apiserver]
+
+    try:
+        add_node()
+        add_node()
+        chaos.create(new_cluster_policy())
+        app.start()
+        wait_for(lambda: deep_get(
+            chaos.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy"),
+            "status", "state") == "ready", message="initial install ready")
+
+        deadline = time.monotonic() + SOAK_SECONDS
+        steps = 0
+        while time.monotonic() < deadline:
+            action = rng.choice(actions)
+            try:
+                action()
+            except ApiError:
+                pass  # chaos racing itself (deleting a DS mid-recreate, etc.)
+            steps += 1
+            time.sleep(rng.uniform(0.02, 0.2))
+        assert steps > 20, "soak too short to mean anything"
+
+        # restore a known-good end state: every operand enabled
+        for operand in ("telemetry", "featureDiscovery", "nodeStatusExporter"):
+            chaos.patch("tpu.ai/v1", "ClusterPolicy", "cluster-policy",
+                        {"spec": {operand: {"enabled": True}}})
+
+        # -- convergence ---------------------------------------------------
+        def all_nodes_schedulable():
+            for name in live_nodes:
+                node = chaos.get("v1", "Node", name)
+                if deep_get(node, "status", "capacity",
+                            consts.TPU_RESOURCE_NAME) != "4":
+                    return False
+            return True
+        wait_for(all_nodes_schedulable, message="all surviving nodes schedulable")
+        wait_for(lambda: deep_get(
+            chaos.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy"),
+            "status", "state") == "ready", message="ready after chaos")
+
+        def core_ds_healthy():
+            for name in ("libtpu-driver", "tpu-device-plugin",
+                         "tpu-telemetry-exporter"):
+                try:
+                    ds = chaos.get("apps/v1", "DaemonSet", name, "tpu-operator")
+                except NotFoundError:
+                    return False
+                status = ds.get("status", {})
+                if status.get("numberAvailable", 0) != len(live_nodes):
+                    return False
+            return True
+        wait_for(core_ds_healthy, message="core DaemonSets healthy on all nodes")
+    finally:
+        app.stop()
+        op_client.stop()
+        kubelet.stop()
+        backend_holder["srv"].stop()

@@ -36,7 +36,7 @@ from ..client.rest import RestClient
 from ..utils import deep_get
 
 SECTIONS = ("cluster", "crs", "operands", "nodes", "validation",
-            "telemetry", "events")
+            "telemetry", "events", "operator")
 
 #: node label columns surfaced in the summary table (upgrade + identity)
 NODE_LABEL_COLUMNS = (
@@ -52,7 +52,9 @@ NODE_LABEL_COLUMNS = (
 class MustGather:
     def __init__(self, client, namespace: str, out_dir: str,
                  status_dir: Optional[str] = None,
-                 telemetry_urls: Optional[List[str]] = None):
+                 telemetry_urls: Optional[List[str]] = None,
+                 operator_metrics_port: int = 8080,
+                 operator_health_port: int = 8081):
         self.client = client
         self.namespace = namespace
         self.out_dir = out_dir
@@ -60,6 +62,8 @@ class MustGather:
             consts.VALIDATION_STATUS_DIR
             if os.path.isdir(consts.VALIDATION_STATUS_DIR) else None)
         self.telemetry_urls = telemetry_urls or []
+        self.operator_metrics_port = operator_metrics_port
+        self.operator_health_port = operator_health_port
         self.manifest: Dict[str, List[str]] = {s: [] for s in SECTIONS}
         self.errors: List[str] = []
         self._nodes: Optional[List[dict]] = None
@@ -210,6 +214,42 @@ class MustGather:
                 self._write("telemetry", f"scrape-{i}.error.txt",
                             f"{url}: {e}\n")
 
+    def gather_operator(self) -> None:
+        """Operator self-diagnostics: prometheus metrics (workqueue depth,
+        reconcile errors, apiserver traffic), the thread dump, and the
+        informer-cache state — the live-process facts a support case needs
+        that logs alone don't carry."""
+        pods = self._try("operator pods", self.client.list, "v1", "Pod",
+                         self.namespace, {"app": "tpu-operator"}) or []
+        targets = [(p["metadata"]["name"], deep_get(p, "status", "podIP"))
+                   for p in pods if deep_get(p, "status", "podIP")]
+        if not targets:
+            self._write("operator", "README.txt",
+                        "no running operator pods with an IP found\n")
+            return
+        endpoints = ((self.operator_metrics_port, "/metrics", "metrics.prom"),
+                     (self.operator_health_port, "/debug/threads", "threads.txt"),
+                     (self.operator_health_port, "/debug/informers", "informers.json"))
+        for name, ip in targets:
+            sources = []
+            for port, path, fname in endpoints:
+                url = f"http://{ip}:{port}{path}"
+                try:
+                    with urllib.request.urlopen(url, timeout=3) as resp:
+                        body = resp.read().decode("utf-8", "replace")
+                    # .json files must stay parseable — no comment prefix;
+                    # provenance goes in the sibling sources.txt instead
+                    if not fname.endswith(".json"):
+                        body = f"# source: {url}\n{body}"
+                    self._write("operator", f"{name}/{fname}", body)
+                    sources.append(f"{fname}: {url}")
+                except OSError as e:
+                    self._write("operator", f"{name}/{fname}.error.txt",
+                                f"{url}: {e}\n")
+            if sources:
+                self._write("operator", f"{name}/sources.txt",
+                            "\n".join(sources) + "\n")
+
     def gather_events(self) -> None:
         events = self._try("events", self.client.list, "v1", "Event",
                            self.namespace) or []
@@ -219,8 +259,7 @@ class MustGather:
 
     # -- driver --------------------------------------------------------------
     def run(self) -> Dict[str, List[str]]:
-        for section in ("cluster", "crs", "operands", "nodes",
-                        "validation", "telemetry", "events"):
+        for section in SECTIONS:
             getattr(self, f"gather_{section}")()
         index = {"sections": self.manifest, "errors": self.errors,
                  "namespace": self.namespace,
@@ -246,6 +285,8 @@ def main(argv=None) -> int:
                    help="validation barrier dir to include")
     p.add_argument("--telemetry-url", action="append", default=[],
                    help="telemetry exporter /metrics URL (repeatable)")
+    p.add_argument("--operator-metrics-port", type=int, default=8080)
+    p.add_argument("--operator-health-port", type=int, default=8081)
     p.add_argument("--no-tar", action="store_true")
     args = p.parse_args(argv)
 
@@ -255,7 +296,9 @@ def main(argv=None) -> int:
         else RestClient()
     gather = MustGather(client, args.namespace, out,
                         status_dir=args.status_dir,
-                        telemetry_urls=args.telemetry_url)
+                        telemetry_urls=args.telemetry_url,
+                        operator_metrics_port=args.operator_metrics_port,
+                        operator_health_port=args.operator_health_port)
     index = gather.run()
     print(f"gathered {sum(len(v) for v in index['sections'].values())} "
           f"files into {out}")
