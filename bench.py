@@ -245,6 +245,10 @@ INJECTED = dict(latency_s=0.02, interval=0.5, rollout_ticks=20)
 
 def main() -> int:
     control_plane_raw_s, _ = bench_control_plane()
+    # scale sidecar: a 50-node pool join on the raw simulator — shows the
+    # sweep cost and request count stay sub-linear per node (informer
+    # cache; one LIST per kind, not one GET per object per sweep)
+    scale_s, scale_requests = bench_control_plane(n_nodes=50)
     control_plane_s, cp_requests = bench_control_plane(**INJECTED)
     # same injected scenario without the informer cache: quantifies the
     # read-amplification the cache removes (requests AND seconds)
@@ -286,6 +290,9 @@ def main() -> int:
                                      if control_plane_uncached_s is not None else None),
         "control_plane_uncached_api_requests": (
             cp_uncached_requests if control_plane_uncached_s is not None else None),
+        "control_plane_50node_raw_sim": (
+            {"s": round(scale_s, 3), "api_requests": scale_requests}
+            if scale_s is not None else {"timed_out": True}),
         "control_plane_sim": {
             "simulated": True,
             "timed_out": cp_timed_out,

@@ -17,6 +17,7 @@ import random
 import time
 
 import pytest
+import requests
 
 from tpu_operator import consts
 from tpu_operator.api.clusterpolicy import new_cluster_policy
@@ -51,8 +52,8 @@ def wait_for(predicate, timeout=60.0, interval=0.05, message="condition"):
         try:
             if predicate():
                 return
-        except (ApiError, Exception):
-            pass
+        except (ApiError, requests.RequestException):
+            pass  # apiserver mid-restart; anything else is a predicate bug
         time.sleep(interval)
     raise AssertionError(f"timed out waiting for {message}")
 
@@ -131,16 +132,21 @@ def test_chaos_soak_converges():
             action = rng.choice(actions)
             try:
                 action()
-            except ApiError:
-                pass  # chaos racing itself (deleting a DS mid-recreate, etc.)
+            except (ApiError, requests.RequestException):
+                # chaos racing itself (deleting a DS mid-recreate) or a
+                # keep-alive socket dying across an apiserver restart
+                pass
             steps += 1
             time.sleep(rng.uniform(0.02, 0.2))
         assert steps > 20, "soak too short to mean anything"
 
-        # restore a known-good end state: every operand enabled
+        # restore a known-good end state: every operand enabled (retry: a
+        # just-restarted apiserver may still be settling keep-alive sockets)
         for operand in ("telemetry", "featureDiscovery", "nodeStatusExporter"):
-            chaos.patch("tpu.ai/v1", "ClusterPolicy", "cluster-policy",
-                        {"spec": {operand: {"enabled": True}}})
+            wait_for(lambda op=operand: chaos.patch(
+                "tpu.ai/v1", "ClusterPolicy", "cluster-policy",
+                {"spec": {op: {"enabled": True}}}) is not None,
+                timeout=10, message=f"re-enable {operand}")
 
         # -- convergence ---------------------------------------------------
         def all_nodes_schedulable():

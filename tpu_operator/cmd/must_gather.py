@@ -205,14 +205,24 @@ class MustGather:
                         "no telemetry endpoints found or provided\n")
             return
         for i, url in enumerate(urls):
-            try:
-                with urllib.request.urlopen(url, timeout=3) as resp:
-                    body = resp.read().decode("utf-8", "replace")
+            body, error = self._scrape(url)
+            if error is not None:
+                self._write("telemetry", f"scrape-{i}.error.txt", error)
+            else:
                 self._write("telemetry", f"scrape-{i}.prom",
                             f"# source: {url}\n{body}")
-            except OSError as e:
-                self._write("telemetry", f"scrape-{i}.error.txt",
-                            f"{url}: {e}\n")
+
+    def _scrape(self, url: str):
+        """Fetch a debug/metrics endpoint; returns (body, None) or
+        (None, error_string). Malformed responses must degrade the one
+        file, never crash the bundle."""
+        import http.client
+
+        try:
+            with urllib.request.urlopen(url, timeout=3) as resp:
+                return resp.read().decode("utf-8", "replace"), None
+        except (OSError, http.client.HTTPException) as e:
+            return None, f"{url}: {e}\n"
 
     def gather_operator(self) -> None:
         """Operator self-diagnostics: prometheus metrics (workqueue depth,
@@ -234,18 +244,16 @@ class MustGather:
             sources = []
             for port, path, fname in endpoints:
                 url = f"http://{ip}:{port}{path}"
-                try:
-                    with urllib.request.urlopen(url, timeout=3) as resp:
-                        body = resp.read().decode("utf-8", "replace")
-                    # .json files must stay parseable — no comment prefix;
-                    # provenance goes in the sibling sources.txt instead
-                    if not fname.endswith(".json"):
-                        body = f"# source: {url}\n{body}"
-                    self._write("operator", f"{name}/{fname}", body)
-                    sources.append(f"{fname}: {url}")
-                except OSError as e:
-                    self._write("operator", f"{name}/{fname}.error.txt",
-                                f"{url}: {e}\n")
+                body, error = self._scrape(url)
+                if error is not None:
+                    self._write("operator", f"{name}/{fname}.error.txt", error)
+                    continue
+                # .json files must stay parseable — no comment prefix;
+                # provenance goes in the sibling sources.txt instead
+                if not fname.endswith(".json"):
+                    body = f"# source: {url}\n{body}"
+                self._write("operator", f"{name}/{fname}", body)
+                sources.append(f"{fname}: {url}")
             if sources:
                 self._write("operator", f"{name}/sources.txt",
                             "\n".join(sources) + "\n")
