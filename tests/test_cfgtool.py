@@ -134,3 +134,55 @@ def test_crd_manifests_parse():
         assert doc["spec"]["versions"][0]["subresources"] == {"status": {}}
         names.append(doc["metadata"]["name"])
     assert names == ["clusterpolicies.tpu.ai", "tpudrivers.tpu.ai"]
+
+
+def test_status_against_live_harness(capsys):
+    """`tpuop-cfg status` renders the triage summary over the wire and
+    exits 0 only when the ClusterPolicy is ready."""
+    from tpu_operator import consts
+    from tpu_operator.api.clusterpolicy import new_cluster_policy
+    from tpu_operator.client.rest import RestClient
+    from tpu_operator.testing import MiniApiServer
+
+    srv = MiniApiServer()
+    base = srv.start()
+    try:
+        client = RestClient(base_url=base)
+        policy = new_cluster_policy()
+        policy["status"] = {"state": "notReady", "conditions": [
+            {"type": "Ready", "status": "False", "reason": "OperandNotReady",
+             "message": "state-device-plugin not ready"}]}
+        client.create(policy)
+        client.create({"apiVersion": "v1", "kind": "Node",
+                       "metadata": {"name": "tpu-0", "labels": {
+                           consts.TPU_PRESENT_LABEL: "true",
+                           consts.UPGRADE_STATE_LABEL: "upgrade-done"}},
+                       "status": {"capacity": {consts.TPU_RESOURCE_NAME: "4"}}})
+        client.create({"apiVersion": "apps/v1", "kind": "DaemonSet",
+                       "metadata": {"name": "libtpu-driver",
+                                    "namespace": "tpu-operator"},
+                       "spec": {"template": {"metadata": {}, "spec": {}}},
+                       "status": {"desiredNumberScheduled": 1,
+                                  "numberAvailable": 1,
+                                  "updatedNumberScheduled": 1}})
+
+        assert run(["status", "--base-url", base]) == 1  # notReady -> exit 1
+        out = capsys.readouterr().out
+        assert "ClusterPolicy/cluster-policy: notReady" in out
+        assert "OperandNotReady" in out
+        assert "tpu-0" in out and "upgrade-done" in out
+        assert "libtpu-driver" in out
+
+        cp = client.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy")
+        cp["status"]["state"] = "ready"
+        client.update_status(cp)
+        assert run(["status", "--base-url", base]) == 0
+    finally:
+        srv.stop()
+
+
+def test_status_unreachable_cluster_fails_cleanly(capsys):
+    assert run(["status", "--base-url", "http://127.0.0.1:1"]) == 2
+    err = capsys.readouterr().err
+    assert "cannot reach the cluster" in err
+    assert "Traceback" not in err

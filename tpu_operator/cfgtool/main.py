@@ -5,6 +5,7 @@ Subcommands:
   validate <file.yaml>...   parse + spec-validate ClusterPolicy/TPUDriver docs
   validate-csv <csv.yaml>   validate the OLM CSV's alm-examples CRs
   sample [clusterpolicy|tpudriver]   print a complete sample CR
+  status [--base-url URL]   live-cluster triage summary (exit 0 iff ready)
 """
 
 from __future__ import annotations
@@ -166,6 +167,77 @@ def validate_csv(path: str) -> int:
     return 1 if failed else 0
 
 
+def status(base_url=None, namespace="tpu-operator", out=None,
+           token=None) -> int:
+    """One-command cluster triage: ClusterPolicy verdict + conditions,
+    TPUDriver pools, node table (TPU presence / schedulable capacity /
+    upgrade state), operand DaemonSet readiness. Exit 0 only when the
+    policy reports ready. (The reference's gpuop-cfg has no live-cluster
+    mode; this is the `kubectl get all`-of-the-operator a support case
+    starts with.)"""
+    import requests
+
+    from ..client.errors import ApiError
+    from ..client.rest import RestClient
+
+    out = out or sys.stdout  # resolve at call time (tests capture stdout)
+    try:
+        client = (RestClient(base_url=base_url, token=token) if base_url
+                  else RestClient())
+        return _status(client, namespace, out)
+    except (ApiError, requests.RequestException, OSError) as e:
+        # the triage tool must fail with one readable line, not a
+        # traceback, exactly when the cluster is sick
+        print(f"status: cannot reach the cluster: {e}", file=sys.stderr)
+        return 2
+
+
+def _status(client, namespace, out) -> int:
+    from .. import consts
+    from ..utils import deep_get
+
+    ready = False
+
+    policies = client.list("tpu.ai/v1", "ClusterPolicy")
+    if not policies:
+        print("ClusterPolicy: none found", file=out)
+    for policy in policies:
+        state = deep_get(policy, "status", "state") or "unknown"
+        ready = ready or state == "ready"
+        print(f"ClusterPolicy/{policy['metadata']['name']}: {state}", file=out)
+        for cond in deep_get(policy, "status", "conditions", default=[]) or []:
+            print(f"  {cond.get('type')}={cond.get('status')} "
+                  f"reason={cond.get('reason', '')} {cond.get('message', '')}",
+                  file=out)
+
+    for driver in client.list("tpu.ai/v1alpha1", "TPUDriver"):
+        state = deep_get(driver, "status", "state") or "unknown"
+        pools = deep_get(driver, "status", "pools", default={}) or {}
+        print(f"TPUDriver/{driver['metadata']['name']}: {state} "
+              f"pools={pools}", file=out)
+
+    # TPU nodes only — presence is the row filter, so no column for it
+    print("\nNODE            CAPACITY  UPGRADE-STATE", file=out)
+    for node in client.list("v1", "Node"):
+        labels = node.get("metadata", {}).get("labels", {}) or {}
+        if labels.get(consts.TPU_PRESENT_LABEL) != "true":
+            continue
+        name = node["metadata"]["name"]
+        capacity = deep_get(node, "status", "capacity",
+                            consts.TPU_RESOURCE_NAME) or "0"
+        upgrade = labels.get(consts.UPGRADE_STATE_LABEL, "-")
+        print(f"{name:<15} {capacity:<9} {upgrade}", file=out)
+
+    print("\nDAEMONSET                 DESIRED  AVAILABLE  UPDATED", file=out)
+    for ds in client.list("apps/v1", "DaemonSet", namespace):
+        st = ds.get("status", {})
+        print(f"{ds['metadata']['name']:<25} "
+              f"{st.get('desiredNumberScheduled', 0):<8} "
+              f"{st.get('numberAvailable', 0):<10} "
+              f"{st.get('updatedNumberScheduled', 0)}", file=out)
+    return 0 if ready else 1
+
+
 def run(argv=None) -> int:
     p = argparse.ArgumentParser(prog="tpuop-cfg")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -176,7 +248,17 @@ def run(argv=None) -> int:
     s = sub.add_parser("sample")
     s.add_argument("kind", nargs="?", default="clusterpolicy",
                    choices=["clusterpolicy", "tpudriver"])
+    st = sub.add_parser("status", help="live-cluster triage summary")
+    st.add_argument("--base-url", default=None,
+                    help="API server URL (default: in-cluster config)")
+    st.add_argument("--token", default=None,
+                    help="bearer token for --base-url (off-cluster use)")
+    st.add_argument("--namespace", default="tpu-operator")
     args = p.parse_args(argv)
+
+    if args.cmd == "status":
+        return status(base_url=args.base_url, namespace=args.namespace,
+                      token=args.token)
 
     if args.cmd == "validate-csv":
         return validate_csv(args.csv)
