@@ -73,10 +73,10 @@ def bench_control_plane(n_nodes: int = 4, timeout: float = 115.0,
     app.start()
     kubelet.start()
 
-    # request accounting reports the TOTAL over the run — operator, kubelet
-    # sim, and bench poller combined (the bench JSON labels it so): isolating
-    # the operator's share isn't attempted; the cached-vs-direct DELTA under
-    # identical co-traffic is the meaningful number
+    # request accounting: operator + kubelet-sim traffic. The bench's own
+    # convergence poller reads the in-process backend (below) and the
+    # n_nodes seed creates are subtracted at return, so the published
+    # number is what the system under test actually sent the apiserver.
     t_req0 = srv.request_count
     try:
         t0 = time.monotonic()
@@ -86,17 +86,21 @@ def bench_control_plane(n_nodes: int = 4, timeout: float = 115.0,
                              consts.GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice",
                              consts.GKE_TPU_TOPOLOGY_LABEL: "4x4"}},
                          "status": {}})
+        # convergence polling reads the in-process backend directly: the
+        # bench's own observer must not inflate the request count or ride
+        # the injected latency
         while time.monotonic() - t0 < timeout:
-            nodes = seed.list("v1", "Node")
+            nodes = srv.backend.list("v1", "Node")
             schedulable = sum(
                 1 for n in nodes
                 if deep_get(n, "status", "capacity", consts.TPU_RESOURCE_NAME) is not None)
-            cp_ready = deep_get(seed.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy"),
-                                "status", "state") == "ready"
+            cp_ready = deep_get(
+                srv.backend.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy"),
+                "status", "state") == "ready"
             if schedulable == n_nodes and cp_ready:
-                return time.monotonic() - t0, srv.request_count - t_req0
+                return time.monotonic() - t0, srv.request_count - t_req0 - n_nodes
             time.sleep(0.05)
-        return None, srv.request_count - t_req0
+        return None, srv.request_count - t_req0 - n_nodes
     finally:
         app.stop()
         op_client.stop()
@@ -279,11 +283,12 @@ def main() -> int:
         # the raw in-process number is a regression trend only
         "control_plane_s": round(control_plane_s, 3),
         "control_plane_raw_sim_s": round(control_plane_raw_s, 3),
-        # informer-cache effect under the same injected latency: total HTTP
-        # requests to the apiserver during the join (operator + kubelet sim
-        # + bench poller combined — the DELTA between the two runs is the
-        # operator's read amplification). A timed-out run's count is from a
-        # truncated, non-converged window — not a measurement, so nulled.
+        # informer-cache effect under the same injected latency: HTTP
+        # requests the system under test (operator + kubelet sim) sent the
+        # apiserver during the join — the bench's poller and node seeds are
+        # excluded, so the DELTA between the two runs is the operator's
+        # read amplification. A timed-out run's count is from a truncated,
+        # non-converged window — not a measurement, so nulled.
         "control_plane_api_requests": (None if cp_injected_timed_out
                                        else cp_requests),
         "control_plane_uncached_s": (round(control_plane_uncached_s, 3)

@@ -181,13 +181,20 @@ def status(base_url=None, namespace="tpu-operator", out=None,
     from ..client.rest import RestClient
 
     out = out or sys.stdout  # resolve at call time (tests capture stdout)
+    # the triage tool must fail with one readable line, not a traceback,
+    # exactly when the cluster is sick — and must not misdiagnose an
+    # apiserver that answered (403 RBAC, 404 CRDs-not-installed) as a
+    # connectivity problem
     try:
         client = (RestClient(base_url=base_url, token=token) if base_url
                   else RestClient())
         return _status(client, namespace, out)
-    except (ApiError, requests.RequestException, OSError) as e:
-        # the triage tool must fail with one readable line, not a
-        # traceback, exactly when the cluster is sick
+    except ApiError as e:
+        print(f"status: apiserver refused the request ({e.code}): {e} — "
+              "check RBAC and that the tpu.ai CRDs are installed",
+              file=sys.stderr)
+        return 2
+    except (requests.RequestException, OSError) as e:
         print(f"status: cannot reach the cluster: {e}", file=sys.stderr)
         return 2
 
