@@ -201,3 +201,36 @@ def test_deleted_instance_cascades_daemonsets(fake_client):
     # fake client implements server-side ownerRef GC
     assert fake_client.list("apps/v1", "DaemonSet", "tpu-operator") == []
     assert r.reconcile(Request("main")).requeue_after is None
+
+
+def test_crash_during_fanout_with_pool_change_resumes(fake_client):
+    """Operator crash semantics for the per-pool fan-out (composing the
+    fault-injection pattern with pool membership changing while down):
+    DSes exist from a previous process; a node's topology label changes
+    during the outage; a FRESH reconciler must create the new pool's DS,
+    clean up the now-empty pool's DS, and report the new pool map —
+    entirely from cluster state, no carried-over memory."""
+    setup_cluster(fake_client, n_24=2, n_44=1)
+    fake_client.create(new_tpu_driver("main", {"image": "img", "nodeSelector": {
+        consts.GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice"}}))
+    TPUDriverReconciler(fake_client).reconcile(Request("main"))
+    assert len(fake_client.list("apps/v1", "DaemonSet", "tpu-operator")) == 2
+
+    # crash happens here; while down, the 4x4 node is re-provisioned as 4x2
+    node = fake_client.get("v1", "Node", "n44-0")
+    node["metadata"]["labels"][consts.GKE_TPU_TOPOLOGY_LABEL] = "4x2"
+    fake_client.update(node)
+
+    fresh = TPUDriverReconciler(fake_client)  # new process, empty memory
+    fresh.reconcile(Request("main"))
+    names = sorted(d["metadata"]["name"]
+                   for d in fake_client.list("apps/v1", "DaemonSet", "tpu-operator"))
+    assert names == ["libtpu-driver-main-v5-lite-podslice-2x4",
+                     "libtpu-driver-main-v5-lite-podslice-4x2"]
+
+    KubeletSimulator(fake_client).tick()
+    fresh.reconcile(Request("main"))
+    live = fake_client.get("tpu.ai/v1alpha1", "TPUDriver", "main")
+    assert live["status"]["pools"] == {"v5-lite-podslice-2x4": 2,
+                                       "v5-lite-podslice-4x2": 1}
+    assert live["status"]["state"] == "ready"

@@ -190,8 +190,9 @@ def status(base_url=None, namespace="tpu-operator", out=None,
                   else RestClient())
         return _status(client, namespace, out)
     except ApiError as e:
-        print(f"status: apiserver refused the request ({e.code}): {e} — "
-              "check RBAC and that the tpu.ai CRDs are installed",
+        hint = (" — check RBAC and that the tpu.ai CRDs are installed"
+                if e.code in (401, 403, 404) else "")
+        print(f"status: apiserver returned {e.code}: {e}{hint}",
               file=sys.stderr)
         return 2
     except (requests.RequestException, OSError) as e:
