@@ -59,12 +59,16 @@ finalize() {
 import json, sys, xml.sax.saxutils as x
 d = sys.argv[1]
 cases = [json.loads(l) for l in open(f"{d}/results.jsonl") if l.strip()]
-failures = sum(1 for c in cases if c["status"] != "pass")
+failures = sum(1 for c in cases if c["status"] not in ("pass", "skip"))
+skipped = sum(1 for c in cases if c["status"] == "skip")
 with open(f"{d}/junit.xml", "w") as f:
-    f.write(f'<testsuite name="kind-e2e" tests="{len(cases)}" failures="{failures}">')
+    f.write(f'<testsuite name="kind-e2e" tests="{len(cases)}" '
+            f'failures="{failures}" skipped="{skipped}">')
     for c in cases:
         f.write(f'<testcase name={x.quoteattr(c["step"])} time="{c["t_offset_s"]}">')
-        if c["status"] != "pass":
+        if c["status"] == "skip":
+            f.write(f'<skipped message={x.quoteattr(c.get("detail", ""))}/>')
+        elif c["status"] != "pass":
             f.write(f'<failure message={x.quoteattr(c.get("detail", ""))}/>')
         f.write('</testcase>')
     f.write('</testsuite>')
@@ -194,6 +198,34 @@ CAP=$(kubectl get node "$NODE" -o jsonpath='{.status.capacity.google\.com/tpu}')
   echo "FAIL: google.com/tpu not advertised by the builtin plugin"; exit 1; }
 echo "ok: google.com/tpu=$CAP via real kubelet device-plugin registration"
 record pass tpu-capacity-advertised "$CAP"
+
+echo "=== live triage surfaces ==="
+# tpuop-cfg status against the real apiserver (via a kubectl proxy) and the
+# operator's debug endpoints land in the evidence bundle — the triage
+# surfaces a support case starts with must work on a real cluster too
+if python3 -c "import requests, yaml" 2>/dev/null; then
+  kubectl proxy --port=8001 > "$EVIDENCE/kubectl-proxy.log" 2>&1 &
+  PROXY_PID=$!
+  timeout 30 bash -c \
+    'until curl -sf http://127.0.0.1:8001/version >/dev/null; do sleep 1; done' \
+    || { echo "FAIL: kubectl proxy never came up"; cat "$EVIDENCE/kubectl-proxy.log";
+         record fail cfg-status "proxy unreachable"; exit 1; }
+  python3 -m tpu_operator.cfgtool.main status --base-url http://127.0.0.1:8001 \
+    > "$EVIDENCE/tpuop-cfg-status.txt" 2>&1 \
+    && { echo "ok: tpuop-cfg status reports ready"; record pass cfg-status; } \
+    || { echo "FAIL: tpuop-cfg status"; cat "$EVIDENCE/tpuop-cfg-status.txt";
+         record fail cfg-status; kill $PROXY_PID; exit 1; }
+  kill $PROXY_PID 2>/dev/null || true
+else
+  echo "skip: python deps (requests, yaml) not on this host"
+  record skip cfg-status "python deps unavailable"
+fi
+OPPOD=$(kubectl -n "$NS" get pods -l app=tpu-operator -o jsonpath='{.items[0].metadata.name}')
+# apiserver pod-proxy: same endpoint must_gather scrapes, no in-image deps
+kubectl get --raw "/api/v1/namespaces/$NS/pods/$OPPOD:8081/proxy/debug/informers" \
+  > "$EVIDENCE/debug-informers.json" 2>/dev/null \
+  && { echo "ok: /debug/informers captured"; record pass debug-informers; } \
+  || { echo "warn: /debug/informers not captured"; record skip debug-informers "endpoint unreachable"; }
 
 echo "=== disable/enable operand flips its DaemonSet ==="
 kubectl patch clusterpolicies.tpu.ai/cluster-policy --type merge \
