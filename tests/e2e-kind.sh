@@ -209,7 +209,7 @@ if python3 -c "import requests, yaml" 2>/dev/null; then
   timeout 30 bash -c \
     'until curl -sf http://127.0.0.1:8001/version >/dev/null; do sleep 1; done' \
     || { echo "FAIL: kubectl proxy never came up"; cat "$EVIDENCE/kubectl-proxy.log";
-         record fail cfg-status "proxy unreachable"; exit 1; }
+         record fail cfg-status "proxy unreachable"; kill $PROXY_PID 2>/dev/null; exit 1; }
   python3 -m tpu_operator.cfgtool.main status --base-url http://127.0.0.1:8001 \
     > "$EVIDENCE/tpuop-cfg-status.txt" 2>&1 \
     && { echo "ok: tpuop-cfg status reports ready"; record pass cfg-status; } \

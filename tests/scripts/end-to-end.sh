@@ -55,9 +55,12 @@ echo "cluster at ${BASE}"
 
 # pidfile-based so cases (run in subshells) can restart the operator too
 start_operator() {
+    # --leader-elect matches the shipped manifests; SIGTERM in
+    # stop_operator exercises the clean lease release + fast re-acquire
     python3 -m tpu_operator.cmd.operator \
         --api-server "${BASE}" --namespace "${NS}" \
         --metrics-port "${METRICS_PORT}" --health-port "${HEALTH_PORT}" \
+        --leader-elect \
         --log-level info >>"${WORK_DIR}/operator.log" 2>&1 &
     echo $! > "${WORK_DIR}/operator.pid"
 }

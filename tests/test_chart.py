@@ -20,7 +20,7 @@ CHART = os.path.join(REPO, "deployments", "tpu-operator")
 CHART_ONLY_KEYS = {"tpuDriver"}
 #: operator-section keys consumed by the Deployment template, not the CR
 OPERATOR_CHART_KEYS = {"image", "version", "imagePullPolicy", "replicas",
-                       "resources"}
+                       "resources", "leaderElect", "extraArgs"}
 
 
 @pytest.fixture(scope="module")

@@ -177,6 +177,44 @@ def test_health_server_serves_metrics_and_informer_debug(monkeypatch):
         srv.stop()
 
 
+def test_standby_replica_serves_probes_without_reconciling(monkeypatch):
+    """Under leader election a standby starts its health servers at process
+    start but no controllers — if probes waited for leadership, the kubelet
+    would crash-loop every standby replica."""
+    import socket
+
+    import requests as rq
+
+    from tpu_operator.controllers.manager import OperatorApp
+
+    for env in ("DRIVER_IMAGE", "VALIDATOR_IMAGE", "DEVICE_PLUGIN_IMAGE"):
+        monkeypatch.setenv(env, "gcr.io/tpu/x:0.1.0")
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    srv = MiniApiServer()
+    base = srv.start()
+    hport = free_port()
+    app = OperatorApp(RestClient(base_url=base), health_port=hport)
+    app.start_servers()  # standby mode: no start_controllers
+    try:
+        assert rq.get(f"http://127.0.0.1:{hport}/healthz", timeout=5).status_code == 200
+        # no controller threads are reconciling
+        assert all(c._thread is None for c in app.manager.controllers)
+        # idempotent across the leadership transition
+        app.start_servers()
+        app.start_controllers()
+        assert all(c._thread is not None for c in app.manager.controllers)
+    finally:
+        app.stop()
+        srv.stop()
+
+
 def test_cached_client_stats_shape():
     backend = FakeClient()
     backend.create({"apiVersion": "v1", "kind": "Node",

@@ -128,8 +128,19 @@ class OperatorApp:
         self._servers: list = []
 
     def start(self) -> None:
-        self._servers = serve_health_and_metrics(
-            self.metrics, self._metrics_port, self._health_port, self.client)
+        self.start_servers()
+        self.start_controllers()
+
+    def start_servers(self) -> None:
+        """Health/metrics endpoints — up from PROCESS start. Under leader
+        election a standby replica reconciles nothing but must still answer
+        its liveness/readiness probes, or the kubelet crash-loops it."""
+        if not self._servers:
+            self._servers = serve_health_and_metrics(
+                self.metrics, self._metrics_port, self._health_port, self.client)
+
+    def start_controllers(self) -> None:
+        """Reconcile loops — only on the leader."""
         self.manager.start()
         # kick an initial reconcile even if no watch event ever fires
         for policy in self.client.list("tpu.ai/v1", "ClusterPolicy"):
@@ -139,6 +150,7 @@ class OperatorApp:
         self.manager.stop()
         for s in self._servers:
             s.shutdown()
+        self._servers = []  # a later start_servers() must re-create them
 
 
 def run_operator(args) -> int:
@@ -180,7 +192,8 @@ def run_operator(args) -> int:
         # election is correctness-critical and tiny — a Lease informer would
         # add a watch stream to save nothing
         elector = LeaderElector(direct_client, app.clusterpolicy_reconciler.namespace)
-        elector.run(on_started=app.start, on_stopped=on_lost)
+        app.start_servers()  # probes answer while standing by
+        elector.run(on_started=app.start_controllers, on_stopped=on_lost)
         log.info("leader election enabled; waiting for leadership as %s", elector.identity)
     else:
         app.start()
