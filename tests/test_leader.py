@@ -55,3 +55,69 @@ def test_run_loop_and_voluntary_release(fake_client):
     assert b.is_leader.wait(timeout=3)
     assert "b-start" in events
     b.release()
+
+
+def test_elector_survives_apiserver_outage_within_lease(fake_client):
+    """Transient apiserver failure must neither kill the elector thread nor
+    relinquish leadership while the leader's own lease cannot have expired
+    (client-go renew-deadline grace) — a dead elector thread is split brain:
+    the leader reconciles forever without renewing while a standby takes
+    over."""
+    import threading
+
+    outage = {"on": False}
+    real_get = fake_client.get
+    real_update = fake_client.update
+
+    def flaky_get(*a, **kw):
+        if outage["on"]:
+            raise ConnectionError("apiserver down")
+        return real_get(*a, **kw)
+
+    def flaky_update(*a, **kw):
+        if outage["on"]:
+            raise ConnectionError("apiserver down")
+        return real_update(*a, **kw)
+
+    fake_client.get = flaky_get
+    fake_client.update = flaky_update
+
+    transitions = {"started": 0, "stopped": 0}
+    e = elector(fake_client, "a", lease_duration=4.0)  # renew_deadline 3.2
+    e.run(on_started=lambda: transitions.__setitem__("started", transitions["started"] + 1),
+          on_stopped=lambda: transitions.__setitem__("stopped", transitions["stopped"] + 1))
+    try:
+        deadline = time.monotonic() + 5
+        while not e.is_leader.is_set() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert e.is_leader.is_set()
+
+        # short outage (well under the lease): leadership retained
+        outage["on"] = True
+        time.sleep(0.5)
+        assert e.is_leader.is_set(), "must not relinquish within its own lease"
+        assert transitions["stopped"] == 0
+        outage["on"] = False
+        time.sleep(0.3)
+        assert e.is_leader.is_set()
+
+        # long outage (past the lease window): leadership released...
+        outage["on"] = True
+        deadline = time.monotonic() + 6
+        while e.is_leader.is_set() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert not e.is_leader.is_set(), "must release past the lease window"
+        assert transitions["stopped"] == 1
+        # ...and the thread is STILL ALIVE and re-acquires on recovery
+        assert any(t.name == "leader-elector" and t.is_alive()
+                   for t in threading.enumerate())
+        outage["on"] = False
+        deadline = time.monotonic() + 5
+        while not e.is_leader.is_set() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert e.is_leader.is_set(), "elector must recover after the outage"
+        assert transitions["started"] == 2
+    finally:
+        e.release()
+        fake_client.get = real_get
+        fake_client.update = real_update

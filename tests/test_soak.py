@@ -58,6 +58,169 @@ def wait_for(predicate, timeout=60.0, interval=0.05, message="condition"):
     raise AssertionError(f"timed out waiting for {message}")
 
 
+class ChaosKit:
+    """Shared churn actions for the soak tests (kept in one place so the
+    single-operator and HA variants can't silently drift)."""
+
+    def __init__(self, client, rng, srv_holder, port):
+        self.client = client
+        self.rng = rng
+        self.srv_holder = srv_holder
+        self.port = port
+        self.live_nodes = []
+        self.ids = iter(range(10_000))
+
+    def add_node(self):
+        name = f"tpu-{next(self.ids)}"
+        self.client.create({"apiVersion": "v1", "kind": "Node",
+                           "metadata": {"name": name, "labels": dict(TPU_LABELS)},
+                           "status": {}})
+        self.live_nodes.append(name)
+
+    def remove_node(self):
+        if len(self.live_nodes) <= 1:
+            return
+        name = self.live_nodes.pop(self.rng.randrange(len(self.live_nodes)))
+        self.client.delete("v1", "Node", name)
+
+    def flip_operand(self):
+        operand = self.rng.choice(["telemetry", "featureDiscovery",
+                                   "nodeStatusExporter"])
+        self.client.patch("tpu.ai/v1", "ClusterPolicy", "cluster-policy",
+                          {"spec": {operand: {"enabled": self.rng.random() < 0.5}}})
+
+    def delete_random_ds(self):
+        dses = self.client.list("apps/v1", "DaemonSet", "tpu-operator")
+        if dses:
+            victim = self.rng.choice(dses)["metadata"]["name"]
+            self.client.delete("apps/v1", "DaemonSet", victim, "tpu-operator")
+
+    def bump_driver(self):
+        self.client.patch("tpu.ai/v1", "ClusterPolicy", "cluster-policy",
+                          {"spec": {"driver": {
+                              "repository": "gcr.io/tpu", "image": "x",
+                              "version": f"0.1.{self.rng.randrange(10)}"}}})
+
+    def restart_apiserver(self):
+        old = self.srv_holder["srv"]
+        backend = old.backend
+        old.stop()
+        time.sleep(0.3)
+        fresh = MiniApiServer(backend=backend)
+        fresh.start(self.port)
+        self.srv_holder["srv"] = fresh
+
+    def restore_operands(self, wait_for):
+        for operand in ("telemetry", "featureDiscovery", "nodeStatusExporter"):
+            wait_for(lambda op=operand: self.client.patch(
+                "tpu.ai/v1", "ClusterPolicy", "cluster-policy",
+                {"spec": {op: {"enabled": True}}}) is not None,
+                timeout=10, message=f"re-enable {operand}")
+
+    def assert_converged(self, wait_for):
+        def all_nodes_schedulable():
+            return all(deep_get(self.client.get("v1", "Node", n), "status",
+                                "capacity", consts.TPU_RESOURCE_NAME) == "4"
+                       for n in self.live_nodes)
+        wait_for(all_nodes_schedulable, message="all surviving nodes schedulable")
+        wait_for(lambda: deep_get(
+            self.client.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy"),
+            "status", "state") == "ready", message="ready after chaos")
+
+
+def test_chaos_soak_with_ha_replicas_converges():
+    """The soak's churn composed with leader-elected HA: two operator
+    replicas, short leases, and a supervisor that replaces any replica
+    whose elector reports leadership lost (a lost leader exits in
+    production and the kubelet restarts the pod — a fresh process, not an
+    in-place restart). Apiserver restarts stall every renewal at once;
+    lease expiry mid-chaos hands leadership over; transient dual-reconcile
+    windows are tolerated by level-driven idempotence. Afterward the
+    cluster must converge exactly as in the single-operator soak."""
+    from tpu_operator.controllers.leader import LeaderElector
+
+    rng = random.Random(SEED + 1)
+    srv_holder = {}
+    srv = MiniApiServer()
+    base = srv.start()
+    srv_holder["srv"] = srv
+    port = int(base.rsplit(":", 1)[1])
+    chaos = RestClient(base_url=base)
+    kubelet = KubeletSimulator(chaos, interval=0.05).start()
+    kit = ChaosKit(chaos, rng, srv_holder, port)
+
+    replicas = {}
+    clients = []
+    spawn_seq = iter(range(10_000))
+
+    def spawn(slot):
+        op_client = CachedClient(RestClient(base_url=base))
+        clients.append(op_client)
+        app = OperatorApp(op_client)
+        elector = LeaderElector(RestClient(base_url=base), "tpu-operator",
+                                identity=f"{slot}-{next(spawn_seq)}",
+                                lease_duration=3.0, renew_period=0.75,
+                                retry_period=0.4)
+        dead = {"flag": False}
+
+        def on_lost(a=app, e=elector, d=dead):
+            # production exits the process here; this instance must never
+            # re-acquire (a stopped app cannot be restarted in place), so
+            # stop the elector FROM ITS OWN CALLBACK before the supervisor
+            # gets around to replacing us
+            d["flag"] = True
+            e._stop.set()
+            a.stop()
+
+        elector.run(on_started=app.start, on_stopped=on_lost)
+        replicas[slot] = {"app": app, "elector": elector, "dead": dead}
+
+    def kill_leader():
+        for replica in replicas.values():
+            if replica["elector"].is_leader.is_set():
+                # hard crash: no lease release; expiry hands over
+                replica["elector"]._stop.set()
+                replica["app"].stop()
+                replica["dead"]["flag"] = True
+                return
+
+    actions = [kit.add_node] * 3 + [kit.remove_node] + \
+        [kit.flip_operand] * 3 + [kit.delete_random_ds] * 2 + \
+        [kit.bump_driver] + [kit.restart_apiserver] + [kill_leader]
+
+    try:
+        kit.add_node()
+        chaos.create(new_cluster_policy())
+        spawn("a")
+        spawn("b")
+        wait_for(lambda: deep_get(
+            chaos.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy"),
+            "status", "state") == "ready", message="initial install ready")
+
+        deadline = time.monotonic() + SOAK_SECONDS
+        while time.monotonic() < deadline:
+            try:
+                rng.choice(actions)()
+            except (ApiError, requests.RequestException):
+                pass
+            # supervisor: replace dead replicas (kubelet-restart semantics)
+            for slot, replica in list(replicas.items()):
+                if replica["dead"]["flag"]:
+                    spawn(slot)
+            time.sleep(rng.uniform(0.05, 0.25))
+
+        kit.restore_operands(wait_for)
+        kit.assert_converged(wait_for)
+    finally:
+        for replica in replicas.values():
+            replica["elector"]._stop.set()
+            replica["app"].stop()
+        for op_client in clients:
+            op_client.stop()
+        kubelet.stop()
+        srv_holder["srv"].stop()
+
+
 def test_chaos_soak_converges():
     rng = random.Random(SEED)
     backend_holder = {}
@@ -69,57 +232,16 @@ def test_chaos_soak_converges():
     op_client = CachedClient(RestClient(base_url=base))
     kubelet = KubeletSimulator(chaos, interval=0.05).start()
     app = OperatorApp(op_client)
+    kit = ChaosKit(chaos, rng, backend_holder, port)
+    live_nodes = kit.live_nodes
 
-    node_ids = iter(range(10_000))
-    live_nodes = []
-
-    def add_node():
-        name = f"tpu-{next(node_ids)}"
-        chaos.create({"apiVersion": "v1", "kind": "Node",
-                      "metadata": {"name": name, "labels": dict(TPU_LABELS)},
-                      "status": {}})
-        live_nodes.append(name)
-
-    def remove_node():
-        if len(live_nodes) <= 1:
-            return
-        name = live_nodes.pop(rng.randrange(len(live_nodes)))
-        chaos.delete("v1", "Node", name)
-
-    def flip_operand():
-        operand = rng.choice(["telemetry", "featureDiscovery",
-                              "nodeStatusExporter"])
-        enabled = rng.random() < 0.5
-        chaos.patch("tpu.ai/v1", "ClusterPolicy", "cluster-policy",
-                    {"spec": {operand: {"enabled": enabled}}})
-
-    def delete_random_ds():
-        dses = chaos.list("apps/v1", "DaemonSet", "tpu-operator")
-        if dses:
-            victim = rng.choice(dses)["metadata"]["name"]
-            chaos.delete("apps/v1", "DaemonSet", victim, "tpu-operator")
-
-    def bump_driver():
-        version = f"0.1.{rng.randrange(10)}"
-        chaos.patch("tpu.ai/v1", "ClusterPolicy", "cluster-policy",
-                    {"spec": {"driver": {"repository": "gcr.io/tpu",
-                                         "image": "x", "version": version}}})
-
-    def restart_apiserver():
-        old = backend_holder["srv"]
-        backend = old.backend
-        old.stop()
-        time.sleep(0.3)
-        fresh = MiniApiServer(backend=backend)
-        fresh.start(port)
-        backend_holder["srv"] = fresh
-
-    actions = [add_node] * 3 + [remove_node] * 2 + [flip_operand] * 3 + \
-        [delete_random_ds] * 2 + [bump_driver] * 2 + [restart_apiserver]
+    actions = [kit.add_node] * 3 + [kit.remove_node] * 2 + \
+        [kit.flip_operand] * 3 + [kit.delete_random_ds] * 2 + \
+        [kit.bump_driver] * 2 + [kit.restart_apiserver]
 
     try:
-        add_node()
-        add_node()
+        kit.add_node()
+        kit.add_node()
         chaos.create(new_cluster_policy())
         app.start()
         wait_for(lambda: deep_get(
@@ -140,26 +262,9 @@ def test_chaos_soak_converges():
             time.sleep(rng.uniform(0.02, 0.2))
         assert steps > 20, "soak too short to mean anything"
 
-        # restore a known-good end state: every operand enabled (retry: a
-        # just-restarted apiserver may still be settling keep-alive sockets)
-        for operand in ("telemetry", "featureDiscovery", "nodeStatusExporter"):
-            wait_for(lambda op=operand: chaos.patch(
-                "tpu.ai/v1", "ClusterPolicy", "cluster-policy",
-                {"spec": {op: {"enabled": True}}}) is not None,
-                timeout=10, message=f"re-enable {operand}")
-
-        # -- convergence ---------------------------------------------------
-        def all_nodes_schedulable():
-            for name in live_nodes:
-                node = chaos.get("v1", "Node", name)
-                if deep_get(node, "status", "capacity",
-                            consts.TPU_RESOURCE_NAME) != "4":
-                    return False
-            return True
-        wait_for(all_nodes_schedulable, message="all surviving nodes schedulable")
-        wait_for(lambda: deep_get(
-            chaos.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy"),
-            "status", "state") == "ready", message="ready after chaos")
+        # restore a known-good end state, then full convergence
+        kit.restore_operands(wait_for)
+        kit.assert_converged(wait_for)
 
         def core_ds_healthy():
             for name in ("libtpu-driver", "tpu-device-plugin",

@@ -50,6 +50,15 @@ class LeaderElector:
         self.lease_duration = lease_duration
         self.renew_period = renew_period
         self.retry_period = retry_period
+        # How long we keep acting as leader when renewal is INDETERMINATE
+        # (apiserver unreachable / write races). Strictly less than what
+        # peers see: they compute expiry from the advertised integer
+        # leaseDurationSeconds and a second-truncated renewTime, so our
+        # float window measured post-RTT must undershoot it or two leaders
+        # legally overlap (client-go's renewDeadline < leaseDuration).
+        self.renew_deadline = max(
+            retry_period,
+            min(0.8 * lease_duration, max(1, int(lease_duration)) - 0.5))
         self.is_leader = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -69,7 +78,12 @@ class LeaderElector:
             },
         }
 
-    def try_acquire_or_renew(self) -> bool:
+    def try_acquire_or_renew(self) -> Optional[bool]:
+        """True = held/renewed; False = another replica DEFINITIVELY holds a
+        live lease; None = indeterminate (write race, lease vanished) — the
+        caller must not treat indeterminate as loss: a leader that steps
+        down on a benign resourceVersion race exits the process for
+        nothing, and the very next attempt would have renewed fine."""
         try:
             lease = self.client.get("coordination.k8s.io/v1", "Lease",
                                     self.lease_name, self.namespace)
@@ -78,7 +92,7 @@ class LeaderElector:
                 self.client.create(self._lease_obj())
                 return True
             except ApiError:
-                return False
+                return None  # racing another creator; retry resolves it
         spec = lease.get("spec", {})
         holder = spec.get("holderIdentity")
         if holder == self.identity:
@@ -97,7 +111,7 @@ class LeaderElector:
             self.client.update(lease)
             return True
         except (ConflictError, NotFoundError):
-            return False  # lost the write race
+            return None  # lost the write race; next attempt re-reads
 
     # -- loop -----------------------------------------------------------------
     def run(self, on_started: Callable[[], None],
@@ -108,14 +122,52 @@ class LeaderElector:
         self._thread.start()
 
     def _loop(self, on_started, on_stopped) -> None:
+        last_renew = 0.0
         while not self._stop.is_set():
-            if self.try_acquire_or_renew():
+            try:
+                acquired = self.try_acquire_or_renew()
+            except Exception:
+                # the elector thread must survive ANY apiserver failure
+                # (transport error, 500, 429): a dead elector is the worst
+                # outcome — a leader that reconciles forever without
+                # renewing while a standby takes over = split brain, and a
+                # standby that can never take over at all
+                log.warning("leader election: %s renew/acquire attempt "
+                            "failed; retrying", self.identity, exc_info=True)
+                acquired = None
+            now = time.monotonic()
+            if acquired:
+                last_renew = now
                 if not self.is_leader.is_set():
                     log.info("leader election: %s acquired leadership", self.identity)
                     self.is_leader.set()
-                    on_started()
+                    try:
+                        on_started()
+                    except Exception:
+                        # a leader that failed to start MUST step down loudly
+                        # — swallowing this leaves a renewed lease held by a
+                        # replica that reconciles nothing, and an unguarded
+                        # raise kills the elector thread with is_leader set
+                        # (zombie split-brain)
+                        log.exception("leader election: on_started failed; "
+                                      "relinquishing %s", self.identity)
+                        self.is_leader.clear()
+                        try:
+                            on_stopped()
+                        except Exception:
+                            log.exception("on_stopped also failed")
+                        self._stop.set()  # this instance is done (prod exits)
+                        return
                 self._stop.wait(self.renew_period)
+            elif (acquired is None and self.is_leader.is_set()
+                  and now - last_renew < self.renew_deadline):
+                # renewal indeterminate but within the deadline that is
+                # strictly inside what peers consider our live lease: still
+                # the leader — keep reconciling, retry promptly
+                self._stop.wait(self.retry_period)
             else:
+                # definitively rejected, or indeterminate past the renew
+                # deadline (a peer may legitimately take over soon)
                 if self.is_leader.is_set():
                     log.warning("leader election: %s LOST leadership", self.identity)
                     self.is_leader.clear()
