@@ -54,3 +54,8 @@ native:
 .PHONY: graft-check
 graft-check:
 	XLA_FLAGS="--xla_force_host_platform_device_count=8" $(PYTHON) __graft_entry__.py
+
+.PHONY: clean
+clean:
+	rm -rf build dist *.egg-info
+	find . -name __pycache__ -not -path "./.git/*" -exec rm -rf {} + 2>/dev/null || true

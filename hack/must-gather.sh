@@ -11,7 +11,8 @@
 #                                   extras (pod logs, exec'd barrier dumps)
 #
 # Sections: cluster/ crs/ operands/ nodes/ validation/ telemetry/ events/
-# plus manifest.json. See tpu_operator/cmd/must_gather.py for the layout.
+# operator/ plus manifest.json. See tpu_operator/cmd/must_gather.py for
+# the layout.
 set -uo pipefail
 
 ARTIFACT_DIR="${ARTIFACT_DIR:-/tmp/tpu-operator-must-gather-$(date +%s)}"
@@ -30,13 +31,14 @@ if [ -n "${BASE:-}" ] || ! command -v "${K%% *}" >/dev/null 2>&1; then
     ${STATUS_DIR_OVERRIDE:+--status-dir "$STATUS_DIR_OVERRIDE"}
 fi
 
-mkdir -p "$ARTIFACT_DIR"/{cluster,crs,operands/pods,nodes,validation/barriers,telemetry,events}
+mkdir -p "$ARTIFACT_DIR"/{cluster,crs,operands/pods,nodes,validation/barriers,telemetry,events,operator}
 echo "gathering into $ARTIFACT_DIR"
 manifest_entries=()
 error_entries=()
 
 collect() { # collect <section/relpath> <command...>
   local rel="$1"; shift
+  mkdir -p "$(dirname "$ARTIFACT_DIR/$rel")"  # per-pod subdirs etc.
   if "$@" > "$ARTIFACT_DIR/$rel" 2>&1; then
     manifest_entries+=("$rel")
   else
@@ -95,6 +97,18 @@ for pod in $($K -n "$NS" get pods -l app=tpu-telemetry-exporter -o name 2>/dev/n
   name="${pod#pod/}"
   collect "telemetry/$name.prom" \
     $K -n "$NS" get --raw "/api/v1/namespaces/$NS/pods/$name:$TPORT/proxy/metrics"
+done
+
+# operator/ — live self-diagnostics per operator pod via the API proxy
+# (same endpoints the Python collector's gather_operator scrapes)
+for pod in $($K -n "$NS" get pods -l app=tpu-operator -o name 2>/dev/null); do
+  name="${pod#pod/}"
+  collect "operator/$name/metrics.prom" \
+    $K -n "$NS" get --raw "/api/v1/namespaces/$NS/pods/$name:8080/proxy/metrics"
+  collect "operator/$name/threads.txt" \
+    $K -n "$NS" get --raw "/api/v1/namespaces/$NS/pods/$name:8081/proxy/debug/threads"
+  collect "operator/$name/informers.json" \
+    $K -n "$NS" get --raw "/api/v1/namespaces/$NS/pods/$name:8081/proxy/debug/informers"
 done
 
 # events/

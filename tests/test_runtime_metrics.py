@@ -69,7 +69,7 @@ def test_workqueue_and_reconcile_metrics():
 
 
 def test_queue_duration_excludes_deliberate_delay():
-    """A 0.3 s requeue delay must not be reported as 0.3 s of queueing —
+    """A deliberate requeue delay must not be reported as queueing —
     only time spent ready-but-unserved counts."""
     metrics = OperatorMetrics()
     recon = _Recon()
@@ -77,16 +77,16 @@ def test_queue_duration_excludes_deliberate_delay():
     controller.instrument(metrics)
     controller.start(FakeClient())
     try:
-        controller.queue.add(Request(name="a"), delay=1.0)
-        deadline = time.monotonic() + 10
+        controller.queue.add(Request(name="a"), delay=2.0)
+        deadline = time.monotonic() + 15
         while recon.calls < 1 and time.monotonic() < deadline:
             time.sleep(0.02)
         assert recon.calls == 1
         total = _sample(metrics, "tpu_operator_workqueue_queue_duration_seconds_sum",
                         name="test-recon")
-        # generous margin: only scheduler jitter should be observed, never
-        # the deliberate 1.0 s delay itself
-        assert total < 0.5, f"delay leaked into queue duration: {total}"
+        # a leak would observe >= the full 2.0 s delay; anything under half
+        # of it is scheduler jitter, even on a cold, contended CI machine
+        assert total < 1.0, f"delay leaked into queue duration: {total}"
     finally:
         controller.stop()
 
