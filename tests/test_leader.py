@@ -26,10 +26,13 @@ def test_second_elector_blocked_while_lease_live(fake_client):
 
 
 def test_takeover_after_expiry(fake_client):
-    a = elector(fake_client, "a", lease_duration=1.0)
-    b = elector(fake_client, "b", lease_duration=1.0)
+    # 2.0 s is the shortest lease the constructor accepts: renewTime is
+    # second-truncated on the wire, so a sub-2s lease can't leave a valid
+    # renew_deadline window (ValueError)
+    a = elector(fake_client, "a", lease_duration=2.0)
+    b = elector(fake_client, "b", lease_duration=2.0)
     assert a.try_acquire_or_renew()
-    time.sleep(2.1)  # a stops renewing (crashed); lease expires
+    time.sleep(2.2)  # a stops renewing (crashed); lease expires
     assert b.try_acquire_or_renew()
     lease = fake_client.get("coordination.k8s.io/v1", "Lease",
                             "tpu-operator-leader", "tpu-operator")
@@ -83,7 +86,7 @@ def test_elector_survives_apiserver_outage_within_lease(fake_client):
     fake_client.update = flaky_update
 
     transitions = {"started": 0, "stopped": 0}
-    e = elector(fake_client, "a", lease_duration=4.0)  # renew_deadline 3.2
+    e = elector(fake_client, "a", lease_duration=4.0)  # renew_deadline 2.5
     e.run(on_started=lambda: transitions.__setitem__("started", transitions["started"] + 1),
           on_stopped=lambda: transitions.__setitem__("stopped", transitions["stopped"] + 1))
     try:
@@ -121,3 +124,17 @@ def test_elector_survives_apiserver_outage_within_lease(fake_client):
         e.release()
         fake_client.get = real_get
         fake_client.update = real_update
+
+
+def test_unsatisfiable_retry_period_rejected(fake_client):
+    """A retry_period that leaves no indeterminate-renewal window inside
+    the lease would silently void renewDeadline < leaseDuration; the
+    constructor must refuse it rather than overlap two leaders."""
+    import pytest
+
+    with pytest.raises(ValueError):
+        elector(fake_client, "a", lease_duration=2.0, retry_period=1.9)
+    # satisfiable config: deadline strictly inside the lease
+    e = elector(fake_client, "a", lease_duration=15.0, retry_period=2.0)
+    assert e.renew_deadline < e.lease_duration
+    assert e.renew_deadline >= e.retry_period

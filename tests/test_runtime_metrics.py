@@ -227,3 +227,22 @@ def test_cached_client_stats_shape():
     assert row["kind"] == "Node" and row["synced"] and row["objects"] == 1
     assert row["scope"] == "all-namespaces" and row["subscribers"] == 0
     assert row["degraded"] is False
+
+
+def test_due_requeue_visible_at_scrape_without_queue_mutation():
+    """Depth is a scrape-time callback: a delayed requeue that becomes due
+    while no add()/get() happens must still read as backlog — recomputing
+    only on queue mutations under-reports ready-but-unserved items in quiet
+    clusters (TPUOperatorWorkqueueBacklog would never fire)."""
+    from tpu_operator.controllers.runtime import RateLimitingQueue
+
+    metrics = OperatorMetrics()
+    queue = RateLimitingQueue()
+    queue.instrument(metrics, "idle-recon")
+    queue.add(Request(name="r"), delay=0.05)
+    assert _sample(metrics, "tpu_operator_workqueue_depth",
+                   name="idle-recon") == 0.0  # still sleeping: scheduling
+    time.sleep(0.15)
+    # NO queue mutation since the add — the scrape alone must see it due
+    assert _sample(metrics, "tpu_operator_workqueue_depth",
+                   name="idle-recon") == 1.0

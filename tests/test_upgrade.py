@@ -825,3 +825,131 @@ def test_max_unavailable_percent_rounds_up(fake_client):
     cordoned = [n for n in fake_client.list("v1", "Node")
                 if n["spec"].get("unschedulable")]
     assert len(cordoned) == 2  # ceil(3 * 50%) = 2
+
+
+# -- drain target selection: ownership, not label presence -------------------
+
+def mk_user_pod(name, node, ns="ml-team", **kw):
+    pod = mk_pod(name, node, None, "user:1", **kw)
+    pod["metadata"]["namespace"] = ns
+    return pod
+
+
+def run_to_drain(fake_client, **machine_kw):
+    sm = machine(fake_client, drain={"enable": True}, **machine_kw)
+    sm.process(fresh_nodes(fake_client))  # -> upgrade-required
+    sm.process(fresh_nodes(fake_client))  # cordon -> ... -> drain/restart
+    return sm
+
+
+def test_user_pod_with_component_label_is_evicted(fake_client):
+    """app.kubernetes.io/component is a standard recommended label; a user
+    TPU workload carrying it (component=web) must NOT be mistaken for an
+    operator operand — the driver would restart under a pod still holding
+    chips (reference skips only DaemonSet/mirror pods,
+    drain_manager.go:76-82)."""
+    setup(fake_client)
+    pod = mk_user_pod("web-train", "tpu-0", tpu_limit=4)
+    pod["metadata"]["labels"]["app.kubernetes.io/component"] = "web"
+    fake_client.create(pod)
+    run_to_drain(fake_client)
+    names = [p["metadata"]["name"] for p in fake_client.list("v1", "Pod", "ml-team")]
+    assert "web-train" not in names, \
+        "user pod with component=web must be evicted during pod-deletion"
+
+
+def test_user_component_pod_drained_without_tpu(fake_client):
+    setup(fake_client)
+    pod = mk_user_pod("web-svc", "tpu-0")  # no TPU request at all
+    pod["metadata"]["labels"]["app.kubernetes.io/component"] = "web"
+    fake_client.create(pod)
+    run_to_drain(fake_client)
+    names = [p["metadata"]["name"] for p in fake_client.list("v1", "Pod", "ml-team")]
+    assert "web-svc" not in names, "drain must evict non-exempt user pods"
+
+
+def test_init_container_tpu_consumer_evicted(fake_client):
+    """A pod whose ONLY TPU request sits in an initContainer (init-time
+    preflight pattern) holds the chips just as hard during init."""
+    setup(fake_client)
+    pod = mk_user_pod("preflight", "tpu-0")
+    pod["spec"]["initContainers"] = [{
+        "name": "warmup",
+        "resources": {"limits": {consts.TPU_RESOURCE_NAME: "4"}}}]
+    fake_client.create(pod)
+    sm = machine(fake_client)
+    assert [p["metadata"]["name"] for p in sm._tpu_consumer_pods("tpu-0")] \
+        == ["preflight"]
+    run_to_drain(fake_client)
+    names = [p["metadata"]["name"] for p in fake_client.list("v1", "Pod", "ml-team")]
+    assert "preflight" not in names
+
+
+def test_tpu_requests_without_limits_counts(fake_client):
+    setup(fake_client)
+    pod = mk_user_pod("req-only", "tpu-0")
+    pod["spec"]["containers"][0]["resources"] = {
+        "requests": {consts.TPU_RESOURCE_NAME: "4"}}
+    fake_client.create(pod)
+    sm = machine(fake_client)
+    assert [p["metadata"]["name"] for p in sm._tpu_consumer_pods("tpu-0")] \
+        == ["req-only"]
+
+
+def test_daemonset_owned_user_pod_exempt_from_drain(fake_client):
+    """kubectl drain semantics: DaemonSet-managed pods are never drained —
+    the DS controller would recreate them instantly anyway."""
+    setup(fake_client)
+    pod = mk_user_pod("user-ds-pod", "tpu-0", tpu_limit=4)
+    pod["metadata"]["ownerReferences"] = [
+        {"kind": "DaemonSet", "name": "user-ds", "controller": True}]
+    fake_client.create(pod)
+    run_to_drain(fake_client)
+    assert fake_client.get("v1", "Pod", "user-ds-pod", "ml-team")
+
+
+def test_mirror_pod_exempt_from_drain(fake_client):
+    setup(fake_client)
+    pod = mk_user_pod("static-pod", "tpu-0")
+    pod["metadata"]["annotations"] = {
+        "kubernetes.io/config.mirror": "abc123"}
+    fake_client.create(pod)
+    run_to_drain(fake_client)
+    assert fake_client.get("v1", "Pod", "static-pod", "ml-team")
+
+
+def test_completed_pod_does_not_block_pod_deletion(fake_client):
+    """Succeeded/Failed pods no longer hold devices; they must not gate the
+    upgrade (reference gpuPodSpecFilter accepts only Running/Pending)."""
+    setup(fake_client)
+    pod = mk_user_pod("done-job", "tpu-0", tpu_limit=4, phase="Succeeded")
+    fake_client.create(pod)
+    sm = machine(fake_client)
+    assert sm._tpu_consumer_pods("tpu-0") == []
+
+
+def test_operand_impersonation_outside_namespace_not_exempt(fake_client):
+    """component=tpu-driver in a USER namespace is not ours: the exemption
+    requires the operator namespace (or a DS ownerRef)."""
+    setup(fake_client)
+    pod = mk_user_pod("fake-driver", "tpu-0", tpu_limit=4)
+    pod["metadata"]["labels"]["app.kubernetes.io/component"] = "tpu-driver"
+    fake_client.create(pod)
+    sm = machine(fake_client)
+    assert [p["metadata"]["name"] for p in sm._tpu_consumer_pods("tpu-0")] \
+        == ["fake-driver"]
+
+
+def test_operand_components_set_matches_manifests():
+    """OPERAND_COMPONENTS drifting from the manifest templates would turn
+    the drain exemption into either a hole (missing value -> we evict our
+    own operand) or a shadow (stale value -> never matches)."""
+    import pathlib
+    import re
+
+    manifest_root = pathlib.Path(m.__file__).parents[1] / "manifests"
+    found = set()
+    for ds_file in manifest_root.glob("*/0500_daemonset.yaml"):
+        found.update(re.findall(
+            r"app\.kubernetes\.io/component:\s*(\S+)", ds_file.read_text()))
+    assert found == set(m.OPERAND_COMPONENTS)

@@ -362,13 +362,13 @@ class CachedClient(Client):
         return self._apply_write(self.inner.update_status(obj))
 
     def delete(self, api_version, kind, name, namespace=None) -> None:
+        # No optimistic tombstone (mirrors evict): a real apiserver delete of
+        # an object with finalizers or a grace period only marks it
+        # Terminating — removing it from the cache here would make cached
+        # get()/list() report it gone while it still exists, until the next
+        # watch MODIFIED event resurrected it. The watch DELETED event is
+        # the one source of truth for removal.
         self.inner.delete(api_version, kind, name, namespace)
-        ns = (namespace or "default") if self.scheme.is_namespaced(api_version, kind) else ""
-        self._apply_delete(api_version, kind, name, ns)
-
-    def _apply_delete(self, api_version: str, kind: str, name: str, ns: str) -> None:
-        for informer in self._matching_informers(api_version, kind, ns):
-            informer.apply("DELETED", {"metadata": {"namespace": ns, "name": name}})
 
     def evict(self, name: str, namespace: Optional[str] = None) -> None:
         # no optimistic remove: eviction starts graceful termination — the

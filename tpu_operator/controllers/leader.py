@@ -53,12 +53,27 @@ class LeaderElector:
         # How long we keep acting as leader when renewal is INDETERMINATE
         # (apiserver unreachable / write races). Strictly less than what
         # peers see: they compute expiry from the advertised integer
-        # leaseDurationSeconds and a second-truncated renewTime, so our
-        # float window measured post-RTT must undershoot it or two leaders
-        # legally overlap (client-go's renewDeadline < leaseDuration).
-        self.renew_deadline = max(
-            retry_period,
-            min(0.8 * lease_duration, max(1, int(lease_duration)) - 0.5))
+        # leaseDurationSeconds and a second-truncated renewTime — up to a
+        # full second earlier than our wall clock at the write — so the
+        # margin must absorb that truncation plus slack, and the hold
+        # window is anchored at the monotonic instant BEFORE the renew RPC
+        # (client-go stamps the observation time pre-request). A
+        # retry_period that leaves no such window would silently void the
+        # renewDeadline < leaseDuration invariant, so it is an error.
+        margin = 1.5  # 1 s renewTime truncation + 0.5 s slack
+        self.renew_deadline = min(0.8 * lease_duration,
+                                  lease_duration - margin)
+        # renew_period matters too: after a SUCCESSFUL renew the loop
+        # sleeps renew_period, so a renew_period past the deadline means
+        # the very next indeterminate attempt finds the window already
+        # expired and steps down on a single transient blip
+        if self.renew_deadline < max(retry_period, renew_period):
+            raise ValueError(
+                f"retry_period={retry_period}/renew_period={renew_period} "
+                f"leave no indeterminate-renewal window inside "
+                f"lease_duration={lease_duration} (renew_deadline would be "
+                f"{self.renew_deadline:.2f}s); raise lease_duration or "
+                f"lower the periods")
         self.is_leader = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -124,6 +139,12 @@ class LeaderElector:
     def _loop(self, on_started, on_stopped) -> None:
         last_renew = 0.0
         while not self._stop.is_set():
+            # Pessimistic anchor: peers measure our lease from the renewTime
+            # stamped BEFORE the update RPC lands, so the hold window must
+            # start from before the call, not after a slow-but-successful
+            # round trip (a post-RTT anchor lets a leader outlive the window
+            # a standby legally takes over in).
+            start = time.monotonic()
             try:
                 acquired = self.try_acquire_or_renew()
             except Exception:
@@ -137,7 +158,7 @@ class LeaderElector:
                 acquired = None
             now = time.monotonic()
             if acquired:
-                last_renew = now
+                last_renew = start
                 if not self.is_leader.is_set():
                     log.info("leader election: %s acquired leadership", self.identity)
                     self.is_leader.set()

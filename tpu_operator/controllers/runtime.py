@@ -56,9 +56,24 @@ class RateLimitingQueue:
         self._name = ""
 
     def instrument(self, metrics, name: str) -> None:
-        """Attach workqueue metrics (controller-runtime's workqueue family)."""
+        """Attach workqueue metrics (controller-runtime's workqueue family).
+        Depth is a scrape-time callback, not a mutation-time set: a delayed
+        requeue that becomes due while the worker is busy elsewhere must
+        show up as backlog at the next scrape even though no queue mutation
+        happened — otherwise the TPUOperatorWorkqueueBacklog alert
+        under-reports ready-but-unserved items in quiet clusters."""
         self._metrics = metrics
         self._name = name
+        metrics.workqueue_depth.labels(name=name).set_function(self._due_depth)
+
+    def _due_depth(self) -> int:
+        """client-go semantics: depth counts only the ACTIVE queue. Items
+        sleeping out a requeue_after/backoff delay are not backlog — a
+        healthy idle operator with periodic resyncs must read depth 0, not
+        one per controller forever (any depth>0 alert would never clear)."""
+        now = time.monotonic()
+        with self._cond:
+            return sum(1 for d in self._due.values() if d <= now)
 
     def add(self, request: Request, delay: float = 0.0) -> None:
         """Enqueue; re-adding a pending request keeps the EARLIER due time
@@ -75,19 +90,7 @@ class RateLimitingQueue:
             self._due[request] = due
             self._seq += 1
             heapq.heappush(self._heap, (due, self._seq, request))
-            self._set_depth_locked()
             self._cond.notify()
-
-    def _set_depth_locked(self) -> None:
-        """client-go semantics: depth counts only the ACTIVE queue. Items
-        sleeping out a requeue_after/backoff delay are not backlog — a
-        healthy idle operator with periodic resyncs must read depth 0, not
-        one per controller forever (any depth>0 alert would never clear)."""
-        if self._metrics is None:
-            return
-        now = time.monotonic()
-        depth = sum(1 for d in self._due.values() if d <= now)
-        self._metrics.workqueue_depth.labels(name=self._name).set(depth)
 
     def add_rate_limited(self, request: Request) -> None:
         failures = self._failures.get(request, 0)
@@ -118,7 +121,6 @@ class RateLimitingQueue:
                         # +Inf on a healthy system)
                         self._metrics.workqueue_queue_duration.labels(
                             name=self._name).observe(max(0.0, now - due))
-                        self._set_depth_locked()
                     return request
                 wait = self._heap[0][0] - now if self._heap else None
                 if deadline is not None:

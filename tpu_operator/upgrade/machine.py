@@ -62,6 +62,20 @@ IN_PROGRESS_STATES = (CORDON_REQUIRED, WAIT_FOR_JOBS_REQUIRED,
 DRIVER_COMPONENT = "tpu-driver"
 VALIDATOR_COMPONENT = "tpu-operator-validator"
 
+#: every app.kubernetes.io/component value the operator's own operand
+#: DaemonSets stamp on their pods (manifests/*/0500_daemonset.yaml). The
+#: drain/pod-deletion sweeps exempt ONLY these (in the operator namespace)
+#: plus DaemonSet-owned and mirror pods — label *presence* is not ownership:
+#: app.kubernetes.io/component is a standard recommended label and a user
+#: TPU workload labeled component=web must still be drained (reference
+#: drain_manager.go:76-82 skips only DaemonSet + mirror pods).
+#: tests/test_upgrade.py pins this set against the manifest templates.
+OPERAND_COMPONENTS = frozenset({
+    "tpu-driver", "tpu-device-plugin", "tpu-operator-validator",
+    "tpu-telemetry", "tpu-feature-discovery", "tpu-slice-partitioner",
+    "tpu-node-status-exporter",
+})
+
 
 def node_upgrade_state(node: dict) -> str:
     return deep_get(node, "metadata", "labels", consts.UPGRADE_STATE_LABEL, default=UNKNOWN)
@@ -199,17 +213,53 @@ class UpgradeStateMachine:
         self.client.patch("v1", "Node", node["metadata"]["name"],
                           {"spec": {"unschedulable": unschedulable or None}})
 
+    @staticmethod
+    def _daemonset_owned(pod: dict) -> bool:
+        return any(ref.get("kind") == "DaemonSet" and ref.get("controller")
+                   for ref in deep_get(pod, "metadata", "ownerReferences",
+                                       default=[]) or [])
+
+    @staticmethod
+    def _mirror_pod(pod: dict) -> bool:
+        return bool(deep_get(pod, "metadata", "annotations",
+                             "kubernetes.io/config.mirror"))
+
+    def _drain_exempt(self, pod: dict) -> bool:
+        """Pods the drain/pod-deletion sweeps never target: DaemonSet-owned
+        and static (mirror) pods — kubectl drain semantics, the reference's
+        IgnoreAllDaemonSets:true (drain_manager.go:76-82) — plus the
+        operator's own operand pods identified by namespace AND a component
+        value from OPERAND_COMPONENTS (not mere label presence)."""
+        if self._daemonset_owned(pod) or self._mirror_pod(pod):
+            return True
+        component = deep_get(pod, "metadata", "labels",
+                             "app.kubernetes.io/component")
+        return (pod["metadata"].get("namespace") == self.namespace
+                and component in OPERAND_COMPONENTS)
+
+    @staticmethod
+    def _requests_tpu(pod: dict) -> bool:
+        """TPU consumption in ANY container — initContainers too (an
+        init-time preflight holding the chips blocks a driver restart just
+        as hard), and requests as well as limits (reference
+        gpuPodSpecFilter, cmd/gpu-operator/main.go:211-233)."""
+        spec = pod.get("spec", {}) or {}
+        for ctr in ((spec.get("containers") or [])
+                    + (spec.get("initContainers") or [])):
+            resources = ctr.get("resources") or {}
+            for section in ("limits", "requests"):
+                if consts.TPU_RESOURCE_NAME in (resources.get(section) or {}):
+                    return True
+        return False
+
     def _tpu_consumer_pods(self, node_name: str) -> List[dict]:
-        out = []
-        for pod in self._pods_on(node_name, all_namespaces=True):
-            if deep_get(pod, "metadata", "labels", "app.kubernetes.io/component"):
-                continue  # our own operands
-            for ctr in deep_get(pod, "spec", "containers", default=[]):
-                limits = deep_get(ctr, "resources", "limits", default={}) or {}
-                if consts.TPU_RESOURCE_NAME in limits:
-                    out.append(pod)
-                    break
-        return out
+        """Pods on the node actively holding TPU chips that the upgrade must
+        clear out. Completed pods (Succeeded/Failed) no longer hold devices;
+        a missing phase (minimal fixtures) is treated as live."""
+        return [pod for pod in self._pods_on(node_name, all_namespaces=True)
+                if not self._drain_exempt(pod)
+                and deep_get(pod, "status", "phase") not in ("Succeeded", "Failed")
+                and self._requests_tpu(pod)]
 
     def _delete_pod(self, pod: dict) -> None:
         try:
@@ -538,9 +588,8 @@ class UpgradeStateMachine:
                     sel_key, _, sel_value = drain.pod_selector.partition("=")
                     targets = []
                     for pod in self._pods_on(name, all_namespaces=True):
-                        if deep_get(pod, "metadata", "labels",
-                                    "app.kubernetes.io/component"):
-                            continue  # operand DS pods stay (kubectl drain ignores DS)
+                        if self._drain_exempt(pod):
+                            continue  # DS-owned/mirror/our operands stay
                         if sel_key and deep_get(pod, "metadata", "labels",
                                                 sel_key) != (sel_value or None):
                             continue
