@@ -176,3 +176,43 @@ def test_psa_disabled_leaves_namespace_alone(fake_client):
     labels = fake_client.get("v1", "Namespace",
                              "tpu-operator")["metadata"].get("labels", {})
     assert not any(k.startswith("pod-security") for k in labels)
+
+
+def test_slice_partition_failure_surfaces_on_cr(fake_client):
+    """A node whose partitioner rejected its desired split
+    (tpu.ai/slice.config.state=failed) must surface as a
+    SlicePartitionFailed condition + Warning Event on the ClusterPolicy —
+    an impossible split is invisible if it only lives in node labels."""
+    from tpu_operator.conditions import SLICE_PARTITION_FAILED
+
+    fake_client.create(new_cluster_policy())
+    labels = dict(GKE_TPU_LABELS)
+    labels[consts.TPU_SLICE_CONFIG_LABEL] = "bad-partition"
+    labels[consts.TPU_SLICE_STATE_LABEL] = "failed"
+    fake_client.create(mk_node("tpu-1", labels))
+    r = ClusterPolicyReconciler(fake_client)
+    kubelet = KubeletSimulator(fake_client)
+
+    r.reconcile(Request("cluster-policy"))
+    kubelet.tick()
+    r.reconcile(Request("cluster-policy"))
+    live = get_policy(fake_client)
+    cond = get_condition(live, SLICE_PARTITION_FAILED)
+    assert cond is not None and cond["status"] == "True"
+    assert "tpu-1" in cond["message"]
+    event_reasons = [e.get("reason") for e in fake_client.list("v1", "Event",
+                                                               "tpu-operator")]
+    assert "SlicePartitionFailed" in event_reasons
+    # exactly one Event for the same persistent failure across sweeps
+    r.reconcile(Request("cluster-policy"))
+    event_reasons = [e.get("reason") for e in fake_client.list("v1", "Event",
+                                                               "tpu-operator")]
+    assert event_reasons.count("SlicePartitionFailed") == 1
+
+    # partitioner recovers -> condition clears
+    fake_client.patch("v1", "Node", "tpu-1", {"metadata": {"labels": {
+        consts.TPU_SLICE_STATE_LABEL: "success"}}})
+    r.reconcile(Request("cluster-policy"))
+    live = get_policy(fake_client)
+    cond = get_condition(live, SLICE_PARTITION_FAILED)
+    assert cond is not None and cond["status"] == "False"

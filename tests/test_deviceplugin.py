@@ -256,3 +256,20 @@ def test_prefer_compact_function():
     # (2,3) adjacent (dist 1) beats (1,2) diagonal (dist 2); (1,3) dist 1 ties
     # (2,3) -> lexical tie-break picks ("tpu-1","tpu-3")
     assert picked == ["tpu-1", "tpu-3"]
+
+
+def test_prefer_compact_uses_real_grid():
+    """With the partitioner-published host grid, the compactness metric
+    prefers a true 2x2 ICI box over a 1x4 row of the same size (the row
+    pays longer worst-case hop counts on every collective)."""
+    from tpu_operator.deviceplugin.plugin import _dispersion, prefer_compact
+
+    chips_of = {f"tpu-{i}": [i] for i in range(8)}
+    grid = (2, 4)  # v5e 8-chip host
+    picked = prefer_compact([f"tpu-{i}" for i in range(8)], [], 4,
+                            chips_of, grid)
+    assert sorted(picked) == ["tpu-0", "tpu-1", "tpu-4", "tpu-5"]  # 2x2 box
+    # sanity: the box really is tighter than the row under the metric
+    box = _dispersion(["tpu-0", "tpu-1", "tpu-4", "tpu-5"], chips_of, 8, grid)
+    row = _dispersion(["tpu-0", "tpu-1", "tpu-2", "tpu-3"], chips_of, 8, grid)
+    assert box < row

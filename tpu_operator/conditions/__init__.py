@@ -24,6 +24,13 @@ REASON_NO_TPU_NODES = "NoTPUNodes"
 REASON_DISCOVERY_LABELS_MISSING = "DiscoveryLabelsMissing"
 REASON_CONFLICTING_NODE_SELECTOR = "ConflictingNodeSelector"
 REASON_DRIVER_NOT_READY = "DriverNotReady"
+REASON_SLICE_PARTITION_FAILED = "SlicePartitionFailed"
+
+#: auxiliary condition type: a node's slice partitioner rejected its
+#: desired partition (tpu.ai/slice.config.state=failed) — surfaced on the
+#: ClusterPolicy so an impossible split is visible without scraping node
+#: labels (MIG analog: mig.config.state=failed)
+SLICE_PARTITION_FAILED = "SlicePartitionFailed"
 
 
 def make_condition(type_: str, status: str, reason: str, message: str = "") -> dict:

@@ -21,10 +21,16 @@ from ..client.errors import ConflictError, NotFoundError
 from ..client.interface import Client, WatchEvent
 from ..conditions import (
     REASON_OPERAND_NOT_READY,
+    REASON_READY,
     REASON_RECONCILE_FAILED,
+    REASON_SLICE_PARTITION_FAILED,
+    SLICE_PARTITION_FAILED,
+    get_condition,
     is_new_error,
+    make_condition,
     mark_error,
     mark_ready,
+    set_condition,
 )
 from ..nodeinfo import label_tpu_nodes
 from ..state.manager import (
@@ -117,6 +123,36 @@ class ClusterPolicyReconciler(Reconciler):
             self.metrics.reconciliation_status.set(0)
             raise
 
+    def _surface_slice_failures(self, policy: ClusterPolicy,
+                                nodes: List[dict]) -> None:
+        """A node whose slice partitioner rejected its desired partition
+        (impossible split -> tpu.ai/slice.config.state=failed) must be
+        visible on the CR, not only as a node label: auxiliary
+        SlicePartitionFailed condition + a Warning Event on transition.
+        The condition rides the same status write as Ready/Error (set
+        later this sweep), so readers never see it detached."""
+        failed = sorted(
+            n["metadata"]["name"] for n in nodes
+            if deep_get(n, "metadata", "labels",
+                        consts.TPU_SLICE_STATE_LABEL) == "failed")
+        conditions = policy.obj.setdefault("status", {}).setdefault(
+            "conditions", [])
+        current = get_condition(policy.obj, SLICE_PARTITION_FAILED)
+        if failed:
+            message = ("slice partition rejected on node(s): "
+                       + ", ".join(failed))
+            if (current is None or current.get("status") != "True"
+                    or current.get("message") != message):
+                events.record(self.client, self.namespace, policy.obj,
+                              events.WARNING, REASON_SLICE_PARTITION_FAILED,
+                              message)
+            set_condition(conditions, make_condition(
+                SLICE_PARTITION_FAILED, "True",
+                REASON_SLICE_PARTITION_FAILED, message))
+        elif current is not None and current.get("status") == "True":
+            set_condition(conditions, make_condition(
+                SLICE_PARTITION_FAILED, "False", REASON_READY, ""))
+
     def _reconcile(self, request: Request) -> Result:
         start = time.monotonic()
         try:
@@ -139,6 +175,10 @@ class ClusterPolicyReconciler(Reconciler):
         catalog[INFO_NODES] = label_result.nodes
 
         results = self.state_manager.sync_state(catalog)
+        # after the (crash-prone) state sweep, right before the status
+        # writes: an exception between the Warning Event and the condition
+        # landing on the CR would re-emit the event every backoff retry
+        self._surface_slice_failures(policy, label_result.nodes)
         previous_state = deep_get(policy.obj, "status", "state")
 
         if results.ready:

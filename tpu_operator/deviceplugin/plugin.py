@@ -59,8 +59,17 @@ class Unit:
     health: str = HEALTHY
 
 
-def discover_units(handoff_dir: str = DEFAULT_HANDOFF_DIR) -> List[Unit]:
-    handoff = read_handoff(handoff_dir)
+_READ_HANDOFF = object()  # sentinel: "read the file yourself"
+
+
+def discover_units(handoff_dir: str = DEFAULT_HANDOFF_DIR,
+                   handoff=_READ_HANDOFF) -> List[Unit]:
+    """Units from an already-parsed handoff dict when given — including an
+    explicit None for "observed absent" (so one read serves both grid and
+    groups; a second read could pair them across two file versions) —
+    else from the handoff file."""
+    if handoff is _READ_HANDOFF:
+        handoff = read_handoff(handoff_dir)
     if handoff and handoff.get("groups"):
         return [Unit(id=f"tpu-part-{i}", chips=list(g.get("chips", [])),
                      topology=g.get("topology", ""))
@@ -69,24 +78,34 @@ def discover_units(handoff_dir: str = DEFAULT_HANDOFF_DIR) -> List[Unit]:
             for i in range(len(discover_devices()))]
 
 
-def _chip_coords(chip: int, total: int) -> tuple:
-    """Host-local ICI grid coordinates. TPU VM hosts arrange their chips in a
-    2-row grid (e.g. v5e ct5lp 4 chips = 2x2, v4 hosts 4 chips = 2x2); odd
-    counts degrade to a line, which keeps the metric monotone anyway."""
+def _chip_coords(chip: int, total: int, grid: Optional[tuple] = None) -> tuple:
+    """Host-local ICI grid coordinates. When the partitioner published the
+    generation's real grid in the handoff file, use it (row-major chip ids,
+    the partitioner/topology.py convention); otherwise fall back to the
+    2-row guess (v5e ct5lp 4 chips = 2x2, v4 hosts 4 chips = 2x2; odd
+    counts degrade to a line, which keeps the metric monotone anyway)."""
+    if grid:
+        coords = []
+        for g in reversed(grid):
+            coords.append(chip % g)
+            chip //= g
+        return tuple(reversed(coords))
     cols = max(total // 2, 1) if total % 2 == 0 else total
     return (chip // cols, chip % cols)
 
 
-def _dispersion(device_ids, chips_of, total: int) -> int:
+def _dispersion(device_ids, chips_of, total: int,
+                grid: Optional[tuple] = None) -> int:
     """Sum of pairwise Manhattan distances between all chips of the chosen
     devices on the host grid — lower means more ICI-adjacent."""
     chips = [c for d in device_ids for c in chips_of.get(d, [])]
-    coords = [_chip_coords(c, total) for c in chips]
-    return sum(abs(a[0] - b[0]) + abs(a[1] - b[1])
+    coords = [_chip_coords(c, total, grid) for c in chips]
+    return sum(sum(abs(x - y) for x, y in zip(a, b))
                for i, a in enumerate(coords) for b in coords[i + 1:])
 
 
-def prefer_compact(available, must_include, size: int, chips_of) -> list:
+def prefer_compact(available, must_include, size: int, chips_of,
+                   grid: Optional[tuple] = None) -> list:
     """Pick `size` device IDs preferring ICI-compact chip subsets.
 
     The kubelet's default allocator is topology-blind; on a multi-chip host a
@@ -107,7 +126,7 @@ def prefer_compact(available, must_include, size: int, chips_of) -> list:
         return must + rest[:need]
     best = min(itertools.combinations(rest, need),
                key=lambda combo: (_dispersion(must + list(combo), chips_of,
-                                              total_chips), combo))
+                                              total_chips, grid), combo))
     return must + list(best)
 
 
@@ -136,6 +155,8 @@ class TPUDevicePlugin:
         #: having been seen; None while present/never-seen
         self._workload_gone_at: Optional[float] = None
         self._units: Dict[str, Unit] = {}
+        #: real host ICI grid from the partitioner handoff (None = guess)
+        self._grid: Optional[tuple] = None
         self._watchers: List["queue.Queue[List[Unit]]"] = []
         self._lock = threading.Lock()
         self._server: Optional[grpc.Server] = None
@@ -182,10 +203,15 @@ class TPUDevicePlugin:
     def refresh_units(self) -> bool:
         """Re-enumerate; returns True (and notifies watchers) on change."""
         health = self._validation_health()
-        fresh = {u.id: u for u in discover_units(self.handoff_dir)}
+        handoff = read_handoff(self.handoff_dir)
+        grid = tuple(handoff["grid"]) if handoff and handoff.get("grid") \
+            else None
+        fresh = {u.id: u
+                 for u in discover_units(self.handoff_dir, handoff=handoff)}
         for u in fresh.values():
             u.health = health
         with self._lock:
+            self._grid = grid
             if {k: (v.chips, v.health) for k, v in fresh.items()} == \
                {k: (v.chips, v.health) for k, v in self._units.items()}:
                 return False
@@ -232,11 +258,12 @@ class TPUDevicePlugin:
         responses = []
         with self._lock:
             chips_of = {u.id: u.chips for u in self._units.values()}
+            grid = self._grid
         for creq in request.container_requests:
             picked = prefer_compact(
                 sorted(creq.available_deviceIDs),
                 list(creq.must_include_deviceIDs),
-                creq.allocation_size, chips_of)
+                creq.allocation_size, chips_of, grid)
             responses.append(pb.ContainerPreferredAllocationResponse(
                 deviceIDs=picked))
         return pb.PreferredAllocationResponse(container_responses=responses)

@@ -29,6 +29,7 @@ import yaml
 from .. import consts
 from ..utils import deep_get
 from ..validator.driver import discover_devices
+from . import topology
 
 log = logging.getLogger(__name__)
 
@@ -53,35 +54,33 @@ def load_config(path: str) -> Dict[str, List[dict]]:
     return partitions
 
 
-def compute_partition(layout: List[dict], total_chips: int) -> List[dict]:
-    """Expand a named layout into explicit chip-id groups."""
-    groups: List[dict] = []
-    next_chip = 0
-    for entry in layout or []:
-        chips = int(entry.get("chips", 1))
-        if chips <= 0:
-            raise PartitionError(f"invalid chips count {chips}")
-        count = entry.get("count", 1)
-        n = (total_chips - next_chip) // chips if count == "all" else int(count)
-        for _ in range(n):
-            if next_chip + chips > total_chips:
-                raise PartitionError(
-                    f"layout needs more than {total_chips} chips")
-            groups.append({
-                "topology": entry.get("topology", f"1x{chips}"),
-                "chips": list(range(next_chip, next_chip + chips)),
-            })
-            next_chip += chips
-    return groups
+def compute_partition(layout: List[dict], total_chips: int,
+                      accelerator: str) -> List[dict]:
+    """Expand a named layout into explicit chip-id groups, validated
+    against the generation's physical ICI grid: every group is an
+    axis-aligned box on the host grid (provably adjacent) and its topology
+    string is DERIVED from the placed shape, never copied from config
+    (reference: only vendor-validated MIG profiles apply,
+    object_controls.go:2410-2422). See topology.tile_partition."""
+    try:
+        return topology.tile_partition(accelerator, total_chips, layout)
+    except topology.TopologyError as e:
+        raise PartitionError(str(e)) from e
 
 
 def write_handoff(groups: List[dict], name: str,
-                  handoff_dir: str = DEFAULT_HANDOFF_DIR) -> str:
+                  handoff_dir: str = DEFAULT_HANDOFF_DIR,
+                  grid: Optional[tuple] = None) -> str:
     os.makedirs(handoff_dir, exist_ok=True)
     path = os.path.join(handoff_dir, HANDOFF_FILE)
     tmp = path + ".tmp"
+    payload = {"partition": name, "groups": groups, "applied_at": time.time()}
+    if grid:
+        # the device plugin's GetPreferredAllocation compactness metric
+        # reads the real host grid instead of guessing from chip count
+        payload["grid"] = list(grid)
     with open(tmp, "w") as f:
-        json.dump({"partition": name, "groups": groups, "applied_at": time.time()}, f)
+        json.dump(payload, f)
     os.replace(tmp, path)  # the device plugin must never read a torn file
     return path
 
@@ -130,8 +129,19 @@ def sync_once(client, node_name: str, config_path: str,
             total_chips = int(chips_label) if chips_label else len(discover_devices())
         if total_chips <= 0:
             raise PartitionError("no TPU chips discoverable on this node")
-        groups = compute_partition(table[desired], total_chips)
-        write_handoff(groups, desired, handoff_dir)
+        accelerator = (labels.get(consts.GKE_TPU_ACCELERATOR_LABEL)
+                       or labels.get(consts.TPU_CHIP_TYPE_LABEL, ""))
+        if not accelerator:
+            # bootstrap window, not a failure: on non-GKE nodes the
+            # generation label arrives with feature discovery; stay
+            # pending (we retry every sleep_interval) instead of minting
+            # a SlicePartitionFailed condition on every fresh node
+            log.info("partition %s on %s: generation label not yet "
+                     "present; pending", desired, node_name)
+            return STATE_PENDING
+        groups = compute_partition(table[desired], total_chips, accelerator)
+        write_handoff(groups, desired, handoff_dir,
+                      grid=topology.host_grid(accelerator, total_chips))
         set_state(STATE_SUCCESS)
         log.info("partition %s applied on %s: %d group(s)", desired, node_name, len(groups))
         return STATE_SUCCESS

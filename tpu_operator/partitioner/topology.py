@@ -1,0 +1,203 @@
+"""Per-generation physical chip layouts and adjacency-validated partitioning.
+
+The MIG analog in the reference applies only vendor-validated profiles
+(applyMIGConfiguration, controllers/object_controls.go:2410-2422) — a config
+cannot invent a slice geometry the hardware doesn't have. The TPU equivalent:
+every host generation has a fixed ICI chip grid, and a partition group is
+only real if its chips form an axis-aligned contiguous box on that grid.
+Sequential chip-id ranges are NOT generally adjacent — on a v5e 2x4 host,
+chips [0,1,2,3] are one full row (a 1x4 line), while a true 2x2 sub-slice is
+[0,1,4,5] (two chips from each row). Advertising the former as "2x2" would
+make GetPreferredAllocation's compactness metric rest on a fiction.
+
+Chip-id convention: row-major over the host grid (chip id = index into the
+grid flattened along the last axis fastest), matching the device enumeration
+order of /dev/accel* on TPU VMs.
+
+Grids and host sizes (public TPU VM shapes):
+  v2/v3   4 chips/host, 2x2 mesh
+  v4/v5p  4 chips/host, 2x2x1 (one z-layer of the 3D torus)
+  v5e/v6e 1, 4 or 8 chips/host (ct5lp-hightpu-1t/-4t/-8t): 1x1, 2x2, 2x4
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+class TopologyError(ValueError):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class HostLayout:
+    """Physical chip arrangement of one host generation."""
+
+    #: host chip-count -> ICI grid dims (row-major chip ids)
+    grids: Dict[int, Tuple[int, ...]]
+    #: group chip-count -> canonical sub-slice box, used when a layout entry
+    #: does not declare a topology (the vendor-validated profile set)
+    canonical: Dict[int, Tuple[int, ...]]
+
+
+_V5E = HostLayout(
+    grids={1: (1, 1), 4: (2, 2), 8: (2, 4)},
+    canonical={1: (1, 1), 2: (1, 2), 4: (2, 2), 8: (2, 4)},
+)
+_2X2 = HostLayout(
+    grids={1: (1, 1), 4: (2, 2)},
+    canonical={1: (1, 1), 2: (1, 2), 4: (2, 2)},
+)
+_2X2X1 = HostLayout(
+    grids={1: (1, 1, 1), 4: (2, 2, 1)},
+    canonical={1: (1, 1, 1), 2: (1, 2, 1), 4: (2, 2, 1)},
+)
+
+#: accelerator-label value -> layout; both our feature-discovery spellings
+#: (validator/feature_discovery.py _KIND_TO_TYPE) and the GKE
+#: cloud.google.com/gke-tpu-accelerator values are accepted
+GENERATIONS: Dict[str, HostLayout] = {
+    "tpu-v2": _2X2,
+    "tpu-v3": _2X2,
+    "tpu-v4": _2X2X1,
+    "tpu-v4-podslice": _2X2X1,
+    "tpu-v5p-slice": _2X2X1,
+    "tpu-v5-lite-podslice": _V5E,
+    "tpu-v5-lite-device": _V5E,
+    "tpu-v6e-slice": _V5E,
+}
+
+
+def host_grid(accelerator: str, total_chips: int) -> Tuple[int, ...]:
+    """The ICI grid of this host, or TopologyError when the generation or
+    chip count has no known physical layout (we refuse to guess — an
+    invented grid would re-create the fiction this module exists to kill)."""
+    layout = GENERATIONS.get(accelerator)
+    if layout is None:
+        raise TopologyError(
+            f"unknown TPU generation {accelerator!r}; cannot validate "
+            f"partition adjacency (known: {sorted(GENERATIONS)})")
+    grid = layout.grids.get(total_chips)
+    if grid is None:
+        raise TopologyError(
+            f"{accelerator} hosts come with {sorted(layout.grids)} chip(s), "
+            f"not {total_chips}")
+    return grid
+
+
+def parse_topology(value: str) -> Tuple[int, ...]:
+    """'2x2' -> (2, 2); '2x2x1' -> (2, 2, 1)."""
+    try:
+        dims = tuple(int(d) for d in str(value).lower().split("x"))
+    except ValueError:
+        dims = ()
+    if not dims or any(d <= 0 for d in dims):
+        raise TopologyError(f"invalid topology string {value!r}")
+    return dims
+
+
+def format_topology(dims: Sequence[int]) -> str:
+    return "x".join(str(d) for d in dims)
+
+
+def _box_shape(accelerator: str, entry_chips: int,
+               declared: Optional[str], grid: Tuple[int, ...]
+               ) -> Tuple[int, ...]:
+    """Resolve a layout entry to a concrete box shape on the host grid."""
+    if declared:
+        dims = parse_topology(declared)
+        if len(dims) != len(grid):
+            raise TopologyError(
+                f"topology {declared!r} has {len(dims)} dims but "
+                f"{accelerator} hosts form a {format_topology(grid)} grid")
+        area = 1
+        for d in dims:
+            area *= d
+        if area != entry_chips:
+            raise TopologyError(
+                f"topology {declared!r} covers {area} chip(s) but the entry "
+                f"requests chips={entry_chips}")
+        return dims
+    canonical = GENERATIONS[accelerator].canonical.get(entry_chips)
+    if canonical is None or len(canonical) != len(grid):
+        raise TopologyError(
+            f"no canonical {accelerator} sub-slice of {entry_chips} chip(s); "
+            f"declare an explicit topology")
+    return canonical
+
+
+def _chip_id(coord: Tuple[int, ...], grid: Tuple[int, ...]) -> int:
+    chip = 0
+    for c, g in zip(coord, grid):
+        chip = chip * g + c
+    return chip
+
+
+def _anchors(shape: Tuple[int, ...], grid: Tuple[int, ...],
+             occupied: set):
+    """All feasible placements of the box, as cell lists, in row-major
+    anchor order (the determinism contract for golden partition tables)."""
+    anchor_ranges = [range(g - s + 1) for g, s in zip(grid, shape)]
+    for anchor in itertools.product(*anchor_ranges):
+        cells = [tuple(a + o for a, o in zip(anchor, offset))
+                 for offset in itertools.product(*(range(s) for s in shape))]
+        if not any(c in occupied for c in cells):
+            yield cells
+
+
+def _tile(shapes: List[Tuple[int, ...]], grid: Tuple[int, ...],
+          occupied: set) -> Optional[List[List[Tuple[int, ...]]]]:
+    """Backtracking tiler: greedy first-fit alone wrongly rejects
+    satisfiable mixed-orientation layouts (two 1x2 rows then two 2x1
+    columns on a 2x4 grid — first-fit blocks every free column with its
+    second row). The search space is a <=8-cell grid, so exact search is
+    trivially cheap; trying anchors in row-major order and taking the
+    first full solution keeps the output deterministic."""
+    if not shapes:
+        return []
+    for cells in _anchors(shapes[0], grid, occupied):
+        occupied.update(cells)
+        rest = _tile(shapes[1:], grid, occupied)
+        occupied.difference_update(cells)
+        if rest is not None:
+            return [cells] + rest
+    return None
+
+
+def tile_partition(accelerator: str, total_chips: int,
+                   layout: List[dict]) -> List[dict]:
+    """Expand a named layout into chip groups that are PROVABLY
+    ICI-adjacent: each group is an axis-aligned box placed on the host's
+    physical grid, with the topology string derived from the placed shape
+    rather than copied from config.
+
+    Raises TopologyError for impossible splits: unknown generation, a shape
+    that doesn't exist on this host, a declared topology whose area
+    contradicts the chip count, or boxes that cannot tile the grid.
+    """
+    grid = host_grid(accelerator, total_chips)
+    shapes: List[Tuple[int, ...]] = []
+    used = 0
+    for entry in layout or []:
+        chips = int(entry.get("chips", 1))
+        if chips <= 0:
+            raise TopologyError(f"invalid chips count {chips}")
+        shape = _box_shape(accelerator, chips, entry.get("topology"), grid)
+        count = entry.get("count", 1)
+        n = (total_chips - used) // chips if count == "all" else int(count)
+        shapes.extend([shape] * n)
+        used += chips * n
+    if used > total_chips:
+        raise TopologyError(
+            f"layout requests {used} chip(s) but the host has {total_chips}")
+    placed = _tile(shapes, grid, set())
+    if placed is None:
+        raise TopologyError(
+            f"cannot place {[format_topology(s) for s in shapes]} "
+            f"sub-slice(s) on the {format_topology(grid)} grid")
+    return [{
+        "topology": format_topology(shape),
+        "chips": sorted(_chip_id(c, grid) for c in cells),
+    } for shape, cells in zip(shapes, placed)]
