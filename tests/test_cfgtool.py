@@ -186,3 +186,96 @@ def test_status_unreachable_cluster_fails_cleanly(capsys):
     err = capsys.readouterr().err
     assert "cannot reach the cluster" in err
     assert "Traceback" not in err
+
+
+# -- relatedImages + digest validation (reference images.go:31-47) -----------
+
+def _load_bundle_csv():
+    csv_path = os.path.join(os.path.dirname(SAMPLES), "..", "bundle",
+                            "manifests",
+                            "tpu-operator.clusterserviceversion.yaml")
+    with open(csv_path) as f:
+        return yaml.safe_load(f), os.path.dirname(os.path.abspath(csv_path))
+
+
+def _write_csv(tmp_path, csv, bundle_dir):
+    # ship the CRDs next to it so only the image checks differ
+    import shutil
+
+    for fname in os.listdir(bundle_dir):
+        if fname.startswith("tpu.ai_"):
+            shutil.copy(os.path.join(bundle_dir, fname), tmp_path / fname)
+    out = tmp_path / "csv.yaml"
+    out.write_text(yaml.safe_dump(csv))
+    return str(out)
+
+
+def test_validate_csv_shipped_bundle_images_pass(capsys):
+    csv_path = os.path.join(os.path.dirname(SAMPLES), "..", "bundle",
+                            "manifests",
+                            "tpu-operator.clusterserviceversion.yaml")
+    assert run(["validate-csv", csv_path]) == 0
+    assert "digest-pinned image(s), all cross-referenced" in \
+        capsys.readouterr().out
+
+
+def test_validate_csv_fails_on_tag_only_image(tmp_path, capsys):
+    """A moving tag re-resolves per node — OLM installs are only
+    reproducible digest-pinned; validate-csv must fail on a tag-only
+    image (reference validates every ref via the registry)."""
+    csv, bundle_dir = _load_bundle_csv()
+    ctr = csv["spec"]["install"]["spec"]["deployments"][0]["spec"][
+        "template"]["spec"]["containers"][0]
+    ctr["image"] = "gcr.io/CHANGE_ME/tpu-operator:0.1.0"  # digest dropped
+    assert run(["validate-csv", _write_csv(tmp_path, csv, bundle_dir)]) == 1
+    assert "not digest-pinned" in capsys.readouterr().out
+
+
+def test_validate_csv_fails_on_missing_related_images(tmp_path, capsys):
+    csv, bundle_dir = _load_bundle_csv()
+    del csv["spec"]["relatedImages"]
+    assert run(["validate-csv", _write_csv(tmp_path, csv, bundle_dir)]) == 1
+    assert "relatedImages missing" in capsys.readouterr().out
+
+
+def test_validate_csv_fails_on_uncrossreferenced_images(tmp_path, capsys):
+    """Both directions: an operand env image absent from relatedImages is
+    invisible to disconnected mirrors; a relatedImages entry nothing
+    references is dead weight."""
+    csv, bundle_dir = _load_bundle_csv()
+    ctr = csv["spec"]["install"]["spec"]["deployments"][0]["spec"][
+        "template"]["spec"]["containers"][0]
+    for env in ctr["env"]:
+        if env["name"] == "DRIVER_IMAGE":
+            env["value"] = ("gcr.io/CHANGE_ME/other:1.0@sha256:"
+                            + "ab" * 32)
+    assert run(["validate-csv", _write_csv(tmp_path, csv, bundle_dir)]) == 1
+    out = capsys.readouterr().out
+    assert "not listed in relatedImages" in out
+
+    csv, bundle_dir = _load_bundle_csv()
+    csv["spec"]["relatedImages"].append(
+        {"name": "orphan", "image": "gcr.io/CHANGE_ME/orphan:1.0@sha256:"
+                                    + "cd" * 32})
+    assert run(["validate-csv", _write_csv(tmp_path, csv, bundle_dir)]) == 1
+    assert "not referenced by any" in capsys.readouterr().out
+
+
+def test_multi_arch_mk():
+    """multi-arch.mk (reference multi-arch.mk parity): dry-run both buildx
+    targets and check the platform matrix — operator image dual-arch
+    (mixed clusters), validator amd64-only (libtpu payload only runs on
+    TPU VMs; an arm64 manifest would advertise an image that can't work)."""
+    import subprocess
+
+    repo = os.path.dirname(SAMPLES).rsplit("/config", 1)[0]
+    result = subprocess.run(
+        ["make", "-n", "-f", "multi-arch.mk", "build-all-multiarch"],
+        cwd=repo, capture_output=True, text=True)
+    assert result.returncode == 0, result.stderr
+    out = result.stdout
+    assert "docker buildx build" in out
+    assert "--platform=linux/amd64,linux/arm64" in out  # operator
+    assert out.count("--platform=linux/amd64\n") + \
+        out.count("--platform=linux/amd64 ") >= 1       # validator
+    assert "docker/validator.Dockerfile" in out

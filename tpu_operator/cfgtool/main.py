@@ -11,6 +11,7 @@ Subcommands:
 from __future__ import annotations
 
 import argparse
+import re
 import sys
 
 import yaml
@@ -164,7 +165,141 @@ def validate_csv(path: str) -> int:
         else:
             print(f"{path}: owned CRD {name}: NOT shipped in bundle dir")
             failed = True
+    if _validate_csv_images(csv, path):
+        failed = True
+    if _validate_csv_replaces(csv, path):
+        failed = True
     return 1 if failed else 0
+
+
+#: CSV names follow <package>.v<semver>; `replaces` is how OLM walks the
+#: version-to-version upgrade graph (reference bundle/ chains 30 versions)
+_CSV_NAME_RE = re.compile(r"^(?P<pkg>[a-z0-9][a-z0-9.-]*)\.v"
+                          r"(?P<ver>\d+\.\d+\.\d+(?:[-+][\w.-]+)?)$")
+
+
+def _semver_key(version: str):
+    """Semver precedence key: build metadata ignored; a prerelease sorts
+    BELOW its release (0.1.0-rc.1 < 0.1.0), prerelease identifiers compare
+    numerically when numeric, lexically otherwise (semver.org #11)."""
+    version = version.split("+")[0]
+    main, _, prerelease = version.partition("-")
+    main_key = tuple(int(part) for part in main.split("."))
+    if not prerelease:
+        return (main_key, 1, ())
+    pre_key = tuple((0, int(ident), "") if ident.isdigit() else (1, 0, ident)
+                    for ident in prerelease.split("."))
+    return (main_key, 0, pre_key)
+
+
+def _validate_csv_replaces(csv: dict, path: str) -> bool:
+    """Validate the OLM upgrade-graph edge when present: spec.replaces must
+    name the SAME package at a strictly OLDER version, never itself — a
+    malformed or forward-pointing edge breaks every OperatorHub upgrade
+    from the prior release. (First releases legitimately have none.)
+    Returns True when anything failed."""
+    replaces = csv.get("spec", {}).get("replaces")
+    name = csv.get("metadata", {}).get("name", "")
+    if replaces is None:
+        return False
+    own = _CSV_NAME_RE.match(name)
+    target = _CSV_NAME_RE.match(str(replaces))
+    if own is None:
+        print(f"{path}: CSV name {name!r} is not <package>.v<semver>")
+        return True
+    if target is None:
+        print(f"{path}: replaces {replaces!r} is not <package>.v<semver>")
+        return True
+    if replaces == name:
+        print(f"{path}: CSV replaces itself ({name})")
+        return True
+    if target.group("pkg") != own.group("pkg"):
+        print(f"{path}: replaces {replaces!r} names package "
+              f"{target.group('pkg')!r}, not {own.group('pkg')!r}")
+        return True
+    if _semver_key(target.group("ver")) >= _semver_key(own.group("ver")):
+        print(f"{path}: replaces {replaces!r} is not older than {name!r} "
+              f"(the upgrade graph must point backward)")
+        return True
+    print(f"{path}: replaces {replaces}: OK")
+    return False
+
+
+#: registry/path[:tag]@sha256:<64 hex> — OLM installs are only reproducible
+#: when every image is digest-pinned; a moving tag re-resolves per node
+_DIGEST_RE = re.compile(r"@sha256:[0-9a-f]{64}$")
+
+
+def _image_digest_error(image) -> str:
+    """Non-empty error string when the image ref is not digest-pinned."""
+    if not image or not isinstance(image, str):
+        return "empty image reference"
+    if not _DIGEST_RE.search(image):
+        return f"not digest-pinned (expected @sha256:<64 hex>): {image}"
+    return ""
+
+
+def _validate_csv_images(csv: dict, path: str) -> bool:
+    """relatedImages + digest validation (reference
+    cmd/gpuop-cfg/validate/csv/images.go:31-47 resolves every
+    relatedImages entry, the operator container image, and every *_IMAGE
+    env from the registry; offline, the enforceable contract is that each
+    is digest-pinned and that relatedImages and the deployment/env images
+    cross-reference each other exactly — OLM mirrors/disconnected installs
+    only see relatedImages, so an operand image missing there is
+    uninstallable air-gapped, and an unreferenced entry is dead weight).
+    Returns True when anything failed."""
+    failed = False
+    related = csv.get("spec", {}).get("relatedImages") or []
+    if not related:
+        print(f"{path}: spec.relatedImages missing or empty")
+        return True
+    related_images = set()
+    for entry in related:
+        name = entry.get("name", "?")
+        image = entry.get("image")
+        if not entry.get("name"):
+            print(f"{path}: relatedImages entry without a name: {entry}")
+            failed = True
+        err = _image_digest_error(image)
+        if err:
+            print(f"{path}: relatedImages {name}: {err}")
+            failed = True
+        else:
+            related_images.add(image)
+
+    deployments = (csv.get("spec", {}).get("install", {}).get("spec", {})
+                   .get("deployments") or [])
+    referenced = set()
+    for deployment in deployments:
+        pod_spec = (deployment.get("spec", {}).get("template", {})
+                    .get("spec", {}))
+        containers = ((pod_spec.get("containers") or [])
+                      + (pod_spec.get("initContainers") or []))
+        for ctr in containers:
+            for what, image in [(f"container {ctr.get('name', '?')}",
+                                 ctr.get("image"))] + \
+                    [(f"env {env.get('name')}", env.get("value"))
+                     for env in ctr.get("env") or []
+                     if env.get("name", "").endswith("_IMAGE")]:
+                err = _image_digest_error(image)
+                if err:
+                    print(f"{path}: {what}: {err}")
+                    failed = True
+                    continue
+                referenced.add(image)
+                if image not in related_images:
+                    print(f"{path}: {what}: image not listed in "
+                          f"relatedImages: {image}")
+                    failed = True
+    for image in sorted(related_images - referenced):
+        print(f"{path}: relatedImages entry not referenced by any "
+              f"deployment image or *_IMAGE env: {image}")
+        failed = True
+    if not failed:
+        print(f"{path}: relatedImages: {len(related_images)} digest-pinned "
+              f"image(s), all cross-referenced")
+    return failed
 
 
 def status(base_url=None, namespace="tpu-operator", out=None,
