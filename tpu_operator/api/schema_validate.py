@@ -129,3 +129,46 @@ def _validate_object(obj: Dict[str, Any], schema: Dict[str, Any],
             continue
         else:
             errors.append(f"{path}.{key}: unknown field")
+
+
+def prune(obj: Any, schema: Dict[str, Any]) -> List[str]:
+    """Structural-schema pruning (kube-apiserver semantics for CRDs with
+    preserveUnknownFields: false): remove, in place, every field the schema
+    does not know, except under ``x-kubernetes-preserve-unknown-fields`` or
+    ``additionalProperties``. Returns the pruned paths.
+
+    This is what keeps a CRD *upgrade* from wedging live objects: a CR
+    stored under schema vN may carry a field vN+1 removed — the apiserver
+    silently prunes it on the next write instead of rejecting every status
+    update forever."""
+    pruned: List[str] = []
+    _prune(obj, schema, "$", pruned)
+    return pruned
+
+
+def _prune(obj: Any, schema: Dict[str, Any], path: str,
+           pruned: List[str]) -> None:
+    if isinstance(obj, list):
+        item_schema = schema.get("items")
+        if item_schema:
+            for i, item in enumerate(obj):
+                _prune(item, item_schema, f"{path}[{i}]", pruned)
+        return
+    if not isinstance(obj, dict):
+        return
+    props = schema.get("properties", {})
+    addl = schema.get("additionalProperties")
+    preserve = schema.get("x-kubernetes-preserve-unknown-fields", False)
+    if preserve or addl is True:
+        return
+    if isinstance(addl, dict):
+        for key, value in obj.items():
+            _prune(value, addl, f"{path}.{key}", pruned)
+        return
+    if not props and addl is None:
+        return  # schema stub (metadata): accept any content
+    for key in [k for k in obj if k not in props]:
+        del obj[key]
+        pruned.append(f"{path}.{key}")
+    for key, value in obj.items():
+        _prune(value, props[key], f"{path}.{key}", pruned)

@@ -127,11 +127,20 @@ class FakeClient(Client):
         for obj in objects or []:
             self.create(obj)
 
-    def _admit(self, obj: dict) -> None:
+    def _admit(self, obj: dict, prune: bool = False) -> None:
+        """CRD schema admission. ``prune=True`` (the update path) first
+        applies structural-schema pruning — real kube-apiserver semantics:
+        unknown fields on an EXISTING object are silently dropped on write,
+        so a CR stored under schema vN whose field vN+1 removed does not
+        wedge every subsequent status update (the operator self-upgrade
+        path). Creates stay strict (fieldValidation=Strict: a typo'd new CR
+        is a 422, the property the schema-fuzz e2es pin)."""
         schema = self._crd_schemas.get((obj.get("apiVersion"), obj.get("kind")))
         if schema is None:
             return
         from ..api import schema_validate
+        if prune:
+            schema_validate.prune(obj, schema)
         errors = schema_validate.validate(obj, schema, obj.get("kind", "object"))
         if errors:
             raise InvalidError(
@@ -224,7 +233,7 @@ class FakeClient(Client):
     def update(self, obj: dict) -> dict:
         obj = copy.deepcopy(obj)
         meta = obj.get("metadata", {})
-        self._admit(obj)
+        self._admit(obj, prune=True)
         with self._lock:
             key = self._key(obj["apiVersion"], obj["kind"], meta["name"], meta.get("namespace"))
             current = self._store.get(key)
