@@ -106,3 +106,62 @@ def test_delete_objs_and_list_owned(fake_client):
     except NotFoundError:
         pass
     skel.delete_objs(owned)  # idempotent
+
+
+def test_out_of_band_drift_is_healed(fake_client):
+    """The fingerprint skip only proves the operator's LAST WRITE matched;
+    a kubectl edit to a rendered object (dropped ClusterRole verb,
+    rewritten Service port) leaves the stored hash intact, so the skip
+    must also verify the live object still carries every rendered field —
+    else drift persists until the operator's own template changes."""
+    import copy
+
+    skel = StateSkel("state-test", fake_client)
+    role = {"apiVersion": "rbac.authorization.k8s.io/v1", "kind": "ClusterRole",
+            "metadata": {"name": "drift-role"},
+            "rules": [{"apiGroups": [""], "resources": ["nodes"],
+                       "verbs": ["get", "list", "watch", "patch"]}]}
+    skel.create_or_update_objs([copy.deepcopy(role)])
+
+    # out-of-band edit: drop the patch verb (privilege-reduction attack on
+    # the operator's own RBAC)
+    live = fake_client.get("rbac.authorization.k8s.io/v1", "ClusterRole",
+                           "drift-role")
+    live["rules"][0]["verbs"] = ["get"]
+    fake_client.update(live)
+
+    skel.create_or_update_objs([copy.deepcopy(role)])
+    healed = fake_client.get("rbac.authorization.k8s.io/v1", "ClusterRole",
+                             "drift-role")
+    assert healed["rules"][0]["verbs"] == ["get", "list", "watch", "patch"]
+
+
+def test_unchanged_object_skips_write(fake_client):
+    """The flip side: an unchanged, undrifted object is NOT rewritten
+    every sweep (steady-state write load must be O(changes), not
+    O(sweeps) — the r4 scale-envelope finding)."""
+    import copy
+
+    skel = StateSkel("state-test", fake_client)
+    svc = {"apiVersion": "v1", "kind": "Service",
+           "metadata": {"name": "skip-svc", "namespace": "tpu-operator"},
+           "spec": {"ports": [{"port": 9400}]}}
+    skel.create_or_update_objs([copy.deepcopy(svc)])
+    rv1 = fake_client.get("v1", "Service", "skip-svc",
+                          "tpu-operator")["metadata"]["resourceVersion"]
+    writes = {"n": 0}
+    orig = fake_client.update
+
+    def counting_update(obj):
+        writes["n"] += 1
+        return orig(obj)
+
+    fake_client.update = counting_update
+    try:
+        skel.create_or_update_objs([copy.deepcopy(svc)])
+    finally:
+        fake_client.update = orig
+    assert writes["n"] == 0
+    rv2 = fake_client.get("v1", "Service", "skip-svc",
+                          "tpu-operator")["metadata"]["resourceVersion"]
+    assert rv1 == rv2

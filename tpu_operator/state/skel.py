@@ -98,6 +98,22 @@ _READINESS = {
 }
 
 #: fields the API server (or other controllers) own; preserved on update
+def _covers(live, desired) -> bool:
+    """True when every field of ``desired`` is present and equal in
+    ``live`` — dicts recursively, lists pairwise with equal length. Extra
+    live-only fields are apiserver defaults (clusterIP, protocol,
+    SA-managed secrets), not drift; a rendered field that was changed or
+    removed out-of-band IS drift and fails the check."""
+    if isinstance(desired, dict):
+        return isinstance(live, dict) and all(
+            key in live and _covers(live[key], value)
+            for key, value in desired.items())
+    if isinstance(desired, list):
+        return (isinstance(live, list) and len(live) == len(desired)
+                and all(_covers(l, d) for l, d in zip(live, desired)))
+    return live == desired
+
+
 #: (mergeObjects analog, state_skel.go:344)
 _PRESERVE_ON_UPDATE = {
     "Service": [("spec", "clusterIP"), ("spec", "clusterIPs")],
@@ -140,13 +156,30 @@ class StateSkel:
                          deep_get(obj, "metadata", "name"))
         return applied
 
+    @staticmethod
+    def _desired_fingerprint(desired: dict) -> str:
+        """Order-insensitive hash of everything the operator renders for an
+        object: full doc minus status and server-managed metadata. The
+        DaemonSet-only spec hash generalized to every kind — without it a
+        reconcile sweep re-UPDATEs ~25 unchanged SAs/Services/RBAC objects
+        per trigger, so steady-state write load scales O(sweeps), not
+        O(changes) (apiserver audit-log spam at fleet size)."""
+        doc = copy.deepcopy(desired)
+        doc.pop("status", None)
+        meta = doc.get("metadata", {})
+        for server_managed in ("resourceVersion", "uid", "creationTimestamp",
+                               "generation", "managedFields"):
+            meta.pop(server_managed, None)
+        (meta.get("annotations") or {}).pop(consts.SPEC_HASH_ANNOTATION, None)
+        return object_hash(doc)
+
     def _apply_one(self, desired: dict, owner: Optional[dict]) -> dict:
         meta = desired.setdefault("metadata", {})
         meta.setdefault("labels", {})[consts.STATE_LABEL] = self.name
         if owner is not None:
             meta["ownerReferences"] = [owner_reference(owner)]
-        if desired.get("kind") == "DaemonSet":
-            meta.setdefault("annotations", {})[consts.SPEC_HASH_ANNOTATION] = object_hash(desired.get("spec", {}))
+        meta.setdefault("annotations", {})[consts.SPEC_HASH_ANNOTATION] = \
+            self._desired_fingerprint(desired)
 
         api_version, kind = desired["apiVersion"], desired["kind"]
         name, namespace = meta["name"], meta.get("namespace")
@@ -156,10 +189,17 @@ class StateSkel:
             log.info("state %s: creating %s/%s", self.name, kind, name)
             return self.client.create(desired)
 
-        if kind == "DaemonSet":
-            current_hash = deep_get(current, "metadata", "annotations", consts.SPEC_HASH_ANNOTATION)
-            if current_hash == meta["annotations"][consts.SPEC_HASH_ANNOTATION]:
-                return current  # unchanged: skip write (object_controls.go:4316)
+        current_hash = deep_get(current, "metadata", "annotations", consts.SPEC_HASH_ANNOTATION)
+        if current_hash == meta["annotations"][consts.SPEC_HASH_ANNOTATION] \
+                and _covers(current, desired):
+            # unchanged AND undrifted: the stored fingerprint only proves
+            # the operator's last write matched — an out-of-band kubectl
+            # edit leaves it intact, so the live object must still carry
+            # every rendered field (extra live fields are server defaults,
+            # not drift) or the sweep re-applies and heals it
+            # (object_controls.go:4316 confines the skip to DaemonSets;
+            # we extend it to every kind, so the drift check comes along)
+            return current
 
         for path in _PRESERVE_ON_UPDATE.get(kind, []):
             value = deep_get(current, *path)
