@@ -135,3 +135,82 @@ def test_no_multihost_slices_is_ready(fake_client):
     fake_client.create(mk_node("single"))
     state = MultihostValidationState(fake_client)
     assert state.sync(catalog(fake_client)).status == SyncState.READY
+
+
+def test_multislice_isolation_node_kill_mid_validation(fake_client):
+    """Two slices in one cluster; a node in slice A dies MID-validation.
+    Slice B's validation, stamps, and schedulability must be completely
+    untouched, and slice A must revalidate cleanly against its settled
+    (smaller) membership — the per-slice config hash includes the member
+    list, so membership churn invalidates exactly that slice (VERDICT r3
+    next #7)."""
+    for i in range(4):
+        fake_client.create(mk_node(f"a-{i}", "slice-a"))
+    for i in range(4):
+        fake_client.create(mk_node(f"b-{i}", "slice-b"))
+    state = MultihostValidationState(fake_client)
+    policy = ClusterPolicy.from_obj(fake_client.create(new_cluster_policy()))
+
+    # sweep 1: both slices launch rendezvous pods
+    assert state.sync(catalog(fake_client, policy)).status == SyncState.NOT_READY
+    pods = fake_client.list("v1", "Pod", NS,
+                            label_selector={"app": "tpu-multihost-validation"})
+    assert len(pods) == 8
+
+    # slice B completes; slice A is still mid-rendezvous (pods Pending)
+    for pod in pods:
+        if pod["metadata"]["labels"]["tpu.ai/slice"] == "slice-b":
+            pod["status"] = {"phase": "Succeeded"}
+            fake_client.update_status(pod)
+    result = state.sync(catalog(fake_client, policy))
+    assert result.status == SyncState.NOT_READY  # A still validating
+    assert "slice-a" in result.message and "slice-b" not in result.message
+    b_stamps = {
+        name: deep_get(fake_client.get("v1", "Node", name),
+                       "metadata", "annotations",
+                       consts.MULTIHOST_VALIDATED_ANNOTATION)
+        for name in ("b-0", "b-1", "b-2", "b-3")}
+    assert all(b_stamps.values()), "slice B must be stamped"
+
+    # --- kill a-3 mid-validation (node object gone, its pod orphaned)
+    fake_client.delete("v1", "Node", "a-3")
+
+    # membership changed -> A's in-flight pods are stale; torn down
+    assert state.sync(catalog(fake_client, policy)).status == SyncState.NOT_READY
+    a_pods = [p for p in fake_client.list(
+        "v1", "Pod", NS, label_selector={"app": "tpu-multihost-validation"})
+        if p["metadata"]["labels"]["tpu.ai/slice"] == "slice-a"]
+    assert a_pods == [], "stale 4-member rendezvous must be torn down"
+
+    # next sweep relaunches with the settled 3-member rendezvous
+    assert state.sync(catalog(fake_client, policy)).status == SyncState.NOT_READY
+    a_pods = [p for p in fake_client.list(
+        "v1", "Pod", NS, label_selector={"app": "tpu-multihost-validation"})
+        if p["metadata"]["labels"]["tpu.ai/slice"] == "slice-a"]
+    assert len(a_pods) == 3
+    env = {e["name"]: e.get("value")
+           for e in a_pods[0]["spec"]["containers"][0]["env"]}
+    assert env["TPU_NUM_PROCESSES"] == "3"
+
+    # A completes against the new membership -> everything converges
+    for pod in a_pods:
+        pod["status"] = {"phase": "Succeeded"}
+        fake_client.update_status(pod)
+    assert state.sync(catalog(fake_client, policy)).status == SyncState.READY
+    for name in ("a-0", "a-1", "a-2"):
+        assert deep_get(fake_client.get("v1", "Node", name),
+                        "metadata", "annotations",
+                        consts.MULTIHOST_VALIDATED_ANNOTATION)
+
+    # --- isolation: B's stamps never churned, B stayed schedulable, and
+    # no B pod was ever relaunched after its success
+    for name, stamp in b_stamps.items():
+        node = fake_client.get("v1", "Node", name)
+        assert deep_get(node, "metadata", "annotations",
+                        consts.MULTIHOST_VALIDATED_ANNOTATION) == stamp, \
+            f"{name} stamp churned during slice A's failure"
+        assert deep_get(node, "status", "capacity",
+                        consts.TPU_RESOURCE_NAME) == "4"
+    assert [p for p in fake_client.list(
+        "v1", "Pod", NS, label_selector={"app": "tpu-multihost-validation"})
+        if p["metadata"]["labels"]["tpu.ai/slice"] == "slice-b"] == []

@@ -143,10 +143,21 @@ def test_declared_topology_must_match_chip_count():
         compute_partition([{"chips": 2, "topology": "2x2"}], 8, V5E)
 
 
-def test_declared_topology_wrong_rank_rejected():
+def test_declared_topology_lower_rank_padded():
+    """Generation-agnostic configs declare 2D shapes ('1x1', '2x2'); on a
+    3D host grid they pad with trailing 1s instead of erroring — the
+    shipped single-chip default must work on v4/v5p hosts."""
+    groups = compute_partition([{"chips": 4, "topology": "2x2"}], 4,
+                               "tpu-v4-podslice")
+    assert groups == [{"topology": "2x2x1", "chips": [0, 1, 2, 3]}]
+    singles = compute_partition(
+        [{"chips": 1, "topology": "1x1", "count": "all"}], 4, "tpu-v5p-slice")
+    assert [g["topology"] for g in singles] == ["1x1x1"] * 4
+
+
+def test_declared_topology_higher_rank_rejected():
     with pytest.raises(PartitionError, match="dims"):
-        compute_partition([{"chips": 4, "topology": "2x2"}], 4,
-                          "tpu-v4-podslice")
+        compute_partition([{"chips": 4, "topology": "2x2x1"}], 8, V5E)
 
 
 def test_impossible_box_rejected():
@@ -285,3 +296,33 @@ def test_missing_generation_label_stays_pending(fake_client, config_path,
     fake_client.patch("v1", "Node", "n1", {"metadata": {"labels": {
         consts.GKE_TPU_ACCELERATOR_LABEL: V5E}}})
     assert sync_once(fake_client, "n1", config_path, handoff) == "success"
+
+
+def test_stale_handoff_from_old_version_recomputed(fake_client, config_path,
+                                                   tmp_path):
+    """A handoff written by the pre-topology partitioner (sequential chip
+    groups, no grid) under the SAME partition name must be recomputed on
+    upgrade — the success early-exit verifies content, not just the name,
+    or the device plugin keeps advertising non-adjacent groups forever."""
+    from tpu_operator.partitioner.partitioner import write_handoff
+
+    handoff = str(tmp_path / "handoff")
+    mk_node(fake_client, config="v5e-2x2-pair", state="success")
+    # old-version artifact: sequential fiction, no grid key
+    import json as _json
+    import os as _os
+    _os.makedirs(handoff, exist_ok=True)
+    with open(_os.path.join(handoff, "partition.json"), "w") as f:
+        _json.dump({"partition": "v5e-2x2-pair",
+                    "groups": [{"topology": "2x2", "chips": [0, 1, 2, 3]},
+                               {"topology": "2x2", "chips": [4, 5, 6, 7]}]}, f)
+
+    assert sync_once(fake_client, "n1", config_path, handoff) == "success"
+    data = read_handoff(handoff)
+    assert data["grid"] == [2, 4]
+    assert [g["chips"] for g in data["groups"]] == [[0, 1, 4, 5], [2, 3, 6, 7]]
+
+    # and once current, the early-exit really does skip (no rewrite)
+    before = _os.path.getmtime(_os.path.join(handoff, "partition.json"))
+    assert sync_once(fake_client, "n1", config_path, handoff) == "success"
+    assert _os.path.getmtime(_os.path.join(handoff, "partition.json")) == before

@@ -112,14 +112,11 @@ def sync_once(client, node_name: str, config_path: str,
             return None
         return None
     current = read_handoff(handoff_dir)
-    if current and current.get("partition") == desired and state == STATE_SUCCESS:
-        return STATE_SUCCESS  # already applied
 
     def set_state(value: str) -> None:
         client.patch("v1", "Node", node_name,
                      {"metadata": {"labels": {consts.TPU_SLICE_STATE_LABEL: value}}})
 
-    set_state(STATE_PENDING)
     try:
         table = load_config(config_path)
         if desired not in table:
@@ -136,12 +133,23 @@ def sync_once(client, node_name: str, config_path: str,
             # generation label arrives with feature discovery; stay
             # pending (we retry every sleep_interval) instead of minting
             # a SlicePartitionFailed condition on every fresh node
+            set_state(STATE_PENDING)
             log.info("partition %s on %s: generation label not yet "
                      "present; pending", desired, node_name)
             return STATE_PENDING
         groups = compute_partition(table[desired], total_chips, accelerator)
-        write_handoff(groups, desired, handoff_dir,
-                      grid=topology.host_grid(accelerator, total_chips))
+        grid = list(topology.host_grid(accelerator, total_chips))
+        if (state == STATE_SUCCESS and current
+                and current.get("partition") == desired
+                and current.get("groups") == groups
+                and current.get("grid") == grid):
+            # already applied — verified by CONTENT, not just the partition
+            # name: a handoff written by an older partitioner version
+            # (sequential chip groups, no grid) must be recomputed on
+            # upgrade, or the device plugin keeps advertising it
+            return STATE_SUCCESS
+        set_state(STATE_PENDING)
+        write_handoff(groups, desired, handoff_dir, grid=grid)
         set_state(STATE_SUCCESS)
         log.info("partition %s applied on %s: %d group(s)", desired, node_name, len(groups))
         return STATE_SUCCESS

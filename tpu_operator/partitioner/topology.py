@@ -108,10 +108,14 @@ def _box_shape(accelerator: str, entry_chips: int,
     """Resolve a layout entry to a concrete box shape on the host grid."""
     if declared:
         dims = parse_topology(declared)
-        if len(dims) != len(grid):
+        if len(dims) > len(grid):
             raise TopologyError(
                 f"topology {declared!r} has {len(dims)} dims but "
                 f"{accelerator} hosts form a {format_topology(grid)} grid")
+        # lower-rank declarations are valid on higher-rank grids: the
+        # generation-agnostic "1x1" single-chip layout (shipped default
+        # config) must work on a v4/v5p 2x2x1 host — right-pad with 1s
+        dims = dims + (1,) * (len(grid) - len(dims))
         area = 1
         for d in dims:
             area *= d
@@ -186,7 +190,10 @@ def tile_partition(accelerator: str, total_chips: int,
             raise TopologyError(f"invalid chips count {chips}")
         shape = _box_shape(accelerator, chips, entry.get("topology"), grid)
         count = entry.get("count", 1)
-        n = (total_chips - used) // chips if count == "all" else int(count)
+        # clamp: an "all" entry after an overflowing fixed-count one must
+        # not decrement `used` and mask the explicit overflow diagnostic
+        n = max((total_chips - used) // chips, 0) if count == "all" \
+            else int(count)
         shapes.extend([shape] * n)
         used += chips * n
     if used > total_chips:
