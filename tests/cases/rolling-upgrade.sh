@@ -1,0 +1,65 @@
+# Case: rolling driver upgrade evicts TPU-holding user pods — including
+# ones carrying the standard app.kubernetes.io/component label — while
+# DaemonSet-owned pods are exempt (kubectl drain semantics; the r4 drain
+# target-selection fix proven through the real operator binary).
+
+set -eu
+
+# user workload namespace with two pods on tpu-0:
+#  - "web-train": component=web + a TPU limit -> MUST be evicted
+#  - "user-ds-pod": DaemonSet-owned + TPU limit -> MUST survive
+kpost "api/v1/namespaces/ml-team/pods" '{
+  "apiVersion": "v1", "kind": "Pod",
+  "metadata": {"name": "web-train", "namespace": "ml-team",
+               "labels": {"app.kubernetes.io/component": "web"}},
+  "spec": {"nodeName": "tpu-node-0",
+           "containers": [{"name": "train", "image": "user:1",
+                           "resources": {"limits": {"google.com/tpu": "4"}}}]},
+  "status": {"phase": "Running"}
+}' >/dev/null
+kpost "api/v1/namespaces/ml-team/pods" '{
+  "apiVersion": "v1", "kind": "Pod",
+  "metadata": {"name": "user-ds-pod", "namespace": "ml-team",
+               "ownerReferences": [{"kind": "DaemonSet", "name": "user-ds",
+                                     "controller": true, "uid": "u-1"}]},
+  "spec": {"nodeName": "tpu-node-0",
+           "containers": [{"name": "c", "image": "user:1",
+                           "resources": {"limits": {"google.com/tpu": "4"}}}]},
+  "status": {"phase": "Running"}
+}' >/dev/null
+
+# turn on auto-upgrade with an aggressive-but-safe policy, then roll the
+# driver version to trigger the per-node state machine
+kpatch "${CP_PATH}" '{"spec": {"driver": {
+  "version": "0.3.0",
+  "upgradePolicy": {"autoUpgrade": true, "maxParallelUpgrades": 4,
+                    "maxUnavailable": "100%",
+                    "drain": {"enable": true, "force": true,
+                              "timeoutSeconds": 60},
+                    "podDeletion": {"force": true, "timeoutSeconds": 60}}
+}}}' >/dev/null
+
+pod_gone() { ! kget "api/v1/namespaces/ml-team/pods/web-train"; }
+pod_present() { kget "api/v1/namespaces/ml-team/pods/user-ds-pod"; }
+nodes_settled() {
+    kget "api/v1/nodes" | jsonq '"ok" if all(
+        "tpu.ai/tpu-driver-upgrade-state" not in (n["metadata"].get("labels") or {})
+        and not (n.get("spec") or {}).get("unschedulable")
+        for n in obj["items"]) else sys.exit(1)'
+}
+
+wait_for "TPU-holding user pod evicted (component label no shield)" 90 pod_gone
+ds_rolled() { ds_image libtpu-driver | grep -q "0.3.0"; }
+wait_for "driver DS rolled to 0.3.0" 90 ds_rolled
+wait_for "all nodes uncordoned, upgrade labels cleared" 120 nodes_settled
+wait_for "ClusterPolicy ready after upgrade" 60 cp_state_is ready
+pod_present >/dev/null || { echo "FAIL: DaemonSet-owned pod was evicted" >&2; exit 1; }
+echo "ok: DaemonSet-owned user pod survived the drain"
+
+# revert for later cases
+kpatch "${CP_PATH}" '{"spec": {"driver": {
+  "version": "0.1.0",
+  "upgradePolicy": {"autoUpgrade": false}}}}' >/dev/null
+kdel "api/v1/namespaces/ml-team/pods/user-ds-pod" >/dev/null 2>&1 || true
+wait_for "ClusterPolicy ready after revert" 120 cp_state_is ready
+wait_for "nodes settled after revert" 120 nodes_settled

@@ -3,7 +3,9 @@
 # ClusterPolicy state-driver to per-pool DaemonSets; deleting it hands back.
 
 set -eu
-REPO_ROOT="$(cd "$(dirname "$0")/../.." && pwd)"
+# REPO_ROOT is exported by end-to-end.sh ($0 inside a `bash -c` case run
+# no longer points at the orchestrator, so don't derive it from $0)
+: "${REPO_ROOT:?end-to-end.sh must export REPO_ROOT}"
 
 kpost "apis/tpu.ai/v1alpha1/tpudrivers" \
     "$(yaml2json "${REPO_ROOT}/config/samples/v1alpha1_tpudriver.yaml")" >/dev/null

@@ -82,9 +82,15 @@ echo "=== verify install ==="
 
 STATUS=0
 CASES="${*:-$(cd "${CASES_DIR}" && ls *.sh)}"
+export BASE SCRIPTS_DIR REPO_ROOT
 for case_sh in ${CASES}; do
     echo "=== case: ${case_sh} ==="
-    if ( . "${SCRIPTS_DIR}/common.sh"; . "${CASES_DIR}/${case_sh}" ); then
+    # a FRESH bash process, not a sourced subshell: POSIX suppresses
+    # `set -e` inside an if-condition subshell, so a sourced case's
+    # mid-case wait_for timeout would not fail it (only the last
+    # command's status counted — silent false PASSes)
+    if bash -eu -c '. "$1"; . "$2"' case-runner \
+            "${SCRIPTS_DIR}/common.sh" "${CASES_DIR}/${case_sh}"; then
         echo "=== PASS: ${case_sh} ==="
     else
         echo "=== FAIL: ${case_sh} ===" >&2
