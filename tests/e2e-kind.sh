@@ -109,8 +109,15 @@ EOF
 then
   echo "FAIL: apiserver accepted a typo'd field"; exit 1
 fi
-grep -qi "libtpuVerion\|unknown field\|ValidationError" /tmp/typo-err \
-  && { echo "ok: typo rejected server-side"; record pass schema-422; }
+# explicit if/else: a bare `grep && { record pass; }` is silently skipped
+# under set -e when grep fails (errexit ignores non-final AND-list
+# failures) — the rejection must be POSITIVELY identified or the run fails
+if grep -qi "libtpuVerion\|unknown field\|ValidationError" /tmp/typo-err; then
+  echo "ok: typo rejected server-side"; record pass schema-422
+else
+  echo "FAIL: rejection happened but the message is unrecognized:"
+  cat /tmp/typo-err; record fail schema-422 "unrecognized rejection"; exit 1
+fi
 
 echo "=== node prep: fake TPU stack on a kind node ==="
 NODE=$(kubectl get nodes -o name | head -1); NODE="${NODE#node/}"

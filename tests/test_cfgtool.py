@@ -156,7 +156,9 @@ def test_status_against_live_harness(capsys):
         client.create({"apiVersion": "v1", "kind": "Node",
                        "metadata": {"name": "tpu-0", "labels": {
                            consts.TPU_PRESENT_LABEL: "true",
-                           consts.UPGRADE_STATE_LABEL: "upgrade-done"}},
+                           consts.UPGRADE_STATE_LABEL: "upgrade-done",
+                           consts.TPU_SLICE_CONFIG_LABEL: "split-2x2",
+                           consts.TPU_SLICE_STATE_LABEL: "failed"}},
                        "status": {"capacity": {consts.TPU_RESOURCE_NAME: "4"}}})
         client.create({"apiVersion": "apps/v1", "kind": "DaemonSet",
                        "metadata": {"name": "libtpu-driver",
@@ -171,6 +173,8 @@ def test_status_against_live_harness(capsys):
         assert "ClusterPolicy/cluster-policy: notReady" in out
         assert "OperandNotReady" in out
         assert "tpu-0" in out and "upgrade-done" in out
+        # the slice-partition column shows the failed rollout at a glance
+        assert "split-2x2=failed" in out
         assert "libtpu-driver" in out
 
         cp = client.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy")

@@ -209,10 +209,14 @@ def test_slice_partition_failure_surfaces_on_cr(fake_client):
                                                                "tpu-operator")]
     assert event_reasons.count("SlicePartitionFailed") == 1
 
-    # partitioner recovers -> condition clears
+    # the failed-node gauge feeds the TPUSlicePartitionFailed alert
+    assert r.metrics.slice_partition_failed_nodes._value.get() == 1
+
+    # partitioner recovers -> condition clears, gauge zeroes
     fake_client.patch("v1", "Node", "tpu-1", {"metadata": {"labels": {
         consts.TPU_SLICE_STATE_LABEL: "success"}}})
     r.reconcile(Request("cluster-policy"))
     live = get_policy(fake_client)
     cond = get_condition(live, SLICE_PARTITION_FAILED)
     assert cond is not None and cond["status"] == "False"
+    assert r.metrics.slice_partition_failed_nodes._value.get() == 0

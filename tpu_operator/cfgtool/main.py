@@ -360,7 +360,8 @@ def _status(client, namespace, out) -> int:
               f"pools={pools}", file=out)
 
     # TPU nodes only — presence is the row filter, so no column for it
-    print("\nNODE            CAPACITY  UPGRADE-STATE", file=out)
+    print("\nNODE            CAPACITY  UPGRADE-STATE    SLICE-PARTITION",
+          file=out)
     for node in client.list("v1", "Node"):
         labels = node.get("metadata", {}).get("labels", {}) or {}
         if labels.get(consts.TPU_PRESENT_LABEL) != "true":
@@ -369,7 +370,17 @@ def _status(client, namespace, out) -> int:
         capacity = deep_get(node, "status", "capacity",
                             consts.TPU_RESOURCE_NAME) or "0"
         upgrade = labels.get(consts.UPGRADE_STATE_LABEL, "-")
-        print(f"{name:<15} {capacity:<9} {upgrade}", file=out)
+        slice_cfg = labels.get(consts.TPU_SLICE_CONFIG_LABEL)
+        slice_state = labels.get(consts.TPU_SLICE_STATE_LABEL)
+        # keyed off EITHER label: a stale failed state with the config
+        # label already removed still feeds the gauge/alert, and the
+        # triage table the alert points at must show it too
+        if slice_cfg or slice_state:
+            partition = f"{slice_cfg or '<none>'}={slice_state or '?'}"
+        else:
+            partition = "-"
+        print(f"{name:<15} {capacity:<9} {upgrade:<16} {partition}",
+              file=out)
 
     print("\nDAEMONSET                 DESIRED  AVAILABLE  UPDATED", file=out)
     for ds in client.list("apps/v1", "DaemonSet", namespace):

@@ -135,6 +135,7 @@ class ClusterPolicyReconciler(Reconciler):
             n["metadata"]["name"] for n in nodes
             if deep_get(n, "metadata", "labels",
                         consts.TPU_SLICE_STATE_LABEL) == "failed")
+        self.metrics.slice_partition_failed_nodes.set(len(failed))
         conditions = policy.obj.setdefault("status", {}).setdefault(
             "conditions", [])
         current = get_condition(policy.obj, SLICE_PARTITION_FAILED)

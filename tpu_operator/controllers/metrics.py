@@ -56,6 +56,10 @@ class OperatorMetrics:
         self.upgrades_available = Gauge(
             "tpu_operator_nodes_upgrades_available",
             "Nodes available for driver upgrade", registry=self.registry)
+        self.slice_partition_failed_nodes = Gauge(
+            "tpu_operator_slice_partition_failed_nodes",
+            "Nodes whose slice partitioner rejected the desired partition "
+            "(tpu.ai/slice.config.state=failed)", registry=self.registry)
 
         # controller-runtime/client-go equivalents (workqueue + rest client)
         self.workqueue_depth = Gauge(

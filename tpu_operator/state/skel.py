@@ -190,16 +190,25 @@ class StateSkel:
             return self.client.create(desired)
 
         current_hash = deep_get(current, "metadata", "annotations", consts.SPEC_HASH_ANNOTATION)
-        if current_hash == meta["annotations"][consts.SPEC_HASH_ANNOTATION] \
-                and _covers(current, desired):
-            # unchanged AND undrifted: the stored fingerprint only proves
-            # the operator's last write matched — an out-of-band kubectl
-            # edit leaves it intact, so the live object must still carry
-            # every rendered field (extra live fields are server defaults,
-            # not drift) or the sweep re-applies and heals it
-            # (object_controls.go:4316 confines the skip to DaemonSets;
-            # we extend it to every kind, so the drift check comes along)
-            return current
+        if current_hash == meta["annotations"][consts.SPEC_HASH_ANNOTATION]:
+            if _covers(current, desired):
+                # unchanged AND undrifted: the stored fingerprint only
+                # proves the operator's last write matched — an out-of-band
+                # kubectl edit leaves it intact, so the live object must
+                # still carry every rendered field (extra live fields are
+                # server defaults, not drift) or the sweep re-applies
+                # (object_controls.go:4316 confines the skip to
+                # DaemonSets; we extend it to every kind, so the drift
+                # check comes along)
+                return current
+            # drift heal is loud: an edited operator-rendered object (RBAC
+            # verb dropped, Service port rewritten) is tampering or a
+            # broken controller fight, and a server that NORMALIZES a
+            # rendered value would re-trigger this every sweep — either
+            # way the log must show it, not bury it in a silent update
+            log.warning("state %s: %s/%s drifted from rendered spec "
+                        "(out-of-band edit?); re-applying",
+                        self.name, kind, name)
 
         for path in _PRESERVE_ON_UPDATE.get(kind, []):
             value = deep_get(current, *path)
