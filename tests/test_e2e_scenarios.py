@@ -292,7 +292,9 @@ def test_multihost_slice_validation_e2e(cluster):
                        "status": {}})
     client.create(new_cluster_policy())
     app.start()
-    wait_for(lambda: policy_state(client) == "ready", timeout=30,
+    # file-default margin (45 s): 30 s flaked under full-suite CI load
+    # (multi-process review runs) — the flake class commit 31b24b4 fixed
+    wait_for(lambda: policy_state(client) == "ready",
              message="slice validated + ready")
     for i in range(4):
         node = client.get("v1", "Node", f"vm-{i}")
