@@ -110,6 +110,36 @@ class ChaosKit:
         fresh.start(self.port)
         self.srv_holder["srv"] = fresh
 
+    def churn_slice_state(self):
+        """Flip slice-partition labels on a random node: the controller's
+        failure sweep (condition + gauge + Event dedupe) must stay
+        consistent under the same churn as everything else."""
+        if not self.live_nodes:
+            return
+        name = self.rng.choice(self.live_nodes)
+        roll = self.rng.random()
+        if roll < 0.4:
+            labels = {consts.TPU_SLICE_CONFIG_LABEL: "split-2x2",
+                      consts.TPU_SLICE_STATE_LABEL: "failed"}
+        elif roll < 0.7:
+            labels = {consts.TPU_SLICE_CONFIG_LABEL: "split-2x2",
+                      consts.TPU_SLICE_STATE_LABEL: "success"}
+        else:
+            labels = {consts.TPU_SLICE_CONFIG_LABEL: None,
+                      consts.TPU_SLICE_STATE_LABEL: None}
+        try:
+            self.client.patch("v1", "Node", name, {"metadata": {"labels": labels}})
+        except ApiError:
+            pass  # node deleted mid-choice; chaos is like that
+
+    def restore_slices(self, wait_for):
+        for name in list(self.live_nodes):
+            wait_for(lambda n=name: self.client.patch(
+                "v1", "Node", n, {"metadata": {"labels": {
+                    consts.TPU_SLICE_CONFIG_LABEL: None,
+                    consts.TPU_SLICE_STATE_LABEL: None}}}) is not None,
+                timeout=10, message=f"clear slice labels on {name}")
+
     def restore_operands(self, wait_for):
         for operand in ("telemetry", "featureDiscovery", "nodeStatusExporter"):
             wait_for(lambda op=operand: self.client.patch(
@@ -126,6 +156,19 @@ class ChaosKit:
         wait_for(lambda: deep_get(
             self.client.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy"),
             "status", "state") == "ready", message="ready after chaos")
+
+        def slice_condition_settled():
+            # slice labels were cleared in restore: the failure condition
+            # must read False/absent once a sweep has observed that
+            policy = self.client.get("tpu.ai/v1", "ClusterPolicy",
+                                     "cluster-policy")
+            for cond in deep_get(policy, "status", "conditions",
+                                 default=[]) or []:
+                if cond.get("type") == "SlicePartitionFailed":
+                    return cond.get("status") != "True"
+            return True
+        wait_for(slice_condition_settled,
+                 message="SlicePartitionFailed cleared after restore")
 
 
 def test_chaos_soak_with_ha_replicas_converges():
@@ -186,7 +229,8 @@ def test_chaos_soak_with_ha_replicas_converges():
 
     actions = [kit.add_node] * 3 + [kit.remove_node] + \
         [kit.flip_operand] * 3 + [kit.delete_random_ds] * 2 + \
-        [kit.bump_driver] + [kit.restart_apiserver] + [kill_leader]
+        [kit.bump_driver] + [kit.restart_apiserver] + \
+        [kit.churn_slice_state] * 2 + [kill_leader]
 
     try:
         kit.add_node()
@@ -210,6 +254,7 @@ def test_chaos_soak_with_ha_replicas_converges():
             time.sleep(rng.uniform(0.05, 0.25))
 
         kit.restore_operands(wait_for)
+        kit.restore_slices(wait_for)
         kit.assert_converged(wait_for)
     finally:
         for replica in replicas.values():
@@ -237,7 +282,8 @@ def test_chaos_soak_converges():
 
     actions = [kit.add_node] * 3 + [kit.remove_node] * 2 + \
         [kit.flip_operand] * 3 + [kit.delete_random_ds] * 2 + \
-        [kit.bump_driver] * 2 + [kit.restart_apiserver]
+        [kit.bump_driver] * 2 + [kit.restart_apiserver] + \
+        [kit.churn_slice_state] * 2
 
     try:
         kit.add_node()
@@ -264,6 +310,7 @@ def test_chaos_soak_converges():
 
         # restore a known-good end state, then full convergence
         kit.restore_operands(wait_for)
+        kit.restore_slices(wait_for)
         kit.assert_converged(wait_for)
 
         def core_ds_healthy():
