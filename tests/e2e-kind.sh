@@ -247,6 +247,30 @@ timeout 120 bash -c \
 echo "ok: telemetry DS recreated"
 record pass operand-disable-enable
 
+echo "=== drift heal: out-of-band edit to a rendered object is reverted ==="
+# Drop the ports from the operator-rendered telemetry Service — kubectl
+# drift the operator must reconcile away. On a REAL apiserver this also
+# proves the _covers subset check tolerates server-side defaulting
+# (clusterIP, port protocol) without looping: after the heal, two quiet
+# sweeps must NOT log further drift warnings for this object.
+SVC=tpu-telemetry-exporter
+ORIG_PORT=$(kubectl -n "$NS" get svc "$SVC" -o jsonpath='{.spec.ports[0].port}')
+kubectl -n "$NS" patch svc "$SVC" --type merge \
+  -p '{"spec":{"ports":[{"name":"metrics","port":19999,"targetPort":19999}]}}'
+timeout 120 bash -c '
+  until [ "$(kubectl -n '"$NS"' get svc '"$SVC"' \
+      -o jsonpath="{.spec.ports[0].port}")" = "'"$ORIG_PORT"'" ]; do sleep 2; done'
+echo "ok: rendered Service port healed back to $ORIG_PORT"
+sleep 25  # two resync sweeps on a quiet object
+HEALS=$(kubectl -n "$NS" logs deploy/tpu-operator --since=20s 2>/dev/null \
+        | grep "drifted from rendered spec" | grep -c "$SVC" || true)
+if [ "${HEALS:-0}" -gt 1 ]; then
+  echo "FAIL: drift heal loops on a quiet object ($HEALS warnings in 20s —"
+  echo "      server-side normalization fights the rendered spec)"
+  record fail drift-heal "heal loop: $HEALS warnings"; exit 1
+fi
+record pass drift-heal "healed; no loop"
+
 echo "=== ClusterPolicy delete garbage-collects owned objects ==="
 kubectl delete clusterpolicies.tpu.ai/cluster-policy --wait
 timeout 180 bash -c \

@@ -354,3 +354,35 @@ def test_tpudriver_e2e_over_wire(cluster):
                         "libtpu-driver-main-v5-lite-podslice-2x4", "tpu-operator")
         return ds["spec"]["template"]["spec"]["containers"][0]["image"].endswith(":2.0")
     wait_for(rolled, message="per-pool DS image roll")
+
+
+def test_out_of_band_drift_healed_over_wire(cluster):
+    """kubectl-style drift against a rendered object through the real HTTP
+    path: rewriting the telemetry Service's port out-of-band must be
+    healed by the running operator within a resync sweep — the fingerprint
+    skip alone would never rewrite it (the stored hash still matches the
+    operator's last write)."""
+    client, app = cluster["client"], cluster["app"]
+    client.create({"apiVersion": "v1", "kind": "Node",
+                   "metadata": {"name": "tpu-0", "labels": dict(TPU_LABELS)},
+                   "status": {}})
+    client.create(new_cluster_policy())
+    app.start()
+    wait_for(lambda: policy_state(client) == "ready", message="install ready")
+
+    svc = client.get("v1", "Service", "tpu-telemetry-exporter", "tpu-operator")
+    original_port = svc["spec"]["ports"][0]["port"]
+    # the drift must be asserted from the WRITE's response — a re-read
+    # races the running operator's next heal sweep (10 s resync)
+    drifted = client.patch("v1", "Service", "tpu-telemetry-exporter",
+                           {"spec": {"ports": [{"name": "metrics",
+                                                "port": 19999,
+                                                "targetPort": 19999}]}},
+                           "tpu-operator")
+    assert drifted["spec"]["ports"][0]["port"] == 19999
+
+    def healed():
+        live = client.get("v1", "Service", "tpu-telemetry-exporter",
+                          "tpu-operator")
+        return live["spec"]["ports"][0]["port"] == original_port
+    wait_for(healed, message="drifted Service port healed")
