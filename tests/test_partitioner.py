@@ -326,3 +326,98 @@ def test_stale_handoff_from_old_version_recomputed(fake_client, config_path,
     before = _os.path.getmtime(_os.path.join(handoff, "partition.json"))
     assert sync_once(fake_client, "n1", config_path, handoff) == "success"
     assert _os.path.getmtime(_os.path.join(handoff, "partition.json")) == before
+
+
+def mk_consumer(fake_client, name="train", node="n1", phase="Running",
+                init_only=False):
+    ctr = {"name": "c", "image": "user:1"}
+    res = {"resources": {"limits": {consts.TPU_RESOURCE_NAME: "4"}}}
+    pod = {"apiVersion": "v1", "kind": "Pod",
+           "metadata": {"name": name, "namespace": "ml-team"},
+           "spec": {"nodeName": node, "containers": [ctr]},
+           "status": {"phase": phase}}
+    if init_only:
+        pod["spec"]["initContainers"] = [{"name": "warm", **res}]
+    else:
+        ctr.update(res)
+    return fake_client.create(pod)
+
+
+def test_repartition_deferred_while_tpu_in_use(fake_client, config_path,
+                                               tmp_path):
+    """Changing the layout re-IDs every schedulable unit; a node with a
+    running TPU consumer must stay pending (mig-manager refuses to
+    reconfigure a busy GPU) and apply only after the node drains."""
+    handoff = str(tmp_path / "handoff")
+    mk_node(fake_client, config="v5e-2x2-pair")
+    assert sync_once(fake_client, "n1", config_path, handoff) == "success"
+
+    mk_consumer(fake_client)
+    fake_client.patch("v1", "Node", "n1", {"metadata": {"labels": {
+        consts.TPU_SLICE_CONFIG_LABEL: "single-chip"}}})
+    assert sync_once(fake_client, "n1", config_path, handoff) == "pending"
+    # the OLD handoff stays live for the consumer's allocation
+    assert read_handoff(handoff)["partition"] == "v5e-2x2-pair"
+
+    # consumer finishes -> next pass applies the new layout
+    pod = fake_client.get("v1", "Pod", "train", "ml-team")
+    pod["status"]["phase"] = "Succeeded"
+    fake_client.update_status(pod)
+    assert sync_once(fake_client, "n1", config_path, handoff) == "success"
+    assert read_handoff(handoff)["partition"] == "single-chip"
+
+
+def test_first_partition_also_deferred_under_consumer(fake_client,
+                                                      config_path, tmp_path):
+    """Even the FIRST handoff write re-IDs units (the plugin was
+    advertising per-chip defaults), so an initContainer-only consumer
+    defers it too."""
+    handoff = str(tmp_path / "handoff")
+    mk_node(fake_client, config="v5e-2x2-pair")
+    mk_consumer(fake_client, init_only=True)
+    assert sync_once(fake_client, "n1", config_path, handoff) == "pending"
+    assert read_handoff(handoff) is None
+    fake_client.delete("v1", "Pod", "train", "ml-team")
+    assert sync_once(fake_client, "n1", config_path, handoff) == "success"
+
+
+def test_unpartition_deferred_while_tpu_in_use(fake_client, config_path,
+                                               tmp_path):
+    """Removing the config label reverts to per-chip units — a layout
+    change like any other; with a consumer running the handoff must stay
+    live and the node sits at pending until the drain."""
+    handoff = str(tmp_path / "handoff")
+    mk_node(fake_client, config="v5e-2x2-pair")
+    assert sync_once(fake_client, "n1", config_path, handoff) == "success"
+    mk_consumer(fake_client)
+    fake_client.patch("v1", "Node", "n1", {"metadata": {"labels": {
+        consts.TPU_SLICE_CONFIG_LABEL: None}}})
+    assert sync_once(fake_client, "n1", config_path, handoff) == "pending"
+    assert read_handoff(handoff) is not None  # old layout stays live
+    labels = fake_client.get("v1", "Node", "n1")["metadata"]["labels"]
+    assert labels[consts.TPU_SLICE_STATE_LABEL] == "pending"
+
+    fake_client.delete("v1", "Pod", "train", "ml-team")
+    assert sync_once(fake_client, "n1", config_path, handoff) is None
+    assert read_handoff(handoff) is None
+    labels = fake_client.get("v1", "Node", "n1")["metadata"]["labels"]
+    assert consts.TPU_SLICE_STATE_LABEL not in labels
+
+
+def test_lost_success_write_heals_under_consumer(fake_client, config_path,
+                                                 tmp_path):
+    """Crash after write_handoff but before the success patch leaves
+    state=pending with a live correct handoff; pods scheduled against
+    that very layout must not wedge the label at pending forever — the
+    content-identical path heals it without consulting the in-use
+    guard."""
+    handoff = str(tmp_path / "handoff")
+    mk_node(fake_client, config="v5e-2x2-pair")
+    assert sync_once(fake_client, "n1", config_path, handoff) == "success"
+    # simulate the lost success write + a consumer using the layout
+    fake_client.patch("v1", "Node", "n1", {"metadata": {"labels": {
+        consts.TPU_SLICE_STATE_LABEL: "pending"}}})
+    mk_consumer(fake_client)
+    assert sync_once(fake_client, "n1", config_path, handoff) == "success"
+    labels = fake_client.get("v1", "Node", "n1")["metadata"]["labels"]
+    assert labels[consts.TPU_SLICE_STATE_LABEL] == "success"

@@ -93,6 +93,26 @@ def read_handoff(handoff_dir: str = DEFAULT_HANDOFF_DIR) -> Optional[dict]:
         return None
 
 
+def tpu_consumers_on(client, node_name: str) -> int:
+    """Live pods on the node holding TPU resource. Repartitioning changes
+    the device IDs the plugin advertises, so applying a new layout under a
+    running consumer would strand its allocation — the reference's
+    mig-manager refuses to reconfigure a GPU in use (mig-parted fails on
+    busy GPUs) and waits for the node to drain; same contract here.
+
+    Best-effort, not a lock: a pod can bind between this check and the
+    handoff write (mig-manager closes the window by cordoning first).
+    For a guaranteed-safe repartition, cordon + drain the node before
+    changing ``tpu.ai/slice.config`` — documented in configuration.md."""
+    from ..utils import pod_requests_resource
+
+    return sum(
+        1 for pod in client.list("v1", "Pod", None,
+                                 field_selector={"spec.nodeName": node_name})
+        if deep_get(pod, "status", "phase") not in ("Succeeded", "Failed")
+        and pod_requests_resource(pod, consts.TPU_RESOURCE_NAME))
+
+
 def sync_once(client, node_name: str, config_path: str,
               handoff_dir: str = DEFAULT_HANDOFF_DIR,
               total_chips: Optional[int] = None) -> Optional[str]:
@@ -103,6 +123,18 @@ def sync_once(client, node_name: str, config_path: str,
     state = labels.get(consts.TPU_SLICE_STATE_LABEL)
     if not desired:
         if state:  # config removed: clear our state label + handoff
+            if (read_handoff(handoff_dir) is not None
+                    and tpu_consumers_on(client, node_name)):
+                # un-partitioning is a layout change too: reverting to
+                # per-chip default units re-IDs everything, so it waits
+                # for the node to drain exactly like a repartition
+                log.warning("partition removal on %s deferred: TPU "
+                            "consumer(s) still running", node_name)
+                if state != STATE_PENDING:
+                    client.patch("v1", "Node", node_name, {"metadata": {
+                        "labels": {consts.TPU_SLICE_STATE_LABEL:
+                                   STATE_PENDING}}})
+                return STATE_PENDING
             client.patch("v1", "Node", node_name,
                          {"metadata": {"labels": {consts.TPU_SLICE_STATE_LABEL: None}}})
             try:
@@ -139,15 +171,31 @@ def sync_once(client, node_name: str, config_path: str,
             return STATE_PENDING
         groups = compute_partition(table[desired], total_chips, accelerator)
         grid = list(topology.host_grid(accelerator, total_chips))
-        if (state == STATE_SUCCESS and current
-                and current.get("partition") == desired
+        if (current and current.get("partition") == desired
                 and current.get("groups") == groups
                 and current.get("grid") == grid):
             # already applied — verified by CONTENT, not just the partition
             # name: a handoff written by an older partitioner version
             # (sequential chip groups, no grid) must be recomputed on
-            # upgrade, or the device plugin keeps advertising it
+            # upgrade, or the device plugin keeps advertising it. NOT
+            # gated on the state label: a success write lost to a crash
+            # leaves state=pending with a live correct handoff, and pods
+            # scheduled against that very layout must not block the
+            # label from healing to success (the in-use guard below only
+            # applies to actual content changes)
+            if state != STATE_SUCCESS:
+                set_state(STATE_SUCCESS)
             return STATE_SUCCESS
+        busy = tpu_consumers_on(client, node_name)
+        if busy:
+            # changing the layout re-IDs every schedulable unit; never
+            # yank them from under a running consumer — stay pending until
+            # the node drains (mig-manager semantics), retried each pass
+            set_state(STATE_PENDING)
+            log.warning("partition %s on %s: %d TPU-consuming pod(s) "
+                        "running; repartition deferred until the node "
+                        "drains", desired, node_name, busy)
+            return STATE_PENDING
         set_state(STATE_PENDING)
         write_handoff(groups, desired, handoff_dir, grid=grid)
         set_state(STATE_SUCCESS)

@@ -239,18 +239,12 @@ class UpgradeStateMachine:
 
     @staticmethod
     def _requests_tpu(pod: dict) -> bool:
-        """TPU consumption in ANY container — initContainers too (an
-        init-time preflight holding the chips blocks a driver restart just
-        as hard), and requests as well as limits (reference
-        gpuPodSpecFilter, cmd/gpu-operator/main.go:211-233)."""
-        spec = pod.get("spec", {}) or {}
-        for ctr in ((spec.get("containers") or [])
-                    + (spec.get("initContainers") or [])):
-            resources = ctr.get("resources") or {}
-            for section in ("limits", "requests"):
-                if consts.TPU_RESOURCE_NAME in (resources.get(section) or {}):
-                    return True
-        return False
+        """TPU consumption in ANY container (shared helper: the slice
+        partitioner's in-use guard uses the same detection, so the two
+        sweeps cannot drift)."""
+        from ..utils import pod_requests_resource
+
+        return pod_requests_resource(pod, consts.TPU_RESOURCE_NAME)
 
     def _tpu_consumer_pods(self, node_name: str) -> List[dict]:
         """Pods on the node actively holding TPU chips that the upgrade must

@@ -6,6 +6,7 @@ from .objects import (
     json_merge_patch,
     obj_key,
     parse_quantity,
+    pod_requests_resource,
     rfc3339_now,
     same_object,
 )
@@ -19,6 +20,7 @@ __all__ = [
     "json_merge_patch",
     "obj_key",
     "parse_quantity",
+    "pod_requests_resource",
     "rfc3339_now",
     "same_object",
 ]

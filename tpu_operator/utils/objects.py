@@ -99,3 +99,17 @@ def iter_containers(pod_spec: Mapping) -> Iterable[dict]:
     for field in ("initContainers", "containers"):
         for c in pod_spec.get(field, []) or []:
             yield c
+
+
+def pod_requests_resource(pod: Mapping, resource: str) -> bool:
+    """True when ANY container (initContainers included — an init-time
+    preflight holds devices just as hard) requests or limits ``resource``
+    (reference gpuPodSpecFilter, cmd/gpu-operator/main.go:211-233 checks
+    both sections). Shared by the upgrade drain sweep and the slice
+    partitioner's in-use guard so consumer detection cannot drift."""
+    for container in iter_containers(pod.get("spec") or {}):
+        resources = container.get("resources") or {}
+        for section in ("limits", "requests"):
+            if resource in (resources.get(section) or {}):
+                return True
+    return False
