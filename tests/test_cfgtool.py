@@ -283,3 +283,29 @@ def test_multi_arch_mk():
     assert out.count("--platform=linux/amd64\n") + \
         out.count("--platform=linux/amd64 ") >= 1       # validator
     assert "docker/validator.Dockerfile" in out
+
+
+def test_validate_partitions_offline(tmp_path, capsys):
+    """`tpuop-cfg validate-partitions` runs the node partitioner's exact
+    tiler offline: valid tables print derived groups, impossible splits
+    fail at review time instead of as live SlicePartitionFailed nodes."""
+    table = tmp_path / "partitions.yaml"
+    table.write_text("""
+partitions:
+  split-2x2:
+    - {chips: 4}
+    - {chips: 4}
+  broken:
+    - {chips: 8, topology: 1x8}
+""")
+    assert run(["validate-partitions", str(table)]) == 1
+    out = capsys.readouterr().out
+    assert "'split-2x2' on tpu-v5-lite-podslice/8 chips: OK" in out
+    assert "2x2[0, 1, 4, 5]" in out
+    assert "'broken'" in out and "INVALID" in out
+
+    good = tmp_path / "good.yaml"
+    good.write_text("partitions:\n  singles:\n    - {chips: 1, count: all}\n")
+    assert run(["validate-partitions", str(good),
+                "--accelerator", "tpu-v4-podslice", "--chips", "4"]) == 0
+    assert "1x1x1" in capsys.readouterr().out

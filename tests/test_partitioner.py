@@ -421,3 +421,70 @@ def test_lost_success_write_heals_under_consumer(fake_client, config_path,
     assert sync_once(fake_client, "n1", config_path, handoff) == "success"
     labels = fake_client.get("v1", "Node", "n1")["metadata"]["labels"]
     assert labels[consts.TPU_SLICE_STATE_LABEL] == "success"
+
+
+def test_transient_list_failure_defers_not_fails(fake_client, config_path,
+                                                 tmp_path):
+    """One apiserver blip on the consumer check during a repartition must
+    read pending (retry next pass), never failed — state=failed fires the
+    SlicePartitionFailed alert for a node whose table is perfectly
+    valid."""
+    handoff = str(tmp_path / "handoff")
+    mk_node(fake_client, config="v5e-2x2-pair")
+    assert sync_once(fake_client, "n1", config_path, handoff) == "success"
+    fake_client.patch("v1", "Node", "n1", {"metadata": {"labels": {
+        consts.TPU_SLICE_CONFIG_LABEL: "single-chip"}}})
+
+    real_list = fake_client.list
+
+    def flaky_list(api_version, kind, *a, **kw):
+        if kind == "Pod":
+            raise ConnectionError("apiserver blip")
+        return real_list(api_version, kind, *a, **kw)
+
+    fake_client.list = flaky_list
+    try:
+        assert sync_once(fake_client, "n1", config_path, handoff) == "pending"
+    finally:
+        fake_client.list = real_list
+    assert read_handoff(handoff)["partition"] == "v5e-2x2-pair"
+    assert sync_once(fake_client, "n1", config_path, handoff) == "success"
+
+
+def test_busy_deferral_does_not_repatch_pending(fake_client, config_path,
+                                                tmp_path):
+    """A node parked at pending behind a long-running consumer must not
+    get a redundant label PATCH every pass (hundreds of no-op writes per
+    draining node otherwise)."""
+    handoff = str(tmp_path / "handoff")
+    mk_node(fake_client, config="v5e-2x2-pair")
+    assert sync_once(fake_client, "n1", config_path, handoff) == "success"
+    mk_consumer(fake_client)
+    fake_client.patch("v1", "Node", "n1", {"metadata": {"labels": {
+        consts.TPU_SLICE_CONFIG_LABEL: "single-chip"}}})
+    assert sync_once(fake_client, "n1", config_path, handoff) == "pending"
+
+    patches = {"n": 0}
+    real_patch = fake_client.patch
+
+    def counting_patch(api_version, kind, name, patch, namespace=None):
+        if kind == "Node":
+            patches["n"] += 1
+        return real_patch(api_version, kind, name, patch, namespace)
+
+    fake_client.patch = counting_patch
+    try:
+        for _ in range(3):
+            assert sync_once(fake_client, "n1", config_path,
+                             handoff) == "pending"
+    finally:
+        fake_client.patch = real_patch
+    assert patches["n"] == 0
+
+
+def test_malformed_yaml_table_fails_cleanly(fake_client, tmp_path):
+    handoff = str(tmp_path / "handoff")
+    bad = tmp_path / "bad.yaml"
+    bad.write_text("partitions: [unclosed")
+    mk_node(fake_client, config="anything")
+    assert sync_once(fake_client, "n1", str(bad), handoff) == "failed"

@@ -3,7 +3,11 @@ validates ClusterPolicy samples + CSV image digests in CI).
 
 Subcommands:
   validate <file.yaml>...   parse + spec-validate ClusterPolicy/TPUDriver docs
-  validate-csv <csv.yaml>   validate the OLM CSV's alm-examples CRs
+  validate-csv <csv.yaml>   validate the OLM CSV's alm-examples CRs,
+                            relatedImages digests, and replaces edge
+  validate-partitions <table.yaml> [--accelerator A --chips N]
+                            validate a slice-partition table offline
+                            against the generation's physical chip grid
   sample [clusterpolicy|tpudriver]   print a complete sample CR
   status [--base-url URL]   live-cluster triage summary (exit 0 iff ready)
 """
@@ -302,6 +306,34 @@ def _validate_csv_images(csv: dict, path: str) -> bool:
     return failed
 
 
+def validate_partitions(path: str, accelerator: str, chips: int) -> int:
+    """Validate a slice-partition table offline against a generation's
+    physical chip grid — the same tiler the node partitioner runs, so an
+    impossible split is caught at review time instead of as a
+    SlicePartitionFailed condition on live nodes."""
+    from ..partitioner.partitioner import PartitionError, compute_partition, load_config
+
+    try:
+        table = load_config(path)
+    except (OSError, PartitionError) as e:
+        print(f"{path}: unreadable: {e}")
+        return 1
+    failed = False
+    for name in sorted(table):
+        try:
+            groups = compute_partition(table[name], chips, accelerator)
+        except PartitionError as e:
+            print(f"{path}: partition {name!r} on {accelerator}/{chips} "
+                  f"chips: INVALID: {e}")
+            failed = True
+            continue
+        rendered = ", ".join(
+            f"{g['topology']}{g['chips']}" for g in groups) or "(empty)"
+        print(f"{path}: partition {name!r} on {accelerator}/{chips} "
+              f"chips: OK: {rendered}")
+    return 1 if failed else 0
+
+
 def status(base_url=None, namespace="tpu-operator", out=None,
            token=None) -> int:
     """One-command cluster triage: ClusterPolicy verdict + conditions,
@@ -399,6 +431,13 @@ def run(argv=None) -> int:
     v.add_argument("files", nargs="+")
     c = sub.add_parser("validate-csv")
     c.add_argument("csv")
+    vp = sub.add_parser("validate-partitions",
+                        help="validate a slice-partition table against a "
+                             "generation's physical chip grid")
+    vp.add_argument("table", help="partition-table YAML (ConfigMap data "
+                                  "payload: a 'partitions:' mapping)")
+    vp.add_argument("--accelerator", default="tpu-v5-lite-podslice")
+    vp.add_argument("--chips", type=int, default=8)
     s = sub.add_parser("sample")
     s.add_argument("kind", nargs="?", default="clusterpolicy",
                    choices=["clusterpolicy", "tpudriver"])
@@ -416,6 +455,9 @@ def run(argv=None) -> int:
 
     if args.cmd == "validate-csv":
         return validate_csv(args.csv)
+
+    if args.cmd == "validate-partitions":
+        return validate_partitions(args.table, args.accelerator, args.chips)
 
     if args.cmd == "sample":
         sample = SAMPLE_CLUSTER_POLICY if args.kind == "clusterpolicy" else SAMPLE_TPU_DRIVER

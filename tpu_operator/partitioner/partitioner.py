@@ -27,7 +27,7 @@ from typing import Dict, List, Optional
 import yaml
 
 from .. import consts
-from ..utils import deep_get
+from ..utils import deep_get, pod_requests_resource
 from ..validator.driver import discover_devices
 from . import topology
 
@@ -46,9 +46,14 @@ class PartitionError(ValueError):
 
 
 def load_config(path: str) -> Dict[str, List[dict]]:
-    with open(path) as f:
-        raw = yaml.safe_load(f) or {}
-    partitions = raw.get("partitions")
+    try:
+        with open(path) as f:
+            raw = yaml.safe_load(f) or {}
+    except yaml.YAMLError as e:
+        # a malformed ConfigMap is a config failure, not a crash: the node
+        # path reports state=failed and the offline validator prints it
+        raise PartitionError(f"{path}: invalid YAML: {e}") from e
+    partitions = raw.get("partitions") if isinstance(raw, dict) else None
     if not isinstance(partitions, dict):
         raise PartitionError(f"{path}: missing 'partitions' mapping")
     return partitions
@@ -104,13 +109,23 @@ def tpu_consumers_on(client, node_name: str) -> int:
     handoff write (mig-manager closes the window by cordoning first).
     For a guaranteed-safe repartition, cordon + drain the node before
     changing ``tpu.ai/slice.config`` — documented in configuration.md."""
-    from ..utils import pod_requests_resource
-
     return sum(
         1 for pod in client.list("v1", "Pod", None,
                                  field_selector={"spec.nodeName": node_name})
         if deep_get(pod, "status", "phase") not in ("Succeeded", "Failed")
         and pod_requests_resource(pod, consts.TPU_RESOURCE_NAME))
+
+
+def _consumers_or_none(client, node_name: str) -> Optional[int]:
+    """tpu_consumers_on, with a transient pod-list failure reported as
+    None (defer) rather than raised — one apiserver blip mid-pass must
+    not flip a node with a valid table to state=failed."""
+    try:
+        return tpu_consumers_on(client, node_name)
+    except Exception as e:
+        log.warning("partition consumer check on %s failed (%s); "
+                    "deferring", node_name, e)
+        return None
 
 
 def sync_once(client, node_name: str, config_path: str,
@@ -123,8 +138,8 @@ def sync_once(client, node_name: str, config_path: str,
     state = labels.get(consts.TPU_SLICE_STATE_LABEL)
     if not desired:
         if state:  # config removed: clear our state label + handoff
-            if (read_handoff(handoff_dir) is not None
-                    and tpu_consumers_on(client, node_name)):
+            if read_handoff(handoff_dir) is not None \
+                    and _consumers_or_none(client, node_name) != 0:
                 # un-partitioning is a layout change too: reverting to
                 # per-chip default units re-IDs everything, so it waits
                 # for the node to drain exactly like a repartition
@@ -186,15 +201,21 @@ def sync_once(client, node_name: str, config_path: str,
             if state != STATE_SUCCESS:
                 set_state(STATE_SUCCESS)
             return STATE_SUCCESS
-        busy = tpu_consumers_on(client, node_name)
-        if busy:
+        busy = _consumers_or_none(client, node_name)
+        if busy != 0:
             # changing the layout re-IDs every schedulable unit; never
             # yank them from under a running consumer — stay pending until
-            # the node drains (mig-manager semantics), retried each pass
-            set_state(STATE_PENDING)
-            log.warning("partition %s on %s: %d TPU-consuming pod(s) "
-                        "running; repartition deferred until the node "
-                        "drains", desired, node_name, busy)
+            # the node drains (mig-manager semantics), retried each pass.
+            # busy=None (pod list failed transiently) also defers: a
+            # node we can't PROVE drained is not safe to repartition, and
+            # a transient apiserver blip must not read as failed
+            if state != STATE_PENDING:
+                set_state(STATE_PENDING)
+            log.warning("partition %s on %s: %s; repartition deferred "
+                        "until the node is provably drained",
+                        desired, node_name,
+                        "consumer check unavailable" if busy is None
+                        else f"{busy} TPU-consuming pod(s) running")
             return STATE_PENDING
         set_state(STATE_PENDING)
         write_handoff(groups, desired, handoff_dir, grid=grid)

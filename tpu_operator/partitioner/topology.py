@@ -185,6 +185,9 @@ def tile_partition(accelerator: str, total_chips: int,
     shapes: List[Tuple[int, ...]] = []
     used = 0
     for entry in layout or []:
+        if not isinstance(entry, dict):
+            raise TopologyError(
+                f"layout entries must be mappings, got {entry!r}")
         chips = int(entry.get("chips", 1))
         if chips <= 0:
             raise TopologyError(f"invalid chips count {chips}")

@@ -32,7 +32,7 @@ from .. import consts
 from ..api.common import UpgradePolicySpec
 from ..client.errors import ApiError, NotFoundError, TooManyRequestsError
 from ..client.interface import Client
-from ..utils import deep_get
+from ..utils import deep_get, pod_requests_resource
 
 log = logging.getLogger(__name__)
 
@@ -242,8 +242,6 @@ class UpgradeStateMachine:
         """TPU consumption in ANY container (shared helper: the slice
         partitioner's in-use guard uses the same detection, so the two
         sweeps cannot drift)."""
-        from ..utils import pod_requests_resource
-
         return pod_requests_resource(pod, consts.TPU_RESOURCE_NAME)
 
     def _tpu_consumer_pods(self, node_name: str) -> List[dict]:
