@@ -48,11 +48,12 @@ nodes_settled() {
         for n in obj["items"]) else sys.exit(1)'
 }
 
-wait_for "TPU-holding user pod evicted (component label no shield)" 90 pod_gone
+# generous margins: this runs inside the full pytest suite on one core
+wait_for "TPU-holding user pod evicted (component label no shield)" 240 pod_gone
 ds_rolled() { ds_image libtpu-driver | grep -q "0.3.0"; }
-wait_for "driver DS rolled to 0.3.0" 90 ds_rolled
-wait_for "all nodes uncordoned, upgrade labels cleared" 120 nodes_settled
-wait_for "ClusterPolicy ready after upgrade" 60 cp_state_is ready
+wait_for "driver DS rolled to 0.3.0" 240 ds_rolled
+wait_for "all nodes uncordoned, upgrade labels cleared" 240 nodes_settled
+wait_for "ClusterPolicy ready after upgrade" 120 cp_state_is ready
 pod_present >/dev/null || { echo "FAIL: DaemonSet-owned pod was evicted" >&2; exit 1; }
 echo "ok: DaemonSet-owned user pod survived the drain"
 

@@ -488,3 +488,10 @@ def test_malformed_yaml_table_fails_cleanly(fake_client, tmp_path):
     bad.write_text("partitions: [unclosed")
     mk_node(fake_client, config="anything")
     assert sync_once(fake_client, "n1", str(bad), handoff) == "failed"
+
+
+def test_nonsense_layout_values_fail_cleanly():
+    with pytest.raises(PartitionError, match="chips must be an integer"):
+        compute_partition([{"chips": "four"}], 8, V5E)
+    with pytest.raises(PartitionError, match="count must be an integer"):
+        compute_partition([{"chips": 2, "count": {}}], 8, V5E)

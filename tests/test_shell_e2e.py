@@ -18,10 +18,19 @@ SCRIPT = REPO_ROOT / "tests" / "scripts" / "end-to-end.sh"
 @pytest.mark.slow
 @pytest.mark.skipif(shutil.which("curl") is None, reason="curl not available")
 def test_shell_end_to_end():
-    proc = subprocess.run(
-        ["bash", str(SCRIPT)], cwd=REPO_ROOT,
-        capture_output=True, text=True, timeout=600,
-    )
+    try:
+        proc = subprocess.run(
+            ["bash", str(SCRIPT)], cwd=REPO_ROOT,
+            # the per-wait budgets inside cases are the primary failure
+            # detectors; this outer bound is a backstop against a harness
+            # hang and must report the partial output when it fires
+            capture_output=True, text=True, timeout=1200,
+        )
+    except subprocess.TimeoutExpired as e:
+        out = e.stdout.decode() if isinstance(e.stdout, bytes) else (e.stdout or "")
+        err = e.stderr.decode() if isinstance(e.stderr, bytes) else (e.stderr or "")
+        pytest.fail(f"shell e2e exceeded the outer 1200s backstop\n"
+                    f"--- stdout ---\n{out[-8000:]}\n--- stderr ---\n{err[-4000:]}")
     assert proc.returncode == 0, (
         f"shell e2e failed\n--- stdout ---\n{proc.stdout[-8000:]}"
         f"\n--- stderr ---\n{proc.stderr[-4000:]}"

@@ -70,6 +70,9 @@ def compute_partition(layout: List[dict], total_chips: int,
     try:
         return topology.tile_partition(accelerator, total_chips, layout)
     except topology.TopologyError as e:
+        # config nonsense (typed chips/count/topology/shape problems) is a
+        # partition failure with an entry-naming reason; anything ELSE
+        # escaping the tiler is a code bug and stays a loud traceback
         raise PartitionError(str(e)) from e
 
 

@@ -188,15 +188,26 @@ def tile_partition(accelerator: str, total_chips: int,
         if not isinstance(entry, dict):
             raise TopologyError(
                 f"layout entries must be mappings, got {entry!r}")
-        chips = int(entry.get("chips", 1))
+        try:
+            chips = int(entry.get("chips", 1))
+        except (TypeError, ValueError):
+            raise TopologyError(
+                f"entry {entry!r}: chips must be an integer") from None
         if chips <= 0:
             raise TopologyError(f"invalid chips count {chips}")
         shape = _box_shape(accelerator, chips, entry.get("topology"), grid)
         count = entry.get("count", 1)
         # clamp: an "all" entry after an overflowing fixed-count one must
         # not decrement `used` and mask the explicit overflow diagnostic
-        n = max((total_chips - used) // chips, 0) if count == "all" \
-            else int(count)
+        if count == "all":
+            n = max((total_chips - used) // chips, 0)
+        else:
+            try:
+                n = int(count)
+            except (TypeError, ValueError):
+                raise TopologyError(
+                    f"entry {entry!r}: count must be an integer or "
+                    f"'all'") from None
         shapes.extend([shape] * n)
         used += chips * n
     if used > total_chips:
