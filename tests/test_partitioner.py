@@ -495,3 +495,31 @@ def test_nonsense_layout_values_fail_cleanly():
         compute_partition([{"chips": "four"}], 8, V5E)
     with pytest.raises(PartitionError, match="count must be an integer"):
         compute_partition([{"chips": 2, "count": {}}], 8, V5E)
+
+
+def test_shipped_default_partition_table_is_valid():
+    """The default table baked into the slice-partitioner ConfigMap must
+    tile on the generations it names — a shipped default that the tiler
+    rejects would fail every node that selects it (render the real
+    template, parse the real payload, run the real tiler)."""
+    import pathlib
+
+    import yaml
+
+    from tpu_operator.partitioner import topology as topo
+
+    template = pathlib.Path(topo.__file__).parents[1] / "manifests" \
+        / "state-slice-partitioner" / "0400_configmap.yaml"
+    # default branch of the template: strip the Jinja control lines and
+    # keep the literal payload
+    lines = [ln[4:] for ln in template.read_text().splitlines()
+             if ln.startswith("    ")]
+    table = yaml.safe_load("\n".join(lines))["partitions"]
+    assert set(table) == {"all-disabled", "v5e-2x2-pair", "single-chip"}
+    # every named partition must be valid on at least the host it targets
+    assert compute_partition(table["all-disabled"], 8, V5E) == []
+    assert len(compute_partition(table["v5e-2x2-pair"], 8, V5E)) == 2
+    for accelerator, chips in ((V5E, 8), (V5E, 4), ("tpu-v4-podslice", 4),
+                               ("tpu-v5p-slice", 4), ("tpu-v3", 4)):
+        singles = compute_partition(table["single-chip"], chips, accelerator)
+        assert len(singles) == chips, (accelerator, chips)
