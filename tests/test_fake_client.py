@@ -237,3 +237,39 @@ def test_pdb_with_neither_bound_blocks(fake_client):
     fake_client.create(_mk_pdb("pdb", selector={"app": "x"}))
     with pytest.raises(TooManyRequestsError):
         fake_client.evict("w", "ns1")
+
+
+def test_create_against_deleted_owner_is_garbage_collected(fake_client):
+    """The owner-deleted-mid-sweep race: a reconcile in flight when its CR
+    is deleted re-creates operands owned by the now-gone uid. The real GC
+    removes them shortly after; the fake does so immediately — else they
+    live forever and uninstall never converges. Never-created owner uids
+    are NOT collected (fixture convenience: pods 'owned' by a DS the test
+    didn't bother creating)."""
+    owner = fake_client.create({"apiVersion": "tpu.ai/v1",
+                                "kind": "ClusterPolicy",
+                                "metadata": {"name": "cluster-policy"},
+                                "spec": {}})
+    dead_uid = owner["metadata"]["uid"]
+    fake_client.delete("tpu.ai/v1", "ClusterPolicy", "cluster-policy")
+
+    fake_client.create({"apiVersion": "apps/v1", "kind": "DaemonSet",
+                        "metadata": {"name": "orphan", "namespace": "ns",
+                                     "ownerReferences": [{
+                                         "kind": "ClusterPolicy",
+                                         "name": "cluster-policy",
+                                         "uid": dead_uid,
+                                         "controller": True}]},
+                        "spec": {}})
+    assert fake_client.list("apps/v1", "DaemonSet", "ns") == []
+
+    # never-created owner uid: stays (fixtures rely on this)
+    fake_client.create({"apiVersion": "v1", "kind": "Pod",
+                        "metadata": {"name": "ds-pod", "namespace": "ns",
+                                     "ownerReferences": [{
+                                         "kind": "DaemonSet",
+                                         "name": "user-ds",
+                                         "uid": "never-existed",
+                                         "controller": True}]},
+                        "spec": {}})
+    assert fake_client.get("v1", "Pod", "ds-pod", "ns")

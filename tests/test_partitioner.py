@@ -497,24 +497,27 @@ def test_nonsense_layout_values_fail_cleanly():
         compute_partition([{"chips": 2, "count": {}}], 8, V5E)
 
 
-def test_shipped_default_partition_table_is_valid():
+def test_shipped_default_partition_table_is_valid(fake_client, monkeypatch):
     """The default table baked into the slice-partitioner ConfigMap must
     tile on the generations it names — a shipped default that the tiler
-    rejects would fail every node that selects it (render the real
-    template, parse the real payload, run the real tiler)."""
-    import pathlib
-
+    rejects would fail every node that selects it. Rendered through the
+    REAL renderer (default branch of the template), parsed from the real
+    ConfigMap payload, run through the real tiler."""
     import yaml
 
-    from tpu_operator.partitioner import topology as topo
+    from tpu_operator.api.clusterpolicy import ClusterPolicy, new_cluster_policy
+    from tpu_operator.state.operands import cluster_policy_states
 
-    template = pathlib.Path(topo.__file__).parents[1] / "manifests" \
-        / "state-slice-partitioner" / "0400_configmap.yaml"
-    # default branch of the template: strip the Jinja control lines and
-    # keep the literal payload
-    lines = [ln[4:] for ln in template.read_text().splitlines()
-             if ln.startswith("    ")]
-    table = yaml.safe_load("\n".join(lines))["partitions"]
+    for env in ("DRIVER_IMAGE", "VALIDATOR_IMAGE", "FEATURE_DISCOVERY_IMAGE",
+                "TELEMETRY_EXPORTER_IMAGE", "SLICE_PARTITIONER_IMAGE",
+                "DEVICE_PLUGIN_IMAGE"):
+        monkeypatch.setenv(env, "gcr.io/tpu/x:1")
+    state = next(s for s in cluster_policy_states(fake_client)
+                 if "slice-partitioner" in s.name)
+    policy = ClusterPolicy.from_obj(new_cluster_policy())
+    objs = state.render_objects(policy, "tpu-operator")
+    configmap = next(o for o in objs if o["kind"] == "ConfigMap")
+    table = yaml.safe_load(configmap["data"]["config.yaml"])["partitions"]
     assert set(table) == {"all-disabled", "v5e-2x2-pair", "single-chip"}
     # every named partition must be valid on at least the host it targets
     assert compute_partition(table["all-disabled"], 8, V5E) == []

@@ -118,6 +118,9 @@ class FakeClient(Client):
         # traffic on every reconnect.
         self._last_event_rv: Dict[Tuple[str, str, str], int] = {}
         self._watches: List[_FakeWatch] = []
+        #: uids of deleted objects — creates carrying a controller ownerRef
+        #: to one of these are garbage-collected immediately (see create())
+        self._deleted_uids: set = set()
         # Server-side CRD schema enforcement (VERDICT r1 #2): every write of
         # a tpu.ai CR is validated against the generated openAPIV3Schema the
         # way a real apiserver enforces the reference's CRD schemas — the
@@ -228,7 +231,9 @@ class FakeClient(Client):
             meta.setdefault("generation", 1)
             self._store[key] = obj
             self._notify("ADDED", obj)
-            return copy.deepcopy(obj)
+            created = copy.deepcopy(obj)
+            self._collect_if_owner_dead(obj)
+        return created
 
     def update(self, obj: dict) -> dict:
         obj = copy.deepcopy(obj)
@@ -259,7 +264,9 @@ class FakeClient(Client):
                 meta["generation"] = current["metadata"].get("generation", 1)
             self._store[key] = obj
             self._notify("MODIFIED", obj)
-            return copy.deepcopy(obj)
+            updated = copy.deepcopy(obj)
+            self._collect_if_owner_dead(obj)  # adoption onto a dead owner
+            return updated
 
     def patch(self, api_version, kind, name, patch, namespace=None) -> dict:
         with self._lock:
@@ -279,7 +286,31 @@ class FakeClient(Client):
             # before the delete must be able to tell it missed one)
             obj["metadata"]["resourceVersion"] = self._next_rv()
             self._notify("DELETED", obj)
-            self._collect_orphans(obj["metadata"]["uid"])
+            uid = obj["metadata"].get("uid")
+            if uid:  # an ownerRef missing its uid must never match a
+                # None tombstone, and cascading on None would collect
+                # every uid-less reference
+                self._deleted_uids.add(uid)
+                self._collect_orphans(uid)
+
+    def _collect_if_owner_dead(self, obj: dict) -> None:
+        """GC for the owner-deleted-mid-sweep race (called under the lock
+        right after a write lands): a reconcile in flight when its CR is
+        deleted re-creates — or adopts, via update — operands owned by the
+        now-gone uid; the real garbage collector removes such objects
+        shortly after, so the fake must too or they live forever (the
+        uninstall e2e flaked exactly this way). Matches _collect_orphans'
+        any-ownerRef rule; only uids this store actually DELETED count, so
+        fixtures referencing never-created owners stay alive."""
+        if any(ref.get("uid") in self._deleted_uids
+               for ref in deep_get(obj, "metadata", "ownerReferences",
+                                   default=[]) or []):
+            try:
+                self.delete(obj["apiVersion"], obj["kind"],
+                            obj["metadata"]["name"],
+                            obj["metadata"].get("namespace"))
+            except NotFoundError:
+                pass  # a watch handler already removed it
 
     def _collect_orphans(self, owner_uid: str) -> None:
         """Server-side ownerReference garbage collection (cascade)."""
