@@ -29,9 +29,10 @@ def bench_control_plane(n_nodes: int = 4, timeout: float = 115.0,
                         rollout_ticks: int = 0, cached: bool = True,
                         churn_rounds: int = 0):
     """Time node creation -> all nodes schedulable + ClusterPolicy ready.
-    Returns ``(seconds, operator_api_requests)``; seconds is None if the
-    budget expired before convergence — a timeout is "did not converge",
-    never published as a measurement.
+    Returns ``(seconds, operator_api_requests, churn_requests)``; seconds
+    is None if the budget expired before convergence — a timeout is "did
+    not converge", never published as a measurement — and churn_requests
+    is None unless ``churn_rounds`` was requested and reconverged.
 
     The default arguments time the raw simulator (in-process apiserver,
     instant DS rollouts) — a regression trend, NOT a real-cluster number.
@@ -103,8 +104,11 @@ def bench_control_plane(n_nodes: int = 4, timeout: float = 115.0,
             if converged():
                 join_s = time.monotonic() - t0
                 join_requests = srv.request_count - t_req0 - n_nodes
+                # uniform 3-tuple (churn_requests=None when churn was not
+                # requested or did not reconverge): variable-arity returns
+                # are a future unpacking bug
                 if not churn_rounds:
-                    return join_s, join_requests
+                    return join_s, join_requests, None
                 # label-churn soak: steady-state request complexity must be
                 # O(events), not O(nodes)-per-sweep (informer cache +
                 # hash-skip) — published as requests per churn event. The
@@ -130,9 +134,7 @@ def bench_control_plane(n_nodes: int = 4, timeout: float = 115.0,
                                   - churn_rounds)  # minus our own patches
                 return join_s, join_requests, churn_requests
             time.sleep(0.05)
-        return ((None, srv.request_count - t_req0 - n_nodes)
-                if not churn_rounds
-                else (None, srv.request_count - t_req0 - n_nodes, None))
+        return None, srv.request_count - t_req0 - n_nodes, None
     finally:
         app.stop()
         op_client.stop()
@@ -207,6 +209,45 @@ def bench_ici_cpu_mesh(timeout: float = 240.0) -> dict:
         return {"gbps": 0.0, "trustworthy": False, "n_devices": 0,
                 "health_passed": False, "simulated": True,
                 "error": str(e)[:300]}
+
+
+def bench_compile_cache(timeout: float = 240.0) -> dict:
+    """Cold-vs-warm cost of the validation sweep against the XLA persistent
+    compilation cache — the hostPath cache dir the validator DS mounts
+    (r4 VERDICT weak-#5: wired but never quantified; compile-dominated
+    validation is the main threat to the <120 s north star on a cold node
+    pool). Two FRESH processes share one cache dir, modeling a validator
+    pod restart on the same node: the first populates, the second must hit.
+    ``compile_s`` is host-side trace+lower+compile wall time — trustworthy
+    on the tunneled TPU, unlike device-throughput timing."""
+    import tempfile
+
+    script = (
+        "import json\n"
+        "from tpu_operator.validator.workload import ici_health_check\n"
+        "print(json.dumps(ici_health_check(matrix_dim=512).to_dict()))\n")
+    with tempfile.TemporaryDirectory(prefix="tpu-compile-cache-") as cache:
+        env = dict(os.environ)
+        env["TPU_COMPILATION_CACHE_DIR"] = cache
+        try:
+            cold = _run_json_subprocess(script, timeout, env=env)
+            entries = len(os.listdir(cache))
+            warm = _run_json_subprocess(script, timeout, env=env)
+        except (RuntimeError, json.JSONDecodeError) as e:
+            return {"error": str(e)[:300]}
+    cold_s, warm_s = cold.get("compile_s"), warm.get("compile_s")
+    return {
+        "validation_compile_cold_s": cold_s,
+        "validation_compile_warm_s": warm_s,
+        "cache_entries_after_cold": entries,
+        "speedup": (round(cold_s / warm_s, 2)
+                    if cold_s and warm_s else None),
+        "platform": warm.get("platform"),
+        "note": ("two fresh processes sharing one persistent-cache dir "
+                 "(the validator DS hostPath model; a restarted pod is a "
+                 "new process); compile_s = host-side trace+compile wall "
+                 "time incl. cache lookup"),
+    }
 
 
 def _run_json_subprocess(script: str, timeout: float, env=None) -> dict:
@@ -286,20 +327,20 @@ INJECTED = dict(latency_s=0.02, interval=0.5, rollout_ticks=20)
 
 
 def main() -> int:
-    control_plane_raw_s, _ = bench_control_plane()
+    control_plane_raw_s, _, _ = bench_control_plane()
     # scale sidecar: a 50-node pool join on the raw simulator — shows the
     # sweep cost and request count stay sub-linear per node (informer
     # cache; one LIST per kind, not one GET per object per sweep)
-    scale_s, scale_requests = bench_control_plane(n_nodes=50)
+    scale_s, scale_requests, _ = bench_control_plane(n_nodes=50)
     # scale envelope: 250-node join + 25-event label-churn soak on the raw
     # simulator; churn requests prove steady-state complexity is O(events)
     # (hash-skip + cached reads), not O(nodes)-per-sweep
     env_s, env_requests, env_churn_requests = bench_control_plane(
         n_nodes=250, churn_rounds=25, timeout=180.0)
-    control_plane_s, cp_requests = bench_control_plane(**INJECTED)
+    control_plane_s, cp_requests, _ = bench_control_plane(**INJECTED)
     # same injected scenario without the informer cache: quantifies the
     # read-amplification the cache removes (requests AND seconds)
-    control_plane_uncached_s, cp_uncached_requests = bench_control_plane(
+    control_plane_uncached_s, cp_uncached_requests, _ = bench_control_plane(
         cached=False, **INJECTED)
     cp_injected_timed_out = control_plane_s is None
     cp_timed_out = cp_injected_timed_out or control_plane_raw_s is None
@@ -377,6 +418,10 @@ def main() -> int:
     mesh = bench_ici_cpu_mesh()
     mesh["regenerated_per_run"] = True
     line["ici_cpu_mesh"] = mesh
+    # cold/warm persistent-compile-cache cost on whatever accelerator this
+    # host has (the validator hostPath cache model) — a perf claim with a
+    # published number instead of a PARITY footnote
+    line["compile_cache"] = bench_compile_cache()
     with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "BENCH_CPU_MESH.json"), "w") as f:
         json.dump(mesh, f, indent=1)

@@ -261,12 +261,27 @@ timeout 120 bash -c '
   until [ "$(kubectl -n '"$NS"' get svc '"$SVC"' \
       -o jsonpath="{.spec.ports[0].port}")" = "'"$ORIG_PORT"'" ]; do sleep 2; done'
 echo "ok: rendered Service port healed back to $ORIG_PORT"
+# No-loop check anchored to a log POSITION taken after the heal settles
+# (not a wall-clock --since window, which could straddle the initial heal
+# warning on a slow host and fail a healthy run): count only drift
+# warnings appearing AFTER the baseline across two quiet resync sweeps.
+sleep 5  # let the heal's own warning flush to the log
+BASELINE_LINES=$(kubectl -n "$NS" logs deploy/tpu-operator 2>/dev/null | wc -l)
 sleep 25  # two resync sweeps on a quiet object
-HEALS=$(kubectl -n "$NS" logs deploy/tpu-operator --since=20s 2>/dev/null \
+AFTER_LINES=$(kubectl -n "$NS" logs deploy/tpu-operator 2>/dev/null | wc -l)
+if [ "$AFTER_LINES" -lt "$BASELINE_LINES" ]; then
+  # a shrunk log means the operator container RESTARTED during the quiet
+  # window — the line anchor is meaningless and a restart mid-check is
+  # itself a failure, not a pass
+  echo "FAIL: operator restarted during the drift-heal quiet window"
+  record fail drift-heal "operator restart during no-loop check"; exit 1
+fi
+HEALS=$(kubectl -n "$NS" logs deploy/tpu-operator 2>/dev/null \
+        | tail -n +"$((BASELINE_LINES + 1))" \
         | grep "drifted from rendered spec" | grep -c "$SVC" || true)
-if [ "${HEALS:-0}" -gt 1 ]; then
-  echo "FAIL: drift heal loops on a quiet object ($HEALS warnings in 20s —"
-  echo "      server-side normalization fights the rendered spec)"
+if [ "${HEALS:-0}" -gt 0 ]; then
+  echo "FAIL: drift heal loops on a quiet object ($HEALS warnings after the"
+  echo "      heal settled — server-side normalization fights the rendered spec)"
   record fail drift-heal "heal loop: $HEALS warnings"; exit 1
 fi
 record pass drift-heal "healed; no loop"

@@ -107,3 +107,61 @@ def test_worker_killed_fails_closed_then_retries_clean(tmp_path):
         assert _report_of(out)["passed"]
     for pid in range(4):
         assert os.path.exists(f"{retry_root}/v{pid}/workload-ready")
+
+
+@pytest.mark.slow
+def test_four_process_four_chip_rendezvous_north_star_shape(tmp_path):
+    """The EXACT v5e-16 north-star shape: 4 processes (hosts) x 4 chips =
+    16 global chips (r4 VERDICT weak-#6 — the 4x2 proxies never exercised
+    the true dimensions). Also pins the report's local_chips map: each
+    host's chips must be its contiguous global ordinals, the contract the
+    device plugin's per-chip health gate translates failed_chips through."""
+    port = 19860 + os.getpid() % 30
+    procs = [_spawn_worker(pid, 4, port, chips=4, status_root=str(tmp_path))
+             for pid in range(4)]
+    for i, p in enumerate(procs):
+        out, err = p.communicate(timeout=220)
+        assert p.returncode == 0, f"proc {i} failed:\n{err[-2000:]}"
+        report = _report_of(out)
+        assert report["passed"] and report["n_devices"] == 16
+        for check in ("compute", "psum", "ring", "all_gather"):
+            assert report["details"][check]["passed"], report["details"]
+        assert report["local_chips"] == list(range(4 * i, 4 * i + 4))
+    for pid in range(4):
+        assert os.path.exists(f"{tmp_path}/v{pid}/workload-ready")
+
+
+def test_mesh_factors_prefer_square():
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from __graft_entry__ import _mesh_factors
+
+    assert _mesh_factors(16) == (4, 4)   # v5e-16: 4 hosts x 4 chips
+    assert _mesh_factors(8) == (4, 2)
+    assert _mesh_factors(4) == (2, 2)
+    assert _mesh_factors(2) == (2, 1)
+    assert _mesh_factors(1) == (1, 1)
+    assert _mesh_factors(6) == (3, 2)
+
+
+@pytest.mark.slow
+def test_dryrun_multichip_16_device_v5e16_mesh(tmp_path):
+    """dryrun_multichip(16) over 16 virtual devices: the full training-step
+    shardings (tp psum, dp pmean, 16-hop ring, all_gather) compile and run
+    at the real 4x4 mesh shape. Subprocess: the suite's own JAX is pinned
+    to 8 virtual devices at import."""
+    env = {
+        "PATH": os.environ.get("PATH", "/usr/bin:/bin"),
+        "HOME": os.environ.get("HOME", "/root"),
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=16",
+        "PYTHONPATH": os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    }
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "from __graft_entry__ import dryrun_multichip, _mesh_factors\n"
+         "assert _mesh_factors(16) == (4, 4)\n"
+         "dryrun_multichip(16)\n"
+         "print('DRYRUN16_OK')"],
+        env=env, capture_output=True, text=True, timeout=220)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "DRYRUN16_OK" in proc.stdout

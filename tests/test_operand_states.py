@@ -293,3 +293,38 @@ class TestOperatorWideMetadata:
         assert vmain.run(["-c", "device-plugin"]) == 0
         assert captured["health_interval"] == 3.0
         assert captured["absence_grace_s"] == 120.0
+
+
+def test_stamp_sets_template_fingerprint_label():
+    """Every rendered DaemonSet pod template carries the whole-template
+    fingerprint label (the upgrade machine's currency signal), computed
+    AFTER all other template mutations and stable across re-stamps."""
+    from tpu_operator.api.clusterpolicy import ClusterPolicy, new_cluster_policy
+    from tpu_operator.state.operands import stamp_operator_meta
+    from tpu_operator.utils.hash import template_fingerprint
+    from tpu_operator import consts
+
+    policy = ClusterPolicy.from_obj(new_cluster_policy())
+    ds = {"apiVersion": "apps/v1", "kind": "DaemonSet",
+          "metadata": {"name": "d", "namespace": "ns"},
+          "spec": {"template": {
+              "metadata": {"labels": {"app": "x"}},
+              "spec": {"containers": [{"name": "c", "image": "img:1"}]}}}}
+    [stamped] = stamp_operator_meta([ds], policy)
+    tpl = stamped["spec"]["template"]
+    label = tpl["metadata"]["labels"][consts.TEMPLATE_HASH_LABEL]
+    assert label == template_fingerprint(tpl)  # self-consistent (label excluded)
+    # idempotent: re-stamping an already-stamped template keeps the value
+    [restamped] = stamp_operator_meta([stamped], policy)
+    assert restamped["spec"]["template"]["metadata"]["labels"][
+        consts.TEMPLATE_HASH_LABEL] == label
+    # and a template change changes it
+    ds2 = {"apiVersion": "apps/v1", "kind": "DaemonSet",
+           "metadata": {"name": "d", "namespace": "ns"},
+           "spec": {"template": {
+               "metadata": {"labels": {"app": "x"}},
+               "spec": {"containers": [{"name": "c", "image": "img:1",
+                                        "env": [{"name": "E", "value": "1"}]}]}}}}
+    [stamped2] = stamp_operator_meta([ds2], policy)
+    assert stamped2["spec"]["template"]["metadata"]["labels"][
+        consts.TEMPLATE_HASH_LABEL] != label

@@ -165,3 +165,101 @@ def test_unchanged_object_skips_write(fake_client):
     rv2 = fake_client.get("v1", "Service", "skip-svc",
                           "tpu-operator")["metadata"]["resourceVersion"]
     assert rv1 == rv2
+
+
+def test_drift_heal_damping_bounds_webhook_fight(fake_client):
+    """A mutating admission webhook that appends a toleration to a RENDERED
+    list re-creates drift after every heal; re-applying forever is an
+    unbounded UPDATE/warn loop (r4 VERDICT weak-#2). After DRIFT_HEAL_LIMIT
+    consecutive heals the object must degrade to hash-only skip: bounded
+    writes, ONE warning Event naming the diverging path, then silence."""
+    import copy
+
+    from tpu_operator.state.skel import DRIFT_HEAL_LIMIT
+
+    def mk_tolerating_ds():
+        ds = mk_ds(name="webhooked")
+        ds["spec"]["template"]["spec"]["tolerations"] = [
+            {"key": "google.com/tpu", "operator": "Exists"}]
+        return ds
+
+    skel = StateSkel("state-test", fake_client)
+    orig_create, orig_update = fake_client.create, fake_client.update
+
+    def mutate(obj):
+        if obj.get("kind") == "DaemonSet":
+            tolerations = obj["spec"]["template"]["spec"].setdefault(
+                "tolerations", [])
+            if not any(t.get("key") == "injected" for t in tolerations):
+                tolerations.append({"key": "injected", "operator": "Exists"})
+        return obj
+
+    fake_client.create = lambda obj: orig_create(mutate(copy.deepcopy(obj)))
+    heal_updates = {"n": 0}
+
+    def admitting_update(obj):
+        heal_updates["n"] += 1
+        return orig_update(mutate(copy.deepcopy(obj)))
+
+    fake_client.update = admitting_update
+    try:
+        skel.create_or_update_objs([mk_tolerating_ds()])
+        for _ in range(10):
+            skel.create_or_update_objs([mk_tolerating_ds()])
+    finally:
+        fake_client.create, fake_client.update = orig_create, orig_update
+
+    # LIMIT heals + the one-time damped-marker bookkeeping patch (the
+    # fake's patch routes through update): 4 writes across 10 sweeps,
+    # NOT one per sweep forever
+    assert heal_updates["n"] == DRIFT_HEAL_LIMIT + 1
+    suspended = [e for e in fake_client.list("v1", "Event", "tpu-operator")
+                 if e.get("reason") == "DriftHealSuspended"]
+    assert len(suspended) == 1, "exactly one loud Event, not one per sweep"
+    assert "tolerations" in suspended[0]["message"]
+    live = fake_client.get("apps/v1", "DaemonSet", "webhooked", "tpu-operator")
+    assert live["metadata"]["annotations"][consts.DRIFT_HEALS_ANNOTATION] \
+        == str(DRIFT_HEAL_LIMIT + 1)  # damped marker
+
+
+def test_drift_heal_counter_resets_when_drift_settles(fake_client):
+    """A one-off kubectl edit healed successfully must hand back the full
+    heal budget — only SUSTAINED fights damp."""
+    skel = StateSkel("state-test", fake_client)
+    skel.create_or_update_objs([mk_ds(name="edited")])
+    live = fake_client.get("apps/v1", "DaemonSet", "edited", "tpu-operator")
+    live["spec"]["template"]["spec"]["containers"][0]["image"] = "rogue:1"
+    fake_client.update(live)
+
+    skel.create_or_update_objs([mk_ds(name="edited")])  # heal sweep
+    live = fake_client.get("apps/v1", "DaemonSet", "edited", "tpu-operator")
+    assert live["spec"]["template"]["spec"]["containers"][0]["image"] == "img:1"
+    assert live["metadata"]["annotations"][consts.DRIFT_HEALS_ANNOTATION] == "1"
+
+    skel.create_or_update_objs([mk_ds(name="edited")])  # settled sweep
+    live = fake_client.get("apps/v1", "DaemonSet", "edited", "tpu-operator")
+    assert consts.DRIFT_HEALS_ANNOTATION not in live["metadata"]["annotations"]
+
+
+def test_template_change_resumes_after_damping(fake_client):
+    """Damping is per rendered template: when the operator's OWN render
+    changes, the normal update path runs and the damped marker is dropped
+    with it."""
+    from tpu_operator.state.skel import DRIFT_HEAL_LIMIT
+
+    skel = StateSkel("state-test", fake_client)
+    skel.create_or_update_objs([mk_ds(name="damped")])
+    live = fake_client.get("apps/v1", "DaemonSet", "damped", "tpu-operator")
+    live["metadata"]["annotations"][consts.DRIFT_HEALS_ANNOTATION] = \
+        str(DRIFT_HEAL_LIMIT + 1)
+    live["spec"]["template"]["spec"]["containers"][0]["image"] = "rogue:1"
+    fake_client.update(live)
+
+    skel.create_or_update_objs([mk_ds(name="damped")])  # damped: no heal
+    live = fake_client.get("apps/v1", "DaemonSet", "damped", "tpu-operator")
+    assert live["spec"]["template"]["spec"]["containers"][0]["image"] == "rogue:1"
+
+    skel.create_or_update_objs([mk_ds(name="damped", image="img:2")])
+    live = fake_client.get("apps/v1", "DaemonSet", "damped", "tpu-operator")
+    assert live["spec"]["template"]["spec"]["containers"][0]["image"] == "img:2"
+    assert consts.DRIFT_HEALS_ANNOTATION not in live["metadata"]["annotations"]

@@ -155,6 +155,38 @@ def test_spawn_workload_pod_requests_all_chips(fake_client):
     assert captured["node"] == "n1"
 
 
+def test_spawn_workload_pod_plumbs_status_and_cache(fake_client, monkeypatch):
+    """The spawned pod carries BOTH per-node hostPaths: the status dir (so
+    its in-pod sweep writes the detailed per-chip barrier to the host) and
+    the XLA compile cache (so node-join validation gets the warm-compile
+    benefit the bench quantifies, instead of paying a cold compile every
+    time)."""
+    monkeypatch.setenv("TPU_COMPILATION_CACHE_DIR", "/var/cache/tpu-xla")
+    fake_client.create({"apiVersion": "v1", "kind": "Node", "metadata": {"name": "n1"},
+                        "status": {"allocatable": {consts.TPU_RESOURCE_NAME: "4"}}})
+    captured = {}
+    original = fake_client.create
+
+    def spy(obj):
+        if obj["kind"] == "Pod":
+            captured["pod"] = obj
+        return original(obj)
+
+    fake_client.create = spy
+    spawn_workload_pod(fake_client, "tpu-operator", "n1", "img:1",
+                       timeout=0.1, poll=0.02, status_dir="/run/tpu/validations")
+    pod = captured["pod"]
+    env = {e["name"]: e["value"] for e in pod["spec"]["containers"][0]["env"]}
+    assert env["STATUS_DIR"] == "/run/tpu/validations"
+    assert env["TPU_COMPILATION_CACHE_DIR"] == "/var/cache/tpu-xla"
+    mounts = {m["name"]: m["mountPath"]
+              for m in pod["spec"]["containers"][0]["volumeMounts"]}
+    volumes = {v["name"]: v["hostPath"]["path"] for v in pod["spec"]["volumes"]}
+    assert mounts["validation-status"] == volumes["validation-status"] \
+        == "/run/tpu/validations"
+    assert mounts["xla-cache"] == volumes["xla-cache"] == "/var/cache/tpu-xla"
+
+
 # -- feature discovery --------------------------------------------------------
 
 def test_feature_discovery_passthrough_and_count(fake_client, fake_devs, monkeypatch):

@@ -15,6 +15,18 @@ DEFAULT_NAMESPACE = "tpu-operator"
 STATE_LABEL = "tpu.ai/operator.state"
 #: DaemonSet spec-drift detection (FNV-32a over canonical JSON of the spec)
 SPEC_HASH_ANNOTATION = "tpu.ai/operator-spec-hash"
+#: pod-template fingerprint stamped into every operand DS pod template at
+#: render time; the real DS controller copies template labels onto pods, so
+#: comparing a pod's label against the DS's current template label is an
+#: exact whole-template currency signal (the controller-revision-hash
+#: analog) that non-template spec edits (updateStrategy, minReadySeconds)
+#: cannot false-positive
+TEMPLATE_HASH_LABEL = "tpu.ai/template-hash"
+#: consecutive drift-heal counter: a mutating admission webhook that
+#: normalizes a rendered field would otherwise trade UPDATEs with the
+#: operator forever; past the damping limit the sweep degrades to
+#: hash-only skip for that object
+DRIFT_HEALS_ANNOTATION = "tpu.ai/operator-drift-heals"
 #: set on TPU nodes (analog of nvidia.com/gpu.present)
 TPU_PRESENT_LABEL = "tpu.ai/tpu.present"
 #: per-operand node kill-switches (analog of nvidia.com/gpu.deploy.<operand>)

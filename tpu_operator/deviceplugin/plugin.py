@@ -163,18 +163,24 @@ class TPUDevicePlugin:
         self._stop = threading.Event()
 
     # -- unit inventory -------------------------------------------------------
-    def _validation_health(self) -> str:
+    def _validation_health(self):
         """Health verdict from the node's workload validation barrier.
 
+        Returns ``(verdict, barrier_info)``: barrier_info is the parsed
+        barrier when verdict is Unhealthy *because the barrier recorded a
+        failed sweep* — it may carry per-chip attribution
+        (``details.*.failed_chips``) that narrows the verdict to the units
+        actually containing sick chips; None means node-level (all units).
+
         Known limitation, accepted deliberately: once units go Unhealthy
-        the pod-spawning re-validation cannot schedule on this node (its
-        pod requests the very resource the gate withdrew), so recovery
+        the pod-spawning re-validation cannot schedule on them, so recovery
         comes from the validator's direct ``workload-local`` run
         (privileged /dev access, no allocation) rewriting the barrier, or
-        a plugin restart (bootstrap state). That is the intended semantics:
-        a node that failed its sweep should stop taking work until
-        something re-certifies it. The absence grace window keeps a normal
-        clear-and-rewrite revalidation cycle from ever flapping health."""
+        a plugin restart (bootstrap state). Per-chip granularity softens
+        this: units whose chips all passed keep taking work, and the
+        spawning path keeps working through them. The absence grace window
+        keeps a normal clear-and-rewrite revalidation cycle from ever
+        flapping health."""
         import json
 
         try:
@@ -183,33 +189,98 @@ class TPUDevicePlugin:
         except FileNotFoundError:
             info = None  # absent — grace path below, never "unreadable"
         except (OSError, ValueError):
-            return UNHEALTHY  # present but unreadable/corrupt: fail safe
+            return UNHEALTHY, None  # present but unreadable/corrupt: fail safe
         if info is not None:
             self._workload_gone_at = None
             if info.get("passed") is False:
-                return UNHEALTHY
+                return UNHEALTHY, info
             self._workload_seen = True
-            return HEALTHY
+            return HEALTHY, None
         if not self._workload_seen:
-            return HEALTHY  # bootstrap: the sweep needs this plugin first
+            return HEALTHY, None  # bootstrap: the sweep needs this plugin first
         # absent after being seen: give a revalidation cycle time to
         # rewrite it before declaring regression
         if self._workload_gone_at is None:
             self._workload_gone_at = time.monotonic()
         if time.monotonic() - self._workload_gone_at < self.absence_grace_s:
-            return HEALTHY
-        return UNHEALTHY
+            return HEALTHY, None
+        return UNHEALTHY, None
+
+    @staticmethod
+    def _failed_local_chips(info, units) -> Optional[frozenset]:
+        """Local chip ids implicated by a failed-sweep barrier, or None when
+        the failure cannot be attributed to specific chips (then ALL units
+        must gate — fail safe, the pre-r5 behavior).
+
+        ``details.*.failed_chips`` carries *global sweep ordinals*; the
+        report's ``local_chips`` (global ordinal per local chip, in local
+        device order — written by ``ici_health_check``) translates them.
+        Barriers from older validators lack the map: fall back to the
+        identity mapping only when the sweep provably ran on exactly this
+        host's chips (n_devices matches), else refuse to attribute.
+
+        The reference stack gets the same granularity from NVIDIA's device
+        plugin marking individual GPUs unhealthy, consumed via node
+        capacity (reference validator/main.go:1240-1299); on TPU the sweep
+        itself is the per-chip oracle."""
+        if not isinstance(info, dict):
+            return None
+        details = info.get("details")
+        if not isinstance(details, dict):
+            return None
+        failed_global = set()
+        try:
+            for check in details.values():
+                if not isinstance(check, dict):
+                    return None  # e.g. {"error": "..."} — unattributable
+                if check.get("passed") is not False:
+                    continue
+                chips = check.get("failed_chips")
+                if not isinstance(chips, list) or not chips:
+                    return None  # a check failed with no chip attribution
+                failed_global.update(int(c) for c in chips)
+            if not failed_global:
+                return None  # passed:false but no failing check recorded
+            local_count = len({c for u in units for c in u.chips})
+            local_map = info.get("local_chips")
+            if local_map:
+                # sweep ordinals only mean host chip ids when the sweep
+                # covered this host's FULL chip set: a subset sweep (a pod
+                # allocated 3 of 4 units sees renumbered TPU_VISIBLE_CHIPS
+                # devices) would misattribute failures to the wrong units
+                if len(local_map) != local_count:
+                    return None
+            else:
+                if info.get("n_devices") != local_count:
+                    return None
+                local_map = list(range(local_count))
+            return frozenset(local for local, global_ord
+                             in enumerate(local_map)
+                             if global_ord in failed_global)
+        except (TypeError, ValueError):
+            return None  # malformed barrier content: gate all, fail safe
 
     def refresh_units(self) -> bool:
         """Re-enumerate; returns True (and notifies watchers) on change."""
-        health = self._validation_health()
+        verdict, barrier = self._validation_health()
         handoff = read_handoff(self.handoff_dir)
         grid = tuple(handoff["grid"]) if handoff and handoff.get("grid") \
             else None
         fresh = {u.id: u
                  for u in discover_units(self.handoff_dir, handoff=handoff)}
+        failed = self._failed_local_chips(barrier, fresh.values()) \
+            if verdict == UNHEALTHY and barrier is not None else None
         for u in fresh.values():
-            u.health = health
+            if verdict == HEALTHY:
+                u.health = HEALTHY
+            elif failed is None:
+                u.health = UNHEALTHY  # node-level: no per-chip attribution
+            else:
+                # per-chip: only units containing an implicated chip gate;
+                # a failure wholly on another slice host leaves every local
+                # unit schedulable (slice-level gating is the multihost
+                # state's job, not the kubelet's)
+                u.health = UNHEALTHY if failed & set(u.chips) else HEALTHY
         with self._lock:
             self._grid = grid
             if {k: (v.chips, v.health) for k, v in fresh.items()} == \

@@ -21,10 +21,13 @@ Failure containment: any Failed pod marks the sweep failed for that slice
 
 from __future__ import annotations
 
+import calendar
 import logging
+import os
+import time
 from typing import Dict, List, Optional
 
-from .. import consts
+from .. import consts, events
 from ..api.clusterpolicy import ClusterPolicy
 from ..client.errors import NotFoundError
 from ..client.interface import Client
@@ -61,12 +64,27 @@ def slice_groups(nodes: List[dict],
     return {sid: m for sid, m in groups.items() if len(m) >= 2}
 
 
+#: wall-clock budget for every worker pod of an attempt to reach
+#: Running/Succeeded, measured from pod creation. TPU_INIT_TIMEOUT bounds a
+#: RUNNING worker's rendezvous; this bounds the step before it — a pod stuck
+#: Pending (node died after the capacity check, taint race, quota) would
+#: otherwise hold the sweep NotReady until slice membership happens to
+#: change the config hash. Reference wait-budget semantics:
+#: validator/main.go:1180-1197 (60 x 5 s, then fail).
+SCHEDULING_BUDGET_S = float(os.environ.get(
+    "TPU_MULTIHOST_SCHEDULING_BUDGET", "300"))
+
+
 class MultihostValidationState:
     name = "state-multihost-validation"
 
-    def __init__(self, client: Client):
+    def __init__(self, client: Client,
+                 scheduling_budget_s: float = SCHEDULING_BUDGET_S,
+                 now=time.time):
         self.client = client
         self.skel = StateSkel(self.name, client)
+        self.scheduling_budget_s = scheduling_budget_s
+        self._now = now  # injectable clock for budget tests
 
     # -- manifest builders ----------------------------------------------------
     def _service(self, slice_id: str, namespace: str) -> dict:
@@ -215,7 +233,47 @@ class MultihostValidationState:
             self._stamp(members, config_hash)
             self._teardown(slice_id, namespace)
             return SyncState.READY
+        # per-attempt scheduling budget: every worker must be past Pending
+        # (and none missing — a GC'd pod can never Succeed) within the
+        # budget, else tear down for a clean retry next sweep. Running pods
+        # are the rendezvous' problem: TPU_INIT_TIMEOUT fails them closed.
+        stuck = (len(pods) < n
+                 or any(p not in ("Running", "Succeeded") for p in phases))
+        if stuck and self.scheduling_budget_s > 0:
+            age = self._attempt_age(pods)
+            if age > self.scheduling_budget_s:
+                pending = [p["metadata"]["name"] for p in pods
+                           if deep_get(p, "status", "phase",
+                                       default="Pending")
+                           not in ("Running", "Succeeded")]
+                message = (f"slice {slice_id}: {len(pending)} worker pod(s) "
+                           f"not running {int(age)}s after creation "
+                           f"(budget {int(self.scheduling_budget_s)}s), "
+                           f"{n - len(pods)} missing; tearing down for retry"
+                           f" — stuck: {pending[:4]}")
+                log.warning("multihost %s", message)
+                events.record(self.client, namespace, pods[0],
+                              events.WARNING, "MultihostSchedulingTimeout",
+                              message)
+                self._teardown(slice_id, namespace)
         return SyncState.NOT_READY
+
+    def _attempt_age(self, pods: List[dict]) -> float:
+        """Seconds since the attempt's NEWEST pod was created (generous:
+        the budget starts when the full worker set existed). Unparsable or
+        missing timestamps read as age 0 — grant a budget, never escalate
+        instantly on a malformed fixture."""
+        newest = 0.0
+        for pod in pods:
+            raw = deep_get(pod, "metadata", "creationTimestamp")
+            if not raw:
+                continue
+            try:
+                newest = max(newest, calendar.timegm(
+                    time.strptime(raw, "%Y-%m-%dT%H:%M:%SZ")))
+            except ValueError:
+                continue
+        return self._now() - newest if newest else 0.0
 
     # -- state entry ----------------------------------------------------------
     def sync(self, catalog: InfoCatalog) -> StateResult:

@@ -20,6 +20,7 @@ from ..api.clusterpolicy import ClusterPolicy
 from ..api.common import ComponentSpec
 from ..client.interface import Client
 from ..render import Renderer
+from ..utils.hash import template_fingerprint
 from .driver import MANIFEST_DIR, StateDriver
 from .multihost import MultihostValidationState
 from .manager import (
@@ -80,6 +81,11 @@ def stamp_operator_meta(objs: List[dict], policy: ClusterPolicy) -> List[dict]:
             merge(tpl_meta, "annotations", ds_spec.annotations)
         if runtime_class:
             tpl.setdefault("spec", {})["runtimeClassName"] = runtime_class
+        # LAST template mutation: the DS controller copies template labels
+        # onto pods, so this label gives the upgrade machine an exact
+        # whole-template currency signal (controller-revision-hash analog)
+        tpl_meta.setdefault("labels", {})[consts.TEMPLATE_HASH_LABEL] = \
+            template_fingerprint(tpl)
     return objs
 
 

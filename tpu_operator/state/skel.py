@@ -12,10 +12,11 @@ from __future__ import annotations
 import copy
 import enum
 import logging
+import os
 from typing import Dict, List, Optional
 
-from .. import consts
-from ..client.errors import ConflictError, KindNotServedError, NotFoundError
+from .. import consts, events
+from ..client.errors import ApiError, ConflictError, KindNotServedError, NotFoundError
 from ..client.interface import Client
 from ..utils import deep_get, object_hash
 
@@ -97,22 +98,48 @@ _READINESS = {
     "Pod": is_pod_ready,
 }
 
-#: fields the API server (or other controllers) own; preserved on update
 def _covers(live, desired) -> bool:
     """True when every field of ``desired`` is present and equal in
     ``live`` — dicts recursively, lists pairwise with equal length. Extra
     live-only fields are apiserver defaults (clusterIP, protocol,
     SA-managed secrets), not drift; a rendered field that was changed or
-    removed out-of-band IS drift and fails the check."""
-    if isinstance(desired, dict):
-        return isinstance(live, dict) and all(
-            key in live and _covers(live[key], value)
-            for key, value in desired.items())
-    if isinstance(desired, list):
-        return (isinstance(live, list) and len(live) == len(desired)
-                and all(_covers(l, d) for l, d in zip(live, desired)))
-    return live == desired
+    removed out-of-band IS drift and fails the check. One traversal with
+    ``_first_divergence`` so the drift decision and the reported culprit
+    path can never disagree."""
+    return _first_divergence(live, desired) is None
 
+
+def _first_divergence(live, desired, path="$") -> Optional[str]:
+    """Dotted path of the first field where ``_covers`` fails — names the
+    culprit in the damping Event so an admin can find the webhook/controller
+    fighting the render without diffing YAML by hand."""
+    if isinstance(desired, dict):
+        if not isinstance(live, dict):
+            return path
+        for key, value in desired.items():
+            if key not in live:
+                return f"{path}.{key}"
+            sub = _first_divergence(live[key], value, f"{path}.{key}")
+            if sub:
+                return sub
+        return None
+    if isinstance(desired, list):
+        if not isinstance(live, list) or len(live) != len(desired):
+            have = len(live) if isinstance(live, list) else type(live).__name__
+            return f"{path} (live length {have} != rendered {len(desired)})"
+        for i, (l, d) in enumerate(zip(live, desired)):
+            sub = _first_divergence(l, d, f"{path}[{i}]")
+            if sub:
+                return sub
+        return None
+    return None if live == desired else path
+
+
+#: consecutive heals of one object before the sweep stops re-applying and
+#: degrades to hash-only skip (the reference never loops here because its
+#: skip is hash-only, object_controls.go:4316; our drift check needs the
+#: damper to coexist with normalizing admission webhooks)
+DRIFT_HEAL_LIMIT = 3
 
 #: (mergeObjects analog, state_skel.go:344)
 _PRESERVE_ON_UPDATE = {
@@ -137,6 +164,11 @@ class StateSkel:
     def __init__(self, name: str, client: Client):
         self.name = name
         self.client = client
+        #: objects whose DriftHealSuspended event already fired from this
+        #: process — second guard behind the annotation marker, so a
+        #: persistently failing bookkeeping patch (however unlikely: RBAC
+        #: grants * on operand kinds) cannot re-fire an Event per sweep
+        self._suspension_reported: set = set()
 
     # -- apply ----------------------------------------------------------------
     def create_or_update_objs(self, objs: List[dict], owner: Optional[dict] = None) -> List[dict]:
@@ -170,8 +202,37 @@ class StateSkel:
         for server_managed in ("resourceVersion", "uid", "creationTimestamp",
                                "generation", "managedFields"):
             meta.pop(server_managed, None)
-        (meta.get("annotations") or {}).pop(consts.SPEC_HASH_ANNOTATION, None)
+        for bookkeeping in (consts.SPEC_HASH_ANNOTATION,
+                            consts.DRIFT_HEALS_ANNOTATION):
+            (meta.get("annotations") or {}).pop(bookkeeping, None)
         return object_hash(doc)
+
+    @staticmethod
+    def _heal_count(live: dict) -> int:
+        raw = deep_get(live, "metadata", "annotations",
+                       consts.DRIFT_HEALS_ANNOTATION)
+        try:
+            return int(raw) if raw else 0
+        except (TypeError, ValueError):
+            return 0
+
+    def _set_heal_count(self, live: dict, count: Optional[int]) -> None:
+        """Annotation-persisted counter (not instance state: skels are
+        rebuilt per sweep and reconcilers fail over between replicas —
+        the same crash-safety argument as the upgrade machine's labels).
+        Best-effort: bookkeeping must never fail a reconcile."""
+        meta = live["metadata"]
+        try:
+            self.client.patch(
+                live["apiVersion"], live["kind"], meta["name"],
+                {"metadata": {"annotations": {
+                    consts.DRIFT_HEALS_ANNOTATION:
+                        str(count) if count is not None else None}}},
+                meta.get("namespace"))
+        except ApiError as e:
+            log.info("state %s: drift-heal bookkeeping patch failed on "
+                     "%s/%s: %s", self.name, live.get("kind"),
+                     meta.get("name"), e)
 
     def _apply_one(self, desired: dict, owner: Optional[dict]) -> dict:
         meta = desired.setdefault("metadata", {})
@@ -191,7 +252,13 @@ class StateSkel:
 
         current_hash = deep_get(current, "metadata", "annotations", consts.SPEC_HASH_ANNOTATION)
         if current_hash == meta["annotations"][consts.SPEC_HASH_ANNOTATION]:
+            heals = self._heal_count(current)
             if _covers(current, desired):
+                if heals:
+                    # drift settled (webhook gone / edit reverted): clear
+                    # the counter so an unrelated future drift gets a
+                    # fresh heal budget
+                    self._set_heal_count(current, None)
                 # unchanged AND undrifted: the stored fingerprint only
                 # proves the operator's last write matched — an out-of-band
                 # kubectl edit leaves it intact, so the live object must
@@ -201,14 +268,42 @@ class StateSkel:
                 # DaemonSets; we extend it to every kind, so the drift
                 # check comes along)
                 return current
+            if heals >= DRIFT_HEAL_LIMIT:
+                # the same object needed healing DRIFT_HEAL_LIMIT sweeps
+                # running: something (mutating admission webhook, another
+                # controller) rewrites the rendered value right back every
+                # time. Re-applying forever is an unbounded UPDATE/warn
+                # loop — exactly the write amplification the fingerprint
+                # skip exists to prevent — so degrade THIS object to
+                # hash-only skip, once, loudly
+                obj_key = (api_version, kind, name, namespace)
+                if heals == DRIFT_HEAL_LIMIT \
+                        and obj_key not in self._suspension_reported:
+                    self._suspension_reported.add(obj_key)
+                    where = _first_divergence(current, desired) or "?"
+                    message = (f"{kind}/{name} is rewritten out-of-band at "
+                               f"{where} after every re-apply "
+                               f"({DRIFT_HEAL_LIMIT} consecutive heals); "
+                               f"suspending drift healing for this object "
+                               f"(hash-only skip) — find the mutating "
+                               f"webhook/controller fighting the render")
+                    log.error("state %s: %s", self.name, message)
+                    events.record(self.client, namespace
+                                  or os.environ.get(consts.NAMESPACE_ENV,
+                                                    consts.DEFAULT_NAMESPACE),
+                                  current, events.WARNING, "DriftHealSuspended",
+                                  message)
+                    self._set_heal_count(current, heals + 1)  # damped marker
+                return current
             # drift heal is loud: an edited operator-rendered object (RBAC
             # verb dropped, Service port rewritten) is tampering or a
             # broken controller fight, and a server that NORMALIZES a
             # rendered value would re-trigger this every sweep — either
             # way the log must show it, not bury it in a silent update
             log.warning("state %s: %s/%s drifted from rendered spec "
-                        "(out-of-band edit?); re-applying",
-                        self.name, kind, name)
+                        "(out-of-band edit?); re-applying (heal %d/%d)",
+                        self.name, kind, name, heals + 1, DRIFT_HEAL_LIMIT)
+            meta["annotations"][consts.DRIFT_HEALS_ANNOTATION] = str(heals + 1)
 
         for path in _PRESERVE_ON_UPDATE.get(kind, []):
             value = deep_get(current, *path)

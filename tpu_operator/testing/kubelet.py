@@ -124,12 +124,23 @@ class KubeletSimulator:
         for node_name in sorted(node_names):
             pod = existing.get(node_name)
             if pod is not None:
-                pod_containers = deep_get(pod, "spec", "containers", default=[])
-                is_current = [
-                    {"image": c.get("image"), "args": c.get("args")} for c in pod_containers
-                ] == [
-                    {"image": c.get("image"), "args": c.get("args")} for c in want_containers
-                ]
+                # currency the way the real DS controller tracks it:
+                # template labels (including the operator's whole-template
+                # fingerprint) are copied onto pods at creation, so pod
+                # label vs current template label is the roll signal; pods
+                # or templates without the stamp fall back to image/args
+                want_hash = deep_get(template, "metadata", "labels",
+                                     consts.TEMPLATE_HASH_LABEL)
+                if want_hash:
+                    is_current = want_hash == deep_get(
+                        pod, "metadata", "labels", consts.TEMPLATE_HASH_LABEL)
+                else:
+                    pod_containers = deep_get(pod, "spec", "containers", default=[])
+                    is_current = [
+                        {"image": c.get("image"), "args": c.get("args")} for c in pod_containers
+                    ] == [
+                        {"image": c.get("image"), "args": c.get("args")} for c in want_containers
+                    ]
                 if not is_current and strategy == "RollingUpdate":
                     try:
                         self.client.delete("v1", "Pod", pod["metadata"]["name"], self.namespace)

@@ -29,3 +29,20 @@ def object_hash(obj: Any) -> str:
     """Canonical FNV-32a hash of any JSON-serialisable object."""
     payload = json.dumps(obj, sort_keys=True, separators=(",", ":"), default=str)
     return format(fnv32a(payload.encode("utf-8")), "x")
+
+
+def template_fingerprint(template: dict) -> str:
+    """Whole-pod-template fingerprint, excluding the fingerprint label
+    itself (it is derived FROM the rest of the template, and including it
+    would make the hash self-referential). One definition shared by the
+    render-time stamp (state/operands.stamp_operator_meta) and the upgrade
+    machine's outdated/FAILED-retry checks so the two can never drift."""
+    import copy
+
+    from .. import consts
+
+    doc = copy.deepcopy(template or {})
+    labels = doc.get("metadata", {}).get("labels")
+    if labels:
+        labels.pop(consts.TEMPLATE_HASH_LABEL, None)
+    return object_hash(doc)

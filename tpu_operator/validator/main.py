@@ -194,16 +194,27 @@ def run(argv=None, client=None) -> int:
         if not node_name or not image:
             log.error("workload: NODE_NAME and VALIDATOR_IMAGE required")
             return 1
+        import time as _time
+
+        spawn_start = _time.time()
         ok = spawn_workload_pod(client, namespace, node_name, image,
-                                resource_name=args.resource, timeout=args.timeout)
+                                resource_name=args.resource, timeout=args.timeout,
+                                status_dir=args.status_dir)
+        # the pod mounts the status hostPath and its in-pod sweep writes the
+        # DETAILED barrier (per-chip failed_chips) itself; a barrier stamped
+        # after spawn is that write — preserve it, the parent only knows the
+        # coarse pod phase
+        fresh = status.read("workload")
+        in_pod_wrote = bool(fresh) and fresh.get("timestamp", 0) >= spawn_start
         if ok:
-            status.write("workload", {"mode": "pod"})
+            if not in_pod_wrote:
+                status.write("workload", {"mode": "pod"})
             return 0
-        if ok is False:
-            # the pod RAN and failed: a real sweep verdict — record it so
-            # health gates see the regression. ok is None on timeout
-            # (scheduling/image trouble, not a chip verdict): leave the
-            # previous barrier state alone
+        if ok is False and not in_pod_wrote:
+            # the pod RAN and failed without managing a detailed write (crash
+            # before the sweep reported): record the coarse regression so
+            # health gates see it. ok is None on timeout (scheduling/image
+            # trouble, not a chip verdict): leave the previous barrier alone
             status.write("workload", {"mode": "pod", "passed": False})
         return 1
 

@@ -58,6 +58,11 @@ class IciCheckReport:
     elapsed_s: float
     compile_s: float
     details: dict
+    #: global sweep ordinals of THIS host's chips, in local device order —
+    #: lets per-host consumers (the device plugin's health gate) translate
+    #: ``details.*.failed_chips`` (global ordinals) into local chip ids,
+    #: including for multihost sweeps where this host owns a slice subset
+    local_chips: list = dataclasses.field(default_factory=list)
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -116,8 +121,13 @@ def ici_health_check(matrix_dim: int = 512, devices=None) -> IciCheckReport:
     ids_host = np.arange(n, dtype=np.int32)
     ids = jax.make_array_from_callback(
         (n,), NamedSharding(mesh, P("chips")), lambda idx: ids_host[idx])
-    compiled_at = time.monotonic()
-    per_chip_results = np.asarray(jax.device_get(check(ids)))  # (n, 4) 0/1 flags
+    # AOT split so compile_s really is trace+lower+compile (incl. any
+    # persistent-cache hit), not setup time with the compile smeared into
+    # the first execution
+    compile_start = time.monotonic()
+    compiled = check.lower(ids).compile()
+    compile_s = time.monotonic() - compile_start
+    per_chip_results = np.asarray(jax.device_get(compiled(ids)))  # (n, 4) 0/1 flags
     elapsed = time.monotonic() - start
 
     names = ["compute", "psum", "ring", "all_gather"]
@@ -126,13 +136,16 @@ def ici_health_check(matrix_dim: int = 512, devices=None) -> IciCheckReport:
                "failed_chips": [int(c) for c in range(n) if not per_chip_results[c, i]]}
         for i, name in enumerate(names)
     }
+    me = jax.process_index()
     return IciCheckReport(
         passed=bool(per_chip_results.all()),
         n_devices=n,
         platform=devices[0].platform,
         elapsed_s=round(elapsed, 4),
-        compile_s=round(compiled_at - start, 4),
+        compile_s=round(compile_s, 4),
         details=details,
+        local_chips=[i for i, d in enumerate(devices)
+                     if getattr(d, "process_index", me) == me],
     )
 
 
@@ -172,14 +185,25 @@ WORKLOAD_POD_TEMPLATE = {
             "command": ["tpu-validator"],
             "args": ["-c", "workload-local"],
             "resources": {"limits": {"google.com/tpu": "FILLED_BY_VALIDATOR"}},
+            # the status hostPath rides along so the in-pod sweep writes the
+            # DETAILED barrier (per-chip failed_chips) straight to the host —
+            # the spawner only has a pass/fail pod phase, which cannot feed
+            # the device plugin's per-chip health gate
+            "env": [{"name": "STATUS_DIR", "value": "FILLED_BY_VALIDATOR"}],
+            "volumeMounts": [{"name": "validation-status",
+                              "mountPath": "FILLED_BY_VALIDATOR"}],
         }],
+        "volumes": [{"name": "validation-status",
+                     "hostPath": {"path": "FILLED_BY_VALIDATOR",
+                                  "type": "DirectoryOrCreate"}}],
     },
 }
 
 
 def spawn_workload_pod(client, namespace: str, node_name: str, image: str,
                        resource_name: str = "google.com/tpu", chips: Optional[int] = None,
-                       timeout: float = 300.0, poll: float = 1.0) -> Optional[bool]:
+                       timeout: float = 300.0, poll: float = 1.0,
+                       status_dir: Optional[str] = None) -> Optional[bool]:
     """Create a validation pod pinned to this node requesting TPU resources
     through the device plugin, wait for Succeeded (validator/main.go:1180).
 
@@ -188,6 +212,7 @@ def spawn_workload_pod(client, namespace: str, node_name: str, image: str,
     a verdict about the chips)."""
     import copy
 
+    from .. import consts
     from ..client.errors import NotFoundError
     from ..utils import deep_get
 
@@ -199,9 +224,26 @@ def spawn_workload_pod(client, namespace: str, node_name: str, image: str,
     pod["metadata"]["namespace"] = namespace
     pod["metadata"]["name"] = f"tpu-workload-validation-{node_name}"[:63]
     pod["spec"]["nodeName"] = node_name
+    status_dir = status_dir or consts.VALIDATION_STATUS_DIR
+    pod["spec"]["volumes"][0]["hostPath"]["path"] = status_dir
     ctr = pod["spec"]["containers"][0]
     ctr["image"] = image
     ctr["resources"]["limits"] = {resource_name: str(chips)}
+    ctr["env"][0]["value"] = status_dir
+    ctr["volumeMounts"][0]["mountPath"] = status_dir
+    # the per-node XLA compile cache rides along too (same hostPath the
+    # validator DS mounts): the pod-spawned sweep is the path that gates
+    # node join, so it must get the warm-compile benefit the bench
+    # quantifies, not pay a cold compile every validation
+    cache_dir = os.environ.get("TPU_COMPILATION_CACHE_DIR")
+    if cache_dir:
+        ctr["env"].append({"name": "TPU_COMPILATION_CACHE_DIR",
+                           "value": cache_dir})
+        ctr["volumeMounts"].append({"name": "xla-cache",
+                                    "mountPath": cache_dir})
+        pod["spec"]["volumes"].append({
+            "name": "xla-cache",
+            "hostPath": {"path": cache_dir, "type": "DirectoryOrCreate"}})
 
     try:
         client.delete("v1", "Pod", pod["metadata"]["name"], namespace)
