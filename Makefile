@@ -34,6 +34,10 @@ validate-helm-values:  ## chart renders a schema-valid ClusterPolicy (reference 
 e2e-kind:  ## real-API-server e2e (needs kind + docker + kubectl)
 	bash tests/e2e-kind.sh
 
+.PHONY: e2e-envtest
+e2e-envtest:  ## real kube-apiserver+etcd e2e, no containers (exit 77 = binaries unobtainable)
+	bash tests/e2e-envtest.sh
+
 .PHONY: must-gather
 must-gather:
 	bash hack/must-gather.sh

@@ -420,3 +420,34 @@ def test_per_chip_health_subset_sweep_gates_all(plugin):
         "details": {"compute": {"passed": False, "failed_chips": [2]}}})
     p.refresh_units()
     assert all(u.health == "Unhealthy" for u in p._snapshot())
+
+
+def test_partial_pass_does_not_clear_gated_units(plugin):
+    """A PASSING sweep that covered only a subset of the host's chips (the
+    pod-spawned revalidation can only allocate the still-healthy units)
+    must not un-gate chips it never tested; only a full-host pass (the
+    workload-local direct run) re-certifies them."""
+    from tpu_operator.validator.status import StatusFiles
+
+    p, stub, tmp_path = plugin
+    status = StatusFiles(str(tmp_path / "validations"))
+    status.write("workload", {
+        "passed": False, "n_devices": 4, "local_chips": [0, 1, 2, 3],
+        "details": {"compute": {"passed": False, "failed_chips": [3]}}})
+    p.refresh_units()
+    assert {u.id: u.health for u in p._snapshot()}["tpu-3"] == "Unhealthy"
+
+    # subset pass over the 3 healthy units (renumbered ordinals 0..2)
+    status.write("workload", {"passed": True, "n_devices": 3,
+                              "local_chips": [0, 1, 2]})
+    p.refresh_units()
+    health = {u.id: u.health for u in p._snapshot()}
+    assert health["tpu-3"] == "Unhealthy", \
+        "subset pass must not un-gate the untested chip"
+    assert health["tpu-0"] == "Healthy"
+
+    # full-host pass re-certifies everything
+    status.write("workload", {"passed": True, "n_devices": 4,
+                              "local_chips": [0, 1, 2, 3]})
+    p.refresh_units()
+    assert all(u.health == "Healthy" for u in p._snapshot())

@@ -263,3 +263,35 @@ def test_template_change_resumes_after_damping(fake_client):
     live = fake_client.get("apps/v1", "DaemonSet", "damped", "tpu-operator")
     assert live["spec"]["template"]["spec"]["containers"][0]["image"] == "img:2"
     assert consts.DRIFT_HEALS_ANNOTATION not in live["metadata"]["annotations"]
+
+
+def test_returning_webhook_reannounces_suspension(fake_client):
+    """Damping is per-fight, not per-object-forever: when the drift settles
+    (counter cleared) and the webhook later COMES BACK, the new fight must
+    produce its own DriftHealSuspended event — not be silently re-damped."""
+    from tpu_operator.state.skel import DRIFT_HEAL_LIMIT
+
+    skel = StateSkel("state-test", fake_client)
+    skel.create_or_update_objs([mk_ds(name="flappy")])
+
+    def fight_until_damped():
+        for _ in range(DRIFT_HEAL_LIMIT + 2):
+            live = fake_client.get("apps/v1", "DaemonSet", "flappy",
+                                   "tpu-operator")
+            live["spec"]["template"]["spec"]["containers"][0]["image"] = "rogue:1"
+            fake_client.update(live)
+            skel.create_or_update_objs([mk_ds(name="flappy")])
+
+    fight_until_damped()
+    # settle: live matches render again, counter + reported-flag cleared
+    live = fake_client.get("apps/v1", "DaemonSet", "flappy", "tpu-operator")
+    live["spec"]["template"]["spec"]["containers"][0]["image"] = "img:1"
+    fake_client.update(live)
+    skel.create_or_update_objs([mk_ds(name="flappy")])
+    live = fake_client.get("apps/v1", "DaemonSet", "flappy", "tpu-operator")
+    assert consts.DRIFT_HEALS_ANNOTATION not in live["metadata"]["annotations"]
+
+    fight_until_damped()  # the webhook returns
+    suspended = [e for e in fake_client.list("v1", "Event", "tpu-operator")
+                 if e.get("reason") == "DriftHealSuspended"]
+    assert len(suspended) == 2, "each distinct fight announces itself once"

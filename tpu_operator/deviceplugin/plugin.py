@@ -195,7 +195,7 @@ class TPUDevicePlugin:
             if info.get("passed") is False:
                 return UNHEALTHY, info
             self._workload_seen = True
-            return HEALTHY, None
+            return HEALTHY, info
         if not self._workload_seen:
             return HEALTHY, None  # bootstrap: the sweep needs this plugin first
         # absent after being seen: give a revalidation cycle time to
@@ -260,6 +260,28 @@ class TPUDevicePlugin:
         except (TypeError, ValueError):
             return None  # malformed barrier content: gate all, fail safe
 
+    @staticmethod
+    def _partial_sweep(info, units) -> bool:
+        """True when a PASSING barrier provably covered less than this
+        host's full chip set. A pod-spawned revalidation only allocates
+        the units still healthy, so its sweep sees a renumbered subset
+        (TPU_VISIBLE_CHIPS) and its PASS says nothing about the gated
+        chips — clearing their gates on it would let a sick chip flap
+        fail -> subset-pass -> fail while taking real work. Recovery from
+        a gate is the full-host ``workload-local`` direct run (all of
+        /dev, no allocation), whose barrier covers every chip."""
+        if not isinstance(info, dict):
+            return False  # hand-written/minimal barriers: no coverage claim
+        local_count = len({c for u in units for c in u.chips})
+        local_map = info.get("local_chips")
+        if isinstance(local_map, list) and local_map:
+            return len(local_map) != local_count
+        n = info.get("n_devices")
+        # no local map: a single-host sweep's n_devices must cover every
+        # chip; smaller is provably partial (larger = legacy multihost
+        # barrier — not partial for this host)
+        return isinstance(n, int) and n < local_count
+
     def refresh_units(self) -> bool:
         """Re-enumerate; returns True (and notifies watchers) on change."""
         verdict, barrier = self._validation_health()
@@ -270,9 +292,17 @@ class TPUDevicePlugin:
                  for u in discover_units(self.handoff_dir, handoff=handoff)}
         failed = self._failed_local_chips(barrier, fresh.values()) \
             if verdict == UNHEALTHY and barrier is not None else None
-        for u in fresh.values():
+        partial_pass = verdict == HEALTHY and \
+            self._partial_sweep(barrier, fresh.values())
+        with self._lock:
+            previous = {uid: u.health for uid, u in self._units.items()}
+        for uid, u in fresh.items():
             if verdict == HEALTHY:
-                u.health = HEALTHY
+                # a pass that provably covered only a subset of the host's
+                # chips certifies nothing about the gated ones: carry their
+                # health forward instead of un-gating untested hardware
+                u.health = previous.get(uid, HEALTHY) if partial_pass \
+                    else HEALTHY
             elif failed is None:
                 u.health = UNHEALTHY  # node-level: no per-chip attribution
             else:

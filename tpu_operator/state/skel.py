@@ -253,12 +253,16 @@ class StateSkel:
         current_hash = deep_get(current, "metadata", "annotations", consts.SPEC_HASH_ANNOTATION)
         if current_hash == meta["annotations"][consts.SPEC_HASH_ANNOTATION]:
             heals = self._heal_count(current)
+            obj_key = (api_version, kind, name, namespace)
             if _covers(current, desired):
                 if heals:
                     # drift settled (webhook gone / edit reverted): clear
-                    # the counter so an unrelated future drift gets a
+                    # the counter — and the reported-flag, so a RETURNING
+                    # fight re-announces itself instead of being silently
+                    # re-suspended — so an unrelated future drift gets a
                     # fresh heal budget
                     self._set_heal_count(current, None)
+                    self._suspension_reported.discard(obj_key)
                 # unchanged AND undrifted: the stored fingerprint only
                 # proves the operator's last write matched — an out-of-band
                 # kubectl edit leaves it intact, so the live object must
@@ -276,24 +280,28 @@ class StateSkel:
                 # loop — exactly the write amplification the fingerprint
                 # skip exists to prevent — so degrade THIS object to
                 # hash-only skip, once, loudly
-                obj_key = (api_version, kind, name, namespace)
-                if heals == DRIFT_HEAL_LIMIT \
-                        and obj_key not in self._suspension_reported:
-                    self._suspension_reported.add(obj_key)
-                    where = _first_divergence(current, desired) or "?"
-                    message = (f"{kind}/{name} is rewritten out-of-band at "
-                               f"{where} after every re-apply "
-                               f"({DRIFT_HEAL_LIMIT} consecutive heals); "
-                               f"suspending drift healing for this object "
-                               f"(hash-only skip) — find the mutating "
-                               f"webhook/controller fighting the render")
-                    log.error("state %s: %s", self.name, message)
-                    events.record(self.client, namespace
-                                  or os.environ.get(consts.NAMESPACE_ENV,
-                                                    consts.DEFAULT_NAMESPACE),
-                                  current, events.WARNING, "DriftHealSuspended",
-                                  message)
-                    self._set_heal_count(current, heals + 1)  # damped marker
+                if heals == DRIFT_HEAL_LIMIT:
+                    # always try to persist the damped marker (so the NEXT
+                    # sweep reads heals > LIMIT and skips silently); the
+                    # loud report itself additionally dedupes in-process in
+                    # case that bookkeeping patch keeps failing
+                    self._set_heal_count(current, heals + 1)
+                    if obj_key not in self._suspension_reported:
+                        self._suspension_reported.add(obj_key)
+                        where = _first_divergence(current, desired) or "?"
+                        message = (f"{kind}/{name} is rewritten out-of-band "
+                                   f"at {where} after every re-apply "
+                                   f"({DRIFT_HEAL_LIMIT} consecutive heals); "
+                                   f"suspending drift healing for this "
+                                   f"object (hash-only skip) — find the "
+                                   f"mutating webhook/controller fighting "
+                                   f"the render")
+                        log.error("state %s: %s", self.name, message)
+                        events.record(self.client, namespace
+                                      or os.environ.get(consts.NAMESPACE_ENV,
+                                                        consts.DEFAULT_NAMESPACE),
+                                      current, events.WARNING,
+                                      "DriftHealSuspended", message)
                 return current
             # drift heal is loud: an edited operator-rendered object (RBAC
             # verb dropped, Service port rewritten) is tampering or a
