@@ -25,6 +25,7 @@
 #include <sys/time.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
@@ -93,6 +94,31 @@ bool JsonNumber(const std::string& json, const std::string& key, double* out) {
   return true;
 }
 
+// Parse the FIRST "key": [ints...] array in json into *out; returns false
+// when the key is absent or not an array. Keys are matched with their
+// surrounding quotes, so "failed_chips" (nested per-check) never matches
+// inside "failed_local_chips" (top-level, source-paired) and vice versa.
+bool JsonIntArray(const std::string& json, const char* key,
+                  std::vector<long>* out) {
+  const std::string needle = std::string("\"") + key + "\"";
+  size_t pos = json.find(needle);
+  if (pos == std::string::npos) return false;
+  pos = json.find_first_not_of(" \t:", pos + needle.size());
+  if (pos == std::string::npos || json[pos] != '[') return false;
+  const size_t end = json.find(']', pos);
+  if (end == std::string::npos) return false;
+  const std::string body = json.substr(pos + 1, end - pos - 1);
+  const char* p = body.c_str();
+  char* next = nullptr;
+  while (*p != '\0') {
+    const long value = strtol(p, &next, 10);
+    if (next == p) { ++p; continue; }  // skip commas/whitespace
+    out->push_back(value);
+    p = next;
+  }
+  return true;
+}
+
 void Gauge(std::string* out, const char* name, const char* help, double value) {
   char line[256];
   snprintf(line, sizeof(line), "# HELP %s %s\n# TYPE %s gauge\n%s %.17g\n",
@@ -123,9 +149,64 @@ std::string RenderMetrics(const std::string& status_dir) {
              "1 when the %s validation barrier is present on this node", component);
     Gauge(&out, name, help, BarrierReady(path) ? 1 : 0);
   }
+  const int n_devices = CountDevices(getenv("TPU_DEV_GLOBS"));
   Gauge(&out, "tpu_operator_node_tpu_device_nodes",
-        "TPU device nodes visible on this node",
-        CountDevices(getenv("TPU_DEV_GLOBS")));
+        "TPU device nodes visible on this node", n_devices);
+
+  // per-chip health — twin of metrics.py / validator.status.
+  // failed_local_chips. Attribution is read from the SOURCE-PAIRED
+  // top-level failed_local_chips array (ici_health_check pairs failing
+  // checks with their chips when it writes the barrier), never re-derived
+  // from the nested details, so the two exporters and the device plugin
+  // cannot drift. Rules: failing barrier without the array (legacy /
+  // rendezvous-error / pod-mode coarse record) or without full-host
+  // coverage (local_chips length != visible devices) flags EVERY chip;
+  // a PASSING barrier with only partial coverage emits NO series (it
+  // certifies nothing about gated chips, which the plugin keeps
+  // withdrawn).
+  const std::string workload_path = status_dir + "/workload-ready";
+  std::vector<bool> chip_healthy(static_cast<size_t>(
+                                     n_devices > 0 ? n_devices : 0), true);
+  bool emit_chips = n_devices > 0;
+  if (FileExists(workload_path)) {
+    const std::string workload = ReadFile(workload_path);
+    std::vector<long> local_map;
+    const bool has_map = JsonIntArray(workload, "local_chips", &local_map);
+    const bool full_coverage =
+        has_map ? static_cast<int>(local_map.size()) == n_devices : true;
+    if (BarrierReady(workload_path)) {
+      double n_swept = 0;
+      const bool partial =
+          (has_map && !full_coverage) ||
+          (!has_map && JsonNumber(workload, "n_devices", &n_swept) &&
+           static_cast<int>(n_swept) < n_devices);
+      if (partial) emit_chips = false;  // no full-host verdict to publish
+    } else {
+      std::vector<long> failed_local;
+      const bool attributable =
+          JsonIntArray(workload, "failed_local_chips", &failed_local) &&
+          has_map && full_coverage;
+      for (int i = 0; i < n_devices; ++i) {
+        chip_healthy[static_cast<size_t>(i)] =
+            attributable &&
+            std::find(failed_local.begin(), failed_local.end(),
+                      static_cast<long>(i)) == failed_local.end();
+      }
+    }
+  }
+  if (emit_chips) {
+    out.append("# HELP tpu_operator_node_chip_healthy 1 when the most "
+               "recent full-host workload sweep holds no failure "
+               "attributed to this chip\n"
+               "# TYPE tpu_operator_node_chip_healthy gauge\n");
+    for (int i = 0; i < n_devices; ++i) {
+      char line[128];
+      snprintf(line, sizeof(line),
+               "tpu_operator_node_chip_healthy{chip=\"%d\"} %d\n", i,
+               chip_healthy[static_cast<size_t>(i)] ? 1 : 0);
+      out.append(line);
+    }
+  }
 
   // measured throughput from the perf validation barrier; 0 until perf has
   // run — always emitted so the series set matches the Python exporter

@@ -43,7 +43,9 @@ ETCD="$(find_bin etcd TEST_ASSET_ETCD || true)"
 KCM="$(find_bin kube-controller-manager TEST_ASSET_KUBE_CONTROLLER_MANAGER || true)"
 
 if [ -z "$APISERVER" ] || [ -z "$ETCD" ]; then
-  SKIP_RECORD="$REPO/tests/e2e-envtest-SKIPPED.json"
+  # ENVTEST_SKIP_RECORD lets the default test suite exercise this path
+  # without rewriting the committed record's timestamp on every run
+  SKIP_RECORD="${ENVTEST_SKIP_RECORD:-$REPO/tests/e2e-envtest-SKIPPED.json}"
   python3 - "$SKIP_RECORD" "$PROBE_LOG" <<'PYEOF'
 import json, sys, time
 path = sys.argv[1]

@@ -43,7 +43,7 @@ def load_crds():
 
 
 class Driver:
-    def __init__(self, client, evidence_dir: str, expect_gc: str = "auto",
+    def __init__(self, client, evidence_dir: str, expect_gc: str = "no",
                  timeout: float = 120.0):
         self.client = client
         self.evidence_dir = evidence_dir
@@ -166,16 +166,21 @@ class Driver:
         from tpu_operator.testing.kubelet import KubeletSimulator
         from tpu_operator.utils import deep_get
 
-        for env, image in (
-            ("DRIVER_IMAGE", "gcr.io/tpu/tpu-validator:0.1.0"),
-            ("VALIDATOR_IMAGE", "gcr.io/tpu/tpu-validator:0.1.0"),
-            ("FEATURE_DISCOVERY_IMAGE", "gcr.io/tpu/tpu-validator:0.1.0"),
-            ("TELEMETRY_EXPORTER_IMAGE", "gcr.io/tpu/tpu-validator:0.1.0"),
-            ("SLICE_PARTITIONER_IMAGE", "gcr.io/tpu/tpu-validator:0.1.0"),
-            ("DEVICE_PLUGIN_IMAGE", "gcr.io/tpu/device-plugin:0.1.0"),
-        ):
-            os.environ.setdefault(env, image)
-        os.environ.setdefault(consts.NAMESPACE_ENV, NS)
+        defaults = {
+            "DRIVER_IMAGE": "gcr.io/tpu/tpu-validator:0.1.0",
+            "VALIDATOR_IMAGE": "gcr.io/tpu/tpu-validator:0.1.0",
+            "FEATURE_DISCOVERY_IMAGE": "gcr.io/tpu/tpu-validator:0.1.0",
+            "TELEMETRY_EXPORTER_IMAGE": "gcr.io/tpu/tpu-validator:0.1.0",
+            "SLICE_PARTITIONER_IMAGE": "gcr.io/tpu/tpu-validator:0.1.0",
+            "DEVICE_PLUGIN_IMAGE": "gcr.io/tpu/device-plugin:0.1.0",
+            consts.NAMESPACE_ENV: NS,
+        }
+        # save/restore: when embedded in a pytest process (the
+        # MiniApiServer self-check) leaking defaults would make later
+        # missing-image/default-namespace tests order-dependent
+        saved = {k: os.environ.get(k) for k in defaults}
+        for key, value in defaults.items():
+            os.environ.setdefault(key, value)
         try:
             self.client.create({"apiVersion": "v1", "kind": "Namespace",
                                 "metadata": {"name": NS}})
@@ -212,6 +217,11 @@ class Driver:
         finally:
             app.stop()
             kubelet.stop()
+            for key, value in saved.items():
+                if value is None:
+                    os.environ.pop(key, None)
+                else:
+                    os.environ[key] = value
         self.record("reconcile-to-ready", "pass" if ok else "fail",
                     "node schedulable + ClusterPolicy ready" if ok
                     else "never converged")

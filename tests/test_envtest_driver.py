@@ -61,12 +61,14 @@ def test_script_skips_honestly_without_binaries(tmp_path):
            if k not in ("KUBEBUILDER_ASSETS", "TEST_ASSET_KUBE_APISERVER",
                         "TEST_ASSET_ETCD")}
     env["PATH"] = "/usr/bin:/bin"  # no k8s binaries live here in this image
+    # write the record to tmp: the default suite must not churn the
+    # COMMITTED skip record's timestamp on every pytest run
+    record_path = str(tmp_path / "skip-record.json")
+    env["ENVTEST_SKIP_RECORD"] = record_path
     proc = subprocess.run(
         ["bash", os.path.join(os.path.dirname(__file__), "e2e-envtest.sh")],
         env=env, capture_output=True, text=True, timeout=60)
     assert proc.returncode == 77, proc.stdout + proc.stderr
-    record_path = os.path.join(os.path.dirname(__file__),
-                               "e2e-envtest-SKIPPED.json")
     with open(record_path) as f:
         record = json.load(f)
     assert record["skipped"] is True

@@ -144,3 +144,79 @@ def test_exec_failure_falls_back_to_python(tmp_path, monkeypatch):
     monkeypatch.setenv("TPU_EXPORTER_BIN", str(bogus))
     # find_exporter_binary() accepts it; execv raises ENOEXEC; we return
     _exec_native_exporter(port=0)
+
+
+def _chip_series(text: str) -> dict:
+    out = {}
+    for line in text.splitlines():
+        if line.startswith("tpu_operator_node_chip_healthy{"):
+            name, _, value = line.partition(" ")
+            out[name] = float(value)
+    return out
+
+
+def test_per_chip_health_parity(exporter_bin, tmp_path, monkeypatch):
+    """Native and Python exporters agree on the per-chip health series:
+    attributed failures flag only their chips; unattributable ones flag
+    every chip (fail safe)."""
+    devdir = tmp_path / "dev"
+    devdir.mkdir()
+    for i in range(4):
+        (devdir / f"accel{i}").touch()
+    monkeypatch.setenv("TPU_DEV_GLOBS", str(devdir / "accel*"))
+    d = tmp_path / "validations"
+    status = StatusFiles(str(d))
+
+    def native():
+        out = subprocess.run(
+            [exporter_bin, "--oneshot", f"--status-dir={d}"],
+            capture_output=True, text=True, check=True).stdout
+        return _chip_series(out)
+
+    def python():
+        from prometheus_client import generate_latest
+
+        m = NodeMetrics(status=StatusFiles(str(d)))
+        m.refresh()
+        text = generate_latest(m.registry).decode()
+        return _chip_series(text)
+
+    # attributed: chip 2 failed the ring check — a modern barrier carries
+    # the source-paired failed_local_chips array both exporters consume
+    status.write("workload", {
+        "passed": False, "n_devices": 4, "local_chips": [0, 1, 2, 3],
+        "failed_local_chips": [2],
+        "details": {"ring": {"passed": False, "failed_chips": [2]},
+                    "compute": {"passed": True, "failed_chips": []}}})
+    expect = {f'tpu_operator_node_chip_healthy{{chip="{i}"}}': (0.0 if i == 2 else 1.0)
+              for i in range(4)}
+    assert native() == expect
+    assert python() == expect
+
+    # unattributable (rendezvous error): every chip reads 0
+    status.write("workload", {"passed": False,
+                              "details": {"error": "rendezvous timed out"}})
+    assert set(native().values()) == {0.0}
+    assert set(python().values()) == {0.0}
+
+    # partial-coverage PASS (pod-spawned revalidation over a unit subset):
+    # neither exporter may publish a verdict it doesn't have
+    status.write("workload", {"passed": True, "n_devices": 3,
+                              "local_chips": [0, 1, 2],
+                              "failed_local_chips": []})
+    assert native() == {}
+    assert python() == {}
+
+    # recovery: full-host passing barrier -> all 1
+    status.write("workload", {"passed": True, "n_devices": 4,
+                              "local_chips": [0, 1, 2, 3],
+                              "failed_local_chips": []})
+    assert set(native().values()) == {1.0}
+    assert set(python().values()) == {1.0}
+
+    # corrupt-but-present barrier: fail safe on the wire (Python exporter;
+    # the plugin gates all units on the same condition)
+    with open(os.path.join(str(d), "workload-ready"), "w") as f:
+        f.write('{"passed": false, "truncated')
+    assert set(python().values()) == {0.0}
+    assert set(native().values()) == {0.0}

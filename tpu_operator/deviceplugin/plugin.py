@@ -208,57 +208,19 @@ class TPUDevicePlugin:
 
     @staticmethod
     def _failed_local_chips(info, units) -> Optional[frozenset]:
-        """Local chip ids implicated by a failed-sweep barrier, or None when
-        the failure cannot be attributed to specific chips (then ALL units
-        must gate — fail safe, the pre-r5 behavior).
-
-        ``details.*.failed_chips`` carries *global sweep ordinals*; the
-        report's ``local_chips`` (global ordinal per local chip, in local
-        device order — written by ``ici_health_check``) translates them.
-        Barriers from older validators lack the map: fall back to the
-        identity mapping only when the sweep provably ran on exactly this
-        host's chips (n_devices matches), else refuse to attribute.
+        """Local chip ids implicated by a failed-sweep barrier, or None
+        when the failure cannot be attributed (then ALL units must gate —
+        fail safe, the pre-r5 behavior). Attribution semantics live in
+        ``validator.status.failed_local_chips``, shared with the exporters.
 
         The reference stack gets the same granularity from NVIDIA's device
         plugin marking individual GPUs unhealthy, consumed via node
         capacity (reference validator/main.go:1240-1299); on TPU the sweep
         itself is the per-chip oracle."""
-        if not isinstance(info, dict):
-            return None
-        details = info.get("details")
-        if not isinstance(details, dict):
-            return None
-        failed_global = set()
-        try:
-            for check in details.values():
-                if not isinstance(check, dict):
-                    return None  # e.g. {"error": "..."} — unattributable
-                if check.get("passed") is not False:
-                    continue
-                chips = check.get("failed_chips")
-                if not isinstance(chips, list) or not chips:
-                    return None  # a check failed with no chip attribution
-                failed_global.update(int(c) for c in chips)
-            if not failed_global:
-                return None  # passed:false but no failing check recorded
-            local_count = len({c for u in units for c in u.chips})
-            local_map = info.get("local_chips")
-            if local_map:
-                # sweep ordinals only mean host chip ids when the sweep
-                # covered this host's FULL chip set: a subset sweep (a pod
-                # allocated 3 of 4 units sees renumbered TPU_VISIBLE_CHIPS
-                # devices) would misattribute failures to the wrong units
-                if len(local_map) != local_count:
-                    return None
-            else:
-                if info.get("n_devices") != local_count:
-                    return None
-                local_map = list(range(local_count))
-            return frozenset(local for local, global_ord
-                             in enumerate(local_map)
-                             if global_ord in failed_global)
-        except (TypeError, ValueError):
-            return None  # malformed barrier content: gate all, fail safe
+        from ..validator.status import failed_local_chips
+
+        return failed_local_chips(info,
+                                  len({c for u in units for c in u.chips}))
 
     @staticmethod
     def _partial_sweep(info, units) -> bool:
@@ -270,17 +232,9 @@ class TPUDevicePlugin:
         fail -> subset-pass -> fail while taking real work. Recovery from
         a gate is the full-host ``workload-local`` direct run (all of
         /dev, no allocation), whose barrier covers every chip."""
-        if not isinstance(info, dict):
-            return False  # hand-written/minimal barriers: no coverage claim
-        local_count = len({c for u in units for c in u.chips})
-        local_map = info.get("local_chips")
-        if isinstance(local_map, list) and local_map:
-            return len(local_map) != local_count
-        n = info.get("n_devices")
-        # no local map: a single-host sweep's n_devices must cover every
-        # chip; smaller is provably partial (larger = legacy multihost
-        # barrier — not partial for this host)
-        return isinstance(n, int) and n < local_count
+        from ..validator.status import partial_sweep
+
+        return partial_sweep(info, len({c for u in units for c in u.chips}))
 
     def refresh_units(self) -> bool:
         """Re-enumerate; returns True (and notifies watchers) on change."""

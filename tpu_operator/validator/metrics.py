@@ -35,6 +35,18 @@ class NodeMetrics:
         self.device_nodes = Gauge("tpu_operator_node_tpu_device_nodes",
                                   "TPU device nodes visible on this node",
                                   registry=self.registry)
+        # per-chip health from the workload barrier's failed_chips
+        # attribution — the wire signal behind the device plugin's
+        # per-unit gate, so dashboards/alerts can name the sick chip
+        # instead of the whole node (DCGM per-GPU health analog)
+        self.chip_healthy = Gauge(
+            "tpu_operator_node_chip_healthy",
+            "1 when the most recent full-host workload sweep holds no "
+            "failure attributed to this chip; 0 on attributed failure OR "
+            "any unattributable/corrupt failure record (fail safe, every "
+            "chip reads 0); series absent while only a partial-coverage "
+            "sweep result exists",
+            ["chip"], registry=self.registry)
         self.last_refresh = Gauge("tpu_operator_node_metrics_last_refresh_ts_seconds",
                                   "Timestamp of the last metrics refresh",
                                   registry=self.registry)
@@ -55,7 +67,36 @@ class NodeMetrics:
     def refresh(self) -> None:
         for component, gauge in self.ready.items():
             gauge.set(1 if self.status.is_ready(component) else 0)
-        self.device_nodes.set(len(discover_devices()))
+        n_devices = len(discover_devices())
+        self.device_nodes.set(n_devices)
+        from .status import failed_local_chips, partial_sweep
+
+        workload = self.status.read("workload")
+        corrupt = workload is None and os.path.exists(
+            self.status.path("workload"))
+        # stale series from a previous device count (a chip falling off
+        # the bus) must not keep alerting/masking forever
+        self.chip_healthy.clear()
+        if workload is not None and workload.get("passed") is not False \
+                and partial_sweep(workload, n_devices):
+            # a partial-coverage pass says nothing about the gated chips
+            # (the device plugin keeps them withdrawn); emit NO series
+            # rather than a wrong answer — matches the native exporter
+            pass
+        else:
+            failed = None
+            if corrupt:
+                # unparsable-but-present barrier: the device plugin fails
+                # safe (all units withdrawn); the wire must agree
+                failed = frozenset(range(n_devices))
+            elif workload is not None and workload.get("passed") is False:
+                # None = unattributable -> every chip reads unhealthy
+                failed = failed_local_chips(workload, n_devices)
+                if failed is None:
+                    failed = frozenset(range(n_devices))
+            for chip in range(n_devices):
+                self.chip_healthy.labels(chip=str(chip)).set(
+                    0 if failed is not None and chip in failed else 1)
         perf = self.status.read("perf") or {}
         for key, gauge in self.perf.items():
             value = perf.get(key)

@@ -84,3 +84,76 @@ class StatusFiles:
             if time.monotonic() >= deadline:
                 return False
             time.sleep(min(poll, max(0.01, deadline - time.monotonic())))
+
+
+def failed_local_chips(info, local_count: int):
+    """Local chip ids implicated by a failed workload barrier, or None when
+    the failure cannot be attributed to specific chips (consumers then must
+    treat EVERY chip as implicated — fail safe).
+
+    ``details.*.failed_chips`` carries *global sweep ordinals*; the
+    report's ``local_chips`` (global ordinal per local chip, in local
+    device order — written by ``ici_health_check``) translates them, and
+    only counts when the sweep covered this host's FULL chip set
+    (``local_count``): a subset sweep's renumbered ordinals cannot be tied
+    to host chip ids. Barriers from older validators lack the map: the
+    identity mapping applies only when ``n_devices`` matches exactly.
+
+    Shared by the device plugin's per-chip health gate and the node-status
+    exporters so the two can never disagree about attribution."""
+    if not isinstance(info, dict):
+        return None
+    pre_paired = info.get("failed_local_chips")
+    if isinstance(pre_paired, list):
+        # modern barrier: attribution was computed at the source
+        # (ici_health_check pairs failing checks with their chips); only
+        # the coverage guard remains — a subset sweep's local indices are
+        # renumbered and cannot be tied to host chip ids
+        local_map = info.get("local_chips")
+        if not isinstance(local_map, list) or len(local_map) != local_count:
+            return None
+        try:
+            return frozenset(int(c) for c in pre_paired)
+        except (TypeError, ValueError):
+            return None
+    details = info.get("details")
+    if not isinstance(details, dict):
+        return None
+    failed_global = set()
+    try:
+        for check in details.values():
+            if not isinstance(check, dict):
+                return None  # e.g. {"error": "..."} — unattributable
+            if check.get("passed") is not False:
+                continue
+            chips = check.get("failed_chips")
+            if not isinstance(chips, list) or not chips:
+                return None  # a check failed with no chip attribution
+            failed_global.update(int(c) for c in chips)
+        if not failed_global:
+            return None  # passed:false but no failing check recorded
+        local_map = info.get("local_chips")
+        if local_map:
+            if len(local_map) != local_count:
+                return None
+        else:
+            if info.get("n_devices") != local_count:
+                return None
+            local_map = list(range(local_count))
+        return frozenset(local for local, global_ord in enumerate(local_map)
+                         if global_ord in failed_global)
+    except (TypeError, ValueError):
+        return None  # malformed barrier content: attribute nothing
+
+
+def partial_sweep(info, local_count: int) -> bool:
+    """True when a PASSING barrier provably covered less than this host's
+    full chip set (see the device plugin's gate for why a subset pass must
+    not clear per-chip gates)."""
+    if not isinstance(info, dict):
+        return False  # hand-written/minimal barriers: no coverage claim
+    local_map = info.get("local_chips")
+    if isinstance(local_map, list) and local_map:
+        return len(local_map) != local_count
+    n = info.get("n_devices")
+    return isinstance(n, int) and n < local_count

@@ -63,6 +63,11 @@ class IciCheckReport:
     #: ``details.*.failed_chips`` (global ordinals) into local chip ids,
     #: including for multihost sweeps where this host owns a slice subset
     local_chips: list = dataclasses.field(default_factory=list)
+    #: LOCAL chip indices (positions in local_chips) with any failing
+    #: check, pre-paired at the source so barrier consumers (device
+    #: plugin, Python + native exporters) never re-derive attribution
+    #: from details themselves and drift apart
+    failed_local_chips: list = dataclasses.field(default_factory=list)
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -137,6 +142,10 @@ def ici_health_check(matrix_dim: int = 512, devices=None) -> IciCheckReport:
         for i, name in enumerate(names)
     }
     me = jax.process_index()
+    local_chips = [i for i, d in enumerate(devices)
+                   if getattr(d, "process_index", me) == me]
+    failed_global = {c for check in details.values()
+                     for c in check["failed_chips"]}
     return IciCheckReport(
         passed=bool(per_chip_results.all()),
         n_devices=n,
@@ -144,8 +153,10 @@ def ici_health_check(matrix_dim: int = 512, devices=None) -> IciCheckReport:
         elapsed_s=round(elapsed, 4),
         compile_s=round(compile_s, 4),
         details=details,
-        local_chips=[i for i, d in enumerate(devices)
-                     if getattr(d, "process_index", me) == me],
+        local_chips=local_chips,
+        failed_local_chips=[local for local, global_ord
+                            in enumerate(local_chips)
+                            if global_ord in failed_global],
     )
 
 
