@@ -154,16 +154,15 @@ std::string RenderMetrics(const std::string& status_dir) {
         "TPU device nodes visible on this node", n_devices);
 
   // per-chip health — twin of metrics.py / validator.status.
-  // failed_local_chips. Attribution is read from the SOURCE-PAIRED
-  // top-level failed_local_chips array (ici_health_check pairs failing
-  // checks with their chips when it writes the barrier), never re-derived
-  // from the nested details, so the two exporters and the device plugin
-  // cannot drift. Rules: failing barrier without the array (legacy /
-  // rendezvous-error / pod-mode coarse record) or without full-host
-  // coverage (local_chips length != visible devices) flags EVERY chip;
-  // a PASSING barrier with only partial coverage emits NO series (it
-  // certifies nothing about gated chips, which the plugin keeps
-  // withdrawn).
+  // failed_local_chips. Attribution prefers the SOURCE-PAIRED top-level
+  // failed_local_chips array (ici_health_check pairs failing checks with
+  // their chips when it writes the barrier); legacy barriers fall back
+  // to the nested details with the same pairing rules as the Python
+  // helper. Unattributable failures (rendezvous-error / pod-mode coarse
+  // record / failing check without chips) or missing full-host coverage
+  // (local_chips length != visible devices) flag EVERY chip; a PASSING
+  // barrier with only partial coverage emits NO series (it certifies
+  // nothing about gated chips, which the plugin keeps withdrawn).
   const std::string workload_path = status_dir + "/workload-ready";
   std::vector<bool> chip_healthy(static_cast<size_t>(
                                      n_devices > 0 ? n_devices : 0), true);
@@ -183,14 +182,62 @@ std::string RenderMetrics(const std::string& status_dir) {
       if (partial) emit_chips = false;  // no full-host verdict to publish
     } else {
       std::vector<long> failed_local;
-      const bool attributable =
-          JsonIntArray(workload, "failed_local_chips", &failed_local) &&
-          has_map && full_coverage;
+      bool attributable =
+          JsonIntArray(workload, "failed_local_chips", &failed_local);
+      // modern arrays hold LOCAL indices; legacy details arrays hold
+      // GLOBAL sweep ordinals that must translate through local_chips
+      bool values_are_local = attributable;
+      if (!attributable) {
+        // legacy barrier (pre-r5 validator, version-skew window): derive
+        // attribution from the nested details with the same pairing rule
+        // as Python's failed_local_chips — only FAILING checks count,
+        // and a failing check with no chips is unattributable. The
+        // writer serializes each check as {"passed": ..,
+        // "failed_chips": [..]}, so the check's verdict is the nearest
+        // "passed" before its array.
+        attributable = true;
+        bool any_failed = false;
+        const std::string needle = "\"failed_chips\"";
+        size_t pos = 0;
+        while ((pos = workload.find(needle, pos)) != std::string::npos) {
+          const size_t passed_pos = workload.rfind("\"passed\"", pos);
+          bool check_failed = false;
+          if (passed_pos != std::string::npos) {
+            const size_t value = workload.find_first_not_of(
+                " \t:", passed_pos + strlen("\"passed\""));
+            check_failed = value != std::string::npos &&
+                           workload.compare(value, 5, "false") == 0;
+          }
+          std::vector<long> chips;
+          JsonIntArray(workload.substr(pos), "failed_chips", &chips);
+          if (check_failed) {
+            if (chips.empty()) { attributable = false; break; }
+            any_failed = true;
+            failed_local.insert(failed_local.end(), chips.begin(),
+                                chips.end());
+          }
+          pos += needle.size();
+        }
+        if (!any_failed) attributable = false;  // e.g. {"error": "..."}
+        // legacy arrays hold GLOBAL ordinals: identity-mappable only for
+        // a sweep over exactly this host's chips (matches Python's
+        // n_devices guard; the local_map length check below covers the
+        // map-bearing case)
+        double n_swept = 0;
+        if (attributable && !has_map &&
+            (!JsonNumber(workload, "n_devices", &n_swept) ||
+             static_cast<int>(n_swept) != n_devices))
+          attributable = false;
+      }
+      attributable = attributable && full_coverage;
       for (int i = 0; i < n_devices; ++i) {
+        long key = i;
+        if (!values_are_local && has_map)
+          key = local_map[static_cast<size_t>(i)];
         chip_healthy[static_cast<size_t>(i)] =
             attributable &&
-            std::find(failed_local.begin(), failed_local.end(),
-                      static_cast<long>(i)) == failed_local.end();
+            std::find(failed_local.begin(), failed_local.end(), key) ==
+                failed_local.end();
       }
     }
   }

@@ -220,3 +220,31 @@ def test_per_chip_health_parity(exporter_bin, tmp_path, monkeypatch):
         f.write('{"passed": false, "truncated')
     assert set(python().values()) == {0.0}
     assert set(native().values()) == {0.0}
+
+    # LEGACY barrier (pre-r5 validator, no failed_local_chips array):
+    # attribution derived from the nested details with the same pairing
+    # rules — the version-skew window must not over-alert
+    status.write("workload", {
+        "passed": False, "n_devices": 4,
+        "details": {"ring": {"passed": False, "failed_chips": [2]},
+                    "compute": {"passed": True, "failed_chips": []}}})
+    assert native() == expect
+    assert python() == expect
+
+    # legacy multihost: global ordinals translate through local_chips
+    status.write("workload", {
+        "passed": False, "n_devices": 16, "local_chips": [4, 5, 6, 7],
+        "details": {"ring": {"passed": False, "failed_chips": [6]}}})
+    expect_mh = {f'tpu_operator_node_chip_healthy{{chip="{i}"}}':
+                 (0.0 if i == 2 else 1.0) for i in range(4)}
+    assert native() == expect_mh
+    assert python() == expect_mh
+
+    # legacy failing check WITHOUT chip attribution: unattributable ->
+    # every chip flagged (both sides)
+    status.write("workload", {
+        "passed": False, "n_devices": 4,
+        "details": {"ring": {"passed": False, "failed_chips": []},
+                    "compute": {"passed": False, "failed_chips": [2]}}})
+    assert set(native().values()) == {0.0}
+    assert set(python().values()) == {0.0}

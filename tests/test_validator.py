@@ -341,6 +341,46 @@ def test_info_reports_stack_state(tmp_path, status, fake_devs, monkeypatch, caps
     assert "driver=ok" in text and "workload=--" in text
 
 
+def test_info_names_failed_chips(tmp_path, status, fake_devs, monkeypatch):
+    """The nvidia-smi analog names the sick chips from the workload
+    barrier's attribution (and says so when the failure is
+    unattributable)."""
+    from tpu_operator.validator import info as info_mod
+
+    monkeypatch.setenv("TPU_INFO_SKIP_JAX", "1")
+    install = tmp_path / "libtpu"
+    install.mkdir()
+    (install / "libtpu.so").write_bytes(b"\x7fELF x")
+    status.write("workload", {
+        "passed": False, "n_devices": 4, "local_chips": [0, 1, 2, 3],
+        "failed_local_chips": [1, 3],
+        "details": {"ring": {"passed": False, "failed_chips": [1, 3]}}})
+    data = info_mod.collect(str(install), status=status)
+    assert data["failed_chips"] == [1, 3]
+    text = info_mod.render(data)
+    assert "UNHEALTHY" in text and "chip 1, chip 3" in text
+
+    status.write("workload", {"passed": False,
+                              "details": {"error": "rendezvous timed out"}})
+    data = info_mod.collect(str(install), status=status)
+    assert data["failed_chips"] == "unattributed (all chips suspect)"
+    assert "all chips suspect" in info_mod.render(data)
+
+    status.write("workload", {"passed": True, "n_devices": 4,
+                              "local_chips": [0, 1, 2, 3],
+                              "failed_local_chips": []})
+    data = info_mod.collect(str(install), status=status)
+    assert "failed_chips" not in data
+
+    # corrupt-but-present barrier: info must explain the all-chips alert
+    import os as _os
+
+    with open(status.path("workload"), "w") as f:
+        f.write('{"passed": false, "truncated')
+    data = info_mod.collect(str(install), status=status)
+    assert data["failed_chips"] == "corrupt barrier (all chips suspect)"
+
+
 def test_info_cli_exit_codes(tmp_path, fake_devs, monkeypatch, capsys):
     monkeypatch.setenv("TPU_INFO_SKIP_JAX", "1")
     monkeypatch.setenv("STATUS_DIR", str(tmp_path / "v"))

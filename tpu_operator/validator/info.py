@@ -37,6 +37,20 @@ def collect(install_dir: str = consts.DEFAULT_LIBTPU_DIR,
     driver_record = status.read("driver") or {}
     if driver_record.get("libtpu_version"):
         info["libtpu"]["version"] = driver_record["libtpu_version"]
+    # per-chip verdict from the workload barrier (the signal behind the
+    # device plugin's per-unit gate and the chip_healthy exporter series)
+    workload = status.read("workload")
+    if workload is None and os.path.exists(status.path("workload")):
+        # present-but-unparsable: the plugin and exporters fail safe on
+        # this state (all units withdrawn, every chip_healthy 0) — the
+        # at-a-glance tool must explain the alert, not stay silent
+        info["failed_chips"] = "corrupt barrier (all chips suspect)"
+    elif workload is not None and workload.get("passed") is False:
+        from .status import failed_local_chips
+
+        failed = failed_local_chips(workload, len(info["device_nodes"]))
+        info["failed_chips"] = (sorted(failed) if failed is not None
+                                else "unattributed (all chips suspect)")
     perf = status.read("perf") or {}
     if perf:
         info["perf"] = {k: perf.get(k, 0.0) for k in
@@ -91,6 +105,11 @@ def render(info: dict) -> str:
     marks = "  ".join(f"{c}={CHECK if ready else MISS}"
                       for c, ready in info["validations"].items())
     lines.append(f"  validations:  {marks}")
+    if "failed_chips" in info:
+        failed = info["failed_chips"]
+        detail = (", ".join(f"chip {c}" for c in failed)
+                  if isinstance(failed, list) else failed)
+        lines.append(f"  UNHEALTHY:    workload sweep failed — {detail}")
     if "perf" in info:
         p = info["perf"]
         ici = f"{p['ici_allreduce_gbps']:.0f} GB/s" if p.get("ici_allreduce_gbps") else MISS
