@@ -196,7 +196,7 @@ std::string RenderMetrics(const std::string& status_dir) {
         // "failed_chips": [..]}, so the check's verdict is the nearest
         // "passed" before its array.
         attributable = true;
-        bool any_failed = false;
+        int failing_with_chips = 0;
         const std::string needle = "\"failed_chips\"";
         size_t pos = 0;
         while ((pos = workload.find(needle, pos)) != std::string::npos) {
@@ -212,13 +212,29 @@ std::string RenderMetrics(const std::string& status_dir) {
           JsonIntArray(workload.substr(pos), "failed_chips", &chips);
           if (check_failed) {
             if (chips.empty()) { attributable = false; break; }
-            any_failed = true;
+            ++failing_with_chips;
             failed_local.insert(failed_local.end(), chips.begin(),
                                 chips.end());
           }
           pos += needle.size();
         }
-        if (!any_failed) attributable = false;  // e.g. {"error": "..."}
+        // every "passed": false marker except the barrier's own top-level
+        // verdict must have contributed an attributed array — a failing
+        // check WITHOUT a failed_chips key (or a bare {"error": ...}
+        // record) is unattributable, matching the Python helper
+        int passed_false_total = 0;
+        for (size_t p = 0;
+             (p = workload.find("\"passed\"", p)) != std::string::npos;
+             p += strlen("\"passed\"")) {
+          const size_t value = workload.find_first_not_of(
+              " \t:", p + strlen("\"passed\""));
+          if (value != std::string::npos &&
+              workload.compare(value, 5, "false") == 0)
+            ++passed_false_total;
+        }
+        if (failing_with_chips == 0 ||
+            failing_with_chips != passed_false_total - 1)
+          attributable = false;
         // legacy arrays hold GLOBAL ordinals: identity-mappable only for
         // a sweep over exactly this host's chips (matches Python's
         // n_devices guard; the local_map length check below covers the
@@ -229,6 +245,10 @@ std::string RenderMetrics(const std::string& status_dir) {
              static_cast<int>(n_swept) != n_devices))
           attributable = false;
       }
+      // modern arrays are LOCAL indices and only meaningful alongside
+      // their local_chips map (the Python helper requires it); legacy
+      // no-map barriers were n_devices-guarded above
+      if (values_are_local) attributable = attributable && has_map;
       attributable = attributable && full_coverage;
       for (int i = 0; i < n_devices; ++i) {
         long key = i;

@@ -247,6 +247,47 @@ timeout 120 bash -c \
 echo "ok: telemetry DS recreated"
 record pass operand-disable-enable
 
+echo "=== env-only driver change rolls the DS (whole-template currency) ==="
+# Patching ONLY spec.driver.env must roll the driver DS through the REAL
+# DaemonSet controller: the render-stamped tpu.ai/template-hash label
+# changes, the controller replaces pods, and the new pods carry the new
+# label — the signal the upgrade machine compares (image stays fixed, so
+# the pre-r5 containers[0] image/args check would have seen nothing).
+IMG_BEFORE=$(kubectl -n "$NS" get ds libtpu-driver \
+  -o jsonpath='{.spec.template.spec.containers[0].image}')
+HASH_BEFORE=$(kubectl -n "$NS" get ds libtpu-driver \
+  -o jsonpath='{.spec.template.metadata.labels.tpu\.ai/template-hash}')
+kubectl patch clusterpolicies.tpu.ai/cluster-policy --type merge \
+  -p '{"spec":{"driver":{"env":[{"name":"LIBTPU_INIT_ARGS","value":"--xla_tpu_probe=1"}]}}}'
+timeout 120 bash -c '
+  until [ "$(kubectl -n '"$NS"' get ds libtpu-driver \
+      -o jsonpath="{.spec.template.metadata.labels.tpu\.ai/template-hash}")" \
+      != "'"$HASH_BEFORE"'" ]; do sleep 2; done'
+kubectl -n "$NS" rollout status ds/libtpu-driver --timeout 180s
+HASH_NOW=$(kubectl -n "$NS" get ds libtpu-driver \
+  -o jsonpath='{.spec.template.metadata.labels.tpu\.ai/template-hash}')
+POD_HASH=$(kubectl -n "$NS" get pods -l app.kubernetes.io/component=tpu-driver \
+  -o jsonpath='{.items[0].metadata.labels.tpu\.ai/template-hash}')
+IMG_AFTER=$(kubectl -n "$NS" get ds libtpu-driver \
+  -o jsonpath='{.spec.template.spec.containers[0].image}')
+if [ "$POD_HASH" != "$HASH_NOW" ] || [ "$IMG_BEFORE" != "$IMG_AFTER" ]; then
+  echo "FAIL: env-only roll: pod hash $POD_HASH vs DS $HASH_NOW;"
+  echo "      image $IMG_BEFORE -> $IMG_AFTER (must be unchanged)"
+  record fail env-only-roll "pod=$POD_HASH ds=$HASH_NOW"; exit 1
+fi
+echo "ok: env-only change rolled driver pods via template hash (image unchanged)"
+# revert so later steps see the default template — wait for the operator
+# to re-render (hash back to the original) BEFORE asking for rollout
+# status, else the still-current old rollout reports success instantly
+kubectl patch clusterpolicies.tpu.ai/cluster-policy --type merge \
+  -p '{"spec":{"driver":{"env":[]}}}'
+timeout 120 bash -c '
+  until [ "$(kubectl -n '"$NS"' get ds libtpu-driver \
+      -o jsonpath="{.spec.template.metadata.labels.tpu\.ai/template-hash}")" \
+      = "'"$HASH_BEFORE"'" ]; do sleep 2; done'
+kubectl -n "$NS" rollout status ds/libtpu-driver --timeout 180s
+record pass env-only-roll
+
 echo "=== drift heal: out-of-band edit to a rendered object is reverted ==="
 # Drop the ports from the operator-rendered telemetry Service — kubectl
 # drift the operator must reconcile away. On a REAL apiserver this also

@@ -248,3 +248,44 @@ def test_per_chip_health_parity(exporter_bin, tmp_path, monkeypatch):
                     "compute": {"passed": False, "failed_chips": [2]}}})
     assert set(native().values()) == {0.0}
     assert set(python().values()) == {0.0}
+
+
+def test_per_chip_health_edge_parity(exporter_bin, tmp_path, monkeypatch):
+    """Divergence-prone corners both exporters must agree on: a modern
+    array without its local_chips map, and a legacy failing check that
+    carries no failed_chips key at all — both unattributable, both flag
+    every chip."""
+    devdir = tmp_path / "dev"
+    devdir.mkdir()
+    for i in range(4):
+        (devdir / f"accel{i}").touch()
+    monkeypatch.setenv("TPU_DEV_GLOBS", str(devdir / "accel*"))
+    d = tmp_path / "validations"
+    status = StatusFiles(str(d))
+
+    def native():
+        out = subprocess.run(
+            [exporter_bin, "--oneshot", f"--status-dir={d}"],
+            capture_output=True, text=True, check=True).stdout
+        return _chip_series(out)
+
+    def python():
+        from prometheus_client import generate_latest
+
+        m = NodeMetrics(status=StatusFiles(str(d)))
+        m.refresh()
+        return _chip_series(generate_latest(m.registry).decode())
+
+    # modern failed_local_chips without the local_chips map
+    status.write("workload", {"passed": False, "failed_local_chips": [2]})
+    assert set(native().values()) == {0.0}
+    assert set(python().values()) == {0.0}
+
+    # legacy: one attributed failing check + one failing check with NO
+    # failed_chips key -> unattributable as a whole
+    status.write("workload", {
+        "passed": False, "n_devices": 4,
+        "details": {"ring": {"passed": False, "failed_chips": [2]},
+                    "init": {"passed": False}}})
+    assert set(native().values()) == {0.0}
+    assert set(python().values()) == {0.0}

@@ -366,6 +366,15 @@ def test_info_names_failed_chips(tmp_path, status, fake_devs, monkeypatch):
     assert data["failed_chips"] == "unattributed (all chips suspect)"
     assert "all chips suspect" in info_mod.render(data)
 
+    # failure wholly on another slice host: local chips stay schedulable
+    # and info says so (no dangling empty list)
+    status.write("workload", {
+        "passed": False, "n_devices": 16, "local_chips": [4, 5, 6, 7],
+        "failed_local_chips": [],
+        "details": {"ring": {"passed": False, "failed_chips": [12]}}})
+    data = info_mod.collect(str(install), status=status)
+    assert data["failed_chips"] == "none local (failure on another slice host)"
+
     status.write("workload", {"passed": True, "n_devices": 4,
                               "local_chips": [0, 1, 2, 3],
                               "failed_local_chips": []})
@@ -373,8 +382,6 @@ def test_info_names_failed_chips(tmp_path, status, fake_devs, monkeypatch):
     assert "failed_chips" not in data
 
     # corrupt-but-present barrier: info must explain the all-chips alert
-    import os as _os
-
     with open(status.path("workload"), "w") as f:
         f.write('{"passed": false, "truncated')
     data = info_mod.collect(str(install), status=status)

@@ -49,8 +49,15 @@ def collect(install_dir: str = consts.DEFAULT_LIBTPU_DIR,
         from .status import failed_local_chips
 
         failed = failed_local_chips(workload, len(info["device_nodes"]))
-        info["failed_chips"] = (sorted(failed) if failed is not None
-                                else "unattributed (all chips suspect)")
+        if failed is None:
+            info["failed_chips"] = "unattributed (all chips suspect)"
+        elif not failed:
+            # multihost sweep failed wholly on ANOTHER slice host: local
+            # chips stay schedulable; say so instead of an empty list
+            info["failed_chips"] = ("none local (failure on another "
+                                    "slice host)")
+        else:
+            info["failed_chips"] = sorted(failed)
     perf = status.read("perf") or {}
     if perf:
         info["perf"] = {k: perf.get(k, 0.0) for k in
