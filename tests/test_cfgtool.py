@@ -176,6 +176,16 @@ def test_status_against_live_harness(capsys):
         # the slice-partition column shows the failed rollout at a glance
         assert "split-2x2=failed" in out
         assert "libtpu-driver" in out
+        assert "HEALTHY" in out  # allocatable-vs-capacity health column
+
+        # the per-chip health gate shows cluster-wide as allocatable <
+        # capacity (the kubelet withdraws Unhealthy units): flag the node
+        node = client.get("v1", "Node", "tpu-0")
+        node["status"]["allocatable"] = {consts.TPU_RESOURCE_NAME: "3"}
+        client.update_status(node)
+        run(["status", "--base-url", base])
+        out = capsys.readouterr().out
+        assert "3!" in out, "withdrawn units must be flagged in HEALTHY"
 
         cp = client.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy")
         cp["status"]["state"] = "ready"

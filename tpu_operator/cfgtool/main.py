@@ -392,8 +392,8 @@ def _status(client, namespace, out) -> int:
               f"pools={pools}", file=out)
 
     # TPU nodes only — presence is the row filter, so no column for it
-    print("\nNODE            CAPACITY  UPGRADE-STATE    SLICE-PARTITION",
-          file=out)
+    print("\nNODE            CAPACITY  HEALTHY  UPGRADE-STATE    "
+          "SLICE-PARTITION", file=out)
     for node in client.list("v1", "Node"):
         labels = node.get("metadata", {}).get("labels", {}) or {}
         if labels.get(consts.TPU_PRESENT_LABEL) != "true":
@@ -401,6 +401,16 @@ def _status(client, namespace, out) -> int:
         name = node["metadata"]["name"]
         capacity = deep_get(node, "status", "capacity",
                             consts.TPU_RESOURCE_NAME) or "0"
+        # the kubelet subtracts Unhealthy device-plugin units from
+        # allocatable: allocatable < capacity IS the cluster-visible
+        # per-chip health signal (reference: per-GPU health consumed via
+        # node capacity, validator/main.go:1240-1299)
+        allocatable = deep_get(node, "status", "allocatable",
+                               consts.TPU_RESOURCE_NAME)
+        if allocatable is None or str(allocatable) == str(capacity):
+            healthy = str(capacity)
+        else:
+            healthy = f"{allocatable}!"  # units withdrawn by the health gate
         upgrade = labels.get(consts.UPGRADE_STATE_LABEL, "-")
         slice_cfg = labels.get(consts.TPU_SLICE_CONFIG_LABEL)
         slice_state = labels.get(consts.TPU_SLICE_STATE_LABEL)
@@ -411,8 +421,8 @@ def _status(client, namespace, out) -> int:
             partition = f"{slice_cfg or '<none>'}={slice_state or '?'}"
         else:
             partition = "-"
-        print(f"{name:<15} {capacity:<9} {upgrade:<16} {partition}",
-              file=out)
+        print(f"{name:<15} {capacity:<9} {healthy:<8} {upgrade:<16} "
+              f"{partition}", file=out)
 
     print("\nDAEMONSET                 DESIRED  AVAILABLE  UPDATED", file=out)
     for ds in client.list("apps/v1", "DaemonSet", namespace):
