@@ -451,3 +451,44 @@ def test_partial_pass_does_not_clear_gated_units(plugin):
                               "local_chips": [0, 1, 2, 3]})
     p.refresh_units()
     assert all(u.health == "Healthy" for u in p._snapshot())
+
+
+def test_health_churn_soak(plugin):
+    """Rapid barrier churn (fail chip i -> full pass -> fail ...) against
+    the RUNNING health loop must neither wedge the stream nor strand a
+    stale verdict: after the churn settles on a final state, the
+    inventory converges to it."""
+    from tpu_operator.validator.status import StatusFiles
+
+    p, stub, tmp_path = plugin
+    status = StatusFiles(str(tmp_path / "validations"))
+    stream = stub.ListAndWatch(pb.Empty())
+    next(stream)  # initial snapshot
+    for i in range(30):
+        if i % 2:
+            status.write("workload", {"passed": True, "n_devices": 4,
+                                      "local_chips": [0, 1, 2, 3],
+                                      "failed_local_chips": []})
+        else:
+            chip = i % 4
+            status.write("workload", {
+                "passed": False, "n_devices": 4,
+                "local_chips": [0, 1, 2, 3],
+                "failed_local_chips": [chip],
+                "details": {"ring": {"passed": False,
+                                     "failed_chips": [chip]}}})
+        if i % 7 == 0:
+            p.refresh_units()  # interleave explicit refreshes with the loop
+    # settle on: chip 1 failed
+    status.write("workload", {
+        "passed": False, "n_devices": 4, "local_chips": [0, 1, 2, 3],
+        "failed_local_chips": [1],
+        "details": {"ring": {"passed": False, "failed_chips": [1]}}})
+    deadline = time.monotonic() + 5
+    want = {"tpu-0": "Healthy", "tpu-1": "Unhealthy",
+            "tpu-2": "Healthy", "tpu-3": "Healthy"}
+    while time.monotonic() < deadline:
+        if {u.id: u.health for u in p._snapshot()} == want:
+            break
+        time.sleep(0.05)
+    assert {u.id: u.health for u in p._snapshot()} == want
