@@ -462,15 +462,17 @@ def test_health_churn_soak(plugin):
 
     p, stub, tmp_path = plugin
     status = StatusFiles(str(tmp_path / "validations"))
-    stream = stub.ListAndWatch(pb.Empty())
+    # deadline on the stream: if a regression stops watcher pushes, the
+    # drain below must fail loudly instead of hanging pytest
+    stream = stub.ListAndWatch(pb.Empty(), timeout=30)
     next(stream)  # initial snapshot
-    for i in range(30):
+    for i in range(32):
         if i % 2:
             status.write("workload", {"passed": True, "n_devices": 4,
                                       "local_chips": [0, 1, 2, 3],
                                       "failed_local_chips": []})
         else:
-            chip = i % 4
+            chip = (i // 2) % 4  # cycle EVERY chip through gate-and-clear
             status.write("workload", {
                 "passed": False, "n_devices": 4,
                 "local_chips": [0, 1, 2, 3],
@@ -479,7 +481,8 @@ def test_health_churn_soak(plugin):
                                      "failed_chips": [chip]}}})
         if i % 7 == 0:
             p.refresh_units()  # interleave explicit refreshes with the loop
-    # settle on: chip 1 failed
+    # settle on: chip 1 failed (a chip the churn gated AND cleared earlier —
+    # exercises re-gating after carry-forward)
     status.write("workload", {
         "passed": False, "n_devices": 4, "local_chips": [0, 1, 2, 3],
         "failed_local_chips": [1],
@@ -492,3 +495,13 @@ def test_health_churn_soak(plugin):
             break
         time.sleep(0.05)
     assert {u.id: u.health for u in p._snapshot()} == want
+    # the kubelet-facing stream must have delivered the same final state —
+    # a wedged watcher queue with a live snapshot is still a failure
+    last = None
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        update = next(stream)
+        last = _health_by_id(update)
+        if last == want:
+            break
+    assert last == want, f"stream never delivered the settled state: {last}"

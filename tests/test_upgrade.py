@@ -1068,3 +1068,36 @@ def test_template_fingerprint_tracks_whole_template():
     ds["spec"]["template"]["spec"]["containers"][0]["env"] = [
         {"name": "LIBTPU_INIT_ARGS", "value": "--xla_tpu_foo=1"}]
     assert UpgradeStateMachine._template_fingerprint(ds) != base
+
+
+def test_pool_scoped_template_change_upgrades_only_that_pool(fake_client):
+    """Per-pool (TPUDriver) driver DSes select disjoint node pools; a
+    template change in pool A's DS flips ONLY pool A's nodes to
+    upgrade-required — _driver_ds_for matches by nodeSelector and the
+    template-hash signal is per-DS."""
+    for pool in ("a", "b"):
+        node = mk_node(f"tpu-{pool}")
+        node["metadata"]["labels"]["pool"] = pool
+        fake_client.create(node)
+        ds = mk_driver_ds("img:1")
+        ds["metadata"]["name"] = f"libtpu-driver-{pool}"
+        ds["spec"]["template"]["spec"]["nodeSelector"] = {"pool": pool}
+        ds["spec"]["template"]["metadata"]["labels"][
+            consts.TEMPLATE_HASH_LABEL] = f"hash-{pool}-current"
+        fake_client.create(ds)
+        fake_client.create(mk_pod(f"val-{pool}", f"tpu-{pool}",
+                                  "tpu-operator-validator", "v:1"))
+    # pool A's pod predates its template; pool B's is current
+    stale = mk_pod("drv-a", "tpu-a", "tpu-driver", "img:1")
+    stale["metadata"]["labels"][consts.TEMPLATE_HASH_LABEL] = "hash-a-old"
+    fake_client.create(stale)
+    current = mk_pod("drv-b", "tpu-b", "tpu-driver", "img:1")
+    current["metadata"]["labels"][consts.TEMPLATE_HASH_LABEL] = \
+        "hash-b-current"
+    fake_client.create(current)
+
+    machine(fake_client).process(fresh_nodes(fake_client))
+    assert node_upgrade_state(fake_client.get("v1", "Node", "tpu-a")) \
+        == m.UPGRADE_REQUIRED
+    assert node_upgrade_state(fake_client.get("v1", "Node", "tpu-b")) \
+        == m.UNKNOWN
