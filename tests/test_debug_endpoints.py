@@ -1,0 +1,260 @@
+"""/debug/* introspection + /readyz gating on the manager health server,
+driven over genuine HTTP against the in-process MiniApiServer, ending with
+the full acceptance path: one TPUDriver reconcile -> one retrievable trace
+whose ID cross-references the emitted Kubernetes Event."""
+
+import socket
+import threading
+import time
+import types
+
+import requests as rq
+
+from tpu_operator import consts, tracing
+from tpu_operator.api.clusterpolicy import new_cluster_policy
+from tpu_operator.api.tpudriver import new_tpu_driver
+from tpu_operator.client.cache import CachedClient
+from tpu_operator.client.rest import RestClient
+from tpu_operator.controllers.manager import OperatorApp
+from tpu_operator.testing import MiniApiServer
+from tpu_operator.testing.kubelet import KubeletSimulator
+
+OPERAND_IMAGE_ENVS = ("DRIVER_IMAGE", "VALIDATOR_IMAGE",
+                      "FEATURE_DISCOVERY_IMAGE", "TELEMETRY_EXPORTER_IMAGE",
+                      "SLICE_PARTITIONER_IMAGE", "DEVICE_PLUGIN_IMAGE")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _sample(metrics, metric, **labels):
+    value = metrics.registry.get_sample_value(metric, labels or None)
+    return 0.0 if value is None else value
+
+
+def mk_node(name, topology="2x4"):
+    return {"apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": name, "labels": {
+                consts.GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice",
+                consts.GKE_TPU_TOPOLOGY_LABEL: topology,
+                consts.deploy_label("driver"): "true",
+            }}, "status": {}}
+
+
+# -- /readyz ------------------------------------------------------------------
+
+def test_readyz_gates_on_controllers_and_leadership(monkeypatch):
+    """503 until the replica can actually serve: controllers started (or
+    leadership acquired when election is on) AND watch caches synced."""
+    for env in OPERAND_IMAGE_ENVS:
+        monkeypatch.setenv(env, "gcr.io/tpu/x:0.1.0")
+    srv = MiniApiServer()
+    base = srv.start()
+    hport = _free_port()
+    app = OperatorApp(RestClient(base_url=base), health_port=hport)
+    app.start_servers()  # probes answer from process start...
+    url = f"http://127.0.0.1:{hport}/readyz"
+    try:
+        resp = rq.get(url, timeout=5)
+        assert resp.status_code == 503  # ...but unready until reconciling
+        assert resp.json()["status"] == "unready"
+
+        app.start_controllers()
+        resp = rq.get(url, timeout=5)
+        assert resp.status_code == 200 and resp.json()["status"] == "ok"
+
+        # leader election wired: a STANDBY must report 503 even with its
+        # controllers capable of starting — routing to it serves nothing
+        app.elector = types.SimpleNamespace(is_leader=threading.Event(),
+                                            identity="replica-b")
+        resp = rq.get(url, timeout=5)
+        assert resp.status_code == 503
+        assert resp.json()["leader"]["is_leader"] is False
+        app.elector.is_leader.set()  # leadership acquired
+        resp = rq.get(url, timeout=5)
+        assert resp.status_code == 200
+        assert resp.json()["leader"]["identity"] == "replica-b"
+    finally:
+        app.stop()
+        srv.stop()
+
+
+def test_readyz_gates_on_watch_cache_sync(monkeypatch):
+    """An unsynced informer holds readiness at 503; a DEGRADED one (sync
+    timed out, reads fall back to direct) counts as serving."""
+    for env in OPERAND_IMAGE_ENVS:
+        monkeypatch.setenv(env, "gcr.io/tpu/x:0.1.0")
+    srv = MiniApiServer()
+    base = srv.start()
+    hport = _free_port()
+    app = OperatorApp(RestClient(base_url=base), health_port=hport)
+    app.start_servers()
+    app.start_controllers()
+    url = f"http://127.0.0.1:{hport}/readyz"
+
+    class _StatsStub:
+        def __init__(self, inner, rows):
+            self._inner = inner
+            self._rows = rows
+
+        def stats(self):
+            return self._rows
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+    try:
+        assert rq.get(url, timeout=5).status_code == 200
+        app.client = _StatsStub(app.client, [
+            {"apiVersion": "v1", "kind": "Node",
+             "synced": False, "degraded": False}])
+        resp = rq.get(url, timeout=5)
+        assert resp.status_code == 503
+        assert resp.json()["unsynced_informers"] == ["v1/Node"]
+        app.client._rows[0]["degraded"] = True  # slow, not wrong
+        assert rq.get(url, timeout=5).status_code == 200
+    finally:
+        app.stop()
+        srv.stop()
+
+
+# -- /debug/* -----------------------------------------------------------------
+
+def test_debug_endpoints_can_be_disabled(monkeypatch):
+    for env in OPERAND_IMAGE_ENVS:
+        monkeypatch.setenv(env, "gcr.io/tpu/x:0.1.0")
+    srv = MiniApiServer()
+    base = srv.start()
+    hport = _free_port()
+    app = OperatorApp(RestClient(base_url=base), health_port=hport,
+                      debug_endpoints=False)
+    app.start_servers()
+    try:
+        for path in ("/debug/traces", "/debug/queue", "/debug/state",
+                     "/debug/informers", "/debug/threads"):
+            assert rq.get(f"http://127.0.0.1:{hport}{path}",
+                          timeout=5).status_code == 404
+        # probes are NOT debug surface: still served
+        assert rq.get(f"http://127.0.0.1:{hport}/healthz",
+                      timeout=5).status_code == 200
+    finally:
+        app.stop()
+        srv.stop()
+
+
+def test_debug_queue_and_state_shapes(monkeypatch):
+    for env in OPERAND_IMAGE_ENVS:
+        monkeypatch.setenv(env, "gcr.io/tpu/x:0.1.0")
+    srv = MiniApiServer()
+    base = srv.start()
+    seed = RestClient(base_url=base)
+    seed.create(new_cluster_policy())
+    hport = _free_port()
+    app = OperatorApp(RestClient(base_url=base), health_port=hport)
+    app.start()
+    try:
+        queues = rq.get(f"http://127.0.0.1:{hport}/debug/queue",
+                        timeout=5).json()
+        assert {q["controller"] for q in queues} == {
+            "clusterpolicy", "tpudriver", "upgrade"}
+        for q in queues:
+            assert {"depth_ready", "delayed", "pending", "backoff",
+                    "inflight", "worker_alive"} <= set(q)
+        state = rq.get(f"http://127.0.0.1:{hport}/debug/state",
+                       timeout=5).json()
+        assert {"ready", "readiness", "informers", "controllers",
+                "flight_recorder"} <= set(state)
+        assert state["flight_recorder"]["capacity"] == tracing.DEFAULT_BUFFER_SIZE
+    finally:
+        app.stop()
+        srv.stop()
+
+
+# -- acceptance: one reconcile, one trace, three cross-referenced planes ------
+
+def test_tpudriver_reconcile_produces_cross_referenced_trace(monkeypatch):
+    """A single TPUDriver reconcile through the fake cluster yields one
+    retrievable trace at /debug/traces with the root reconcile span, render
+    + apply child spans, and client API-call spans — and the trace ID rides
+    the emitted Ready Event, so Event -> /debug/traces navigation works."""
+    for env in OPERAND_IMAGE_ENVS:
+        monkeypatch.setenv(env, "gcr.io/tpu/x:0.1.0")
+    srv = MiniApiServer()
+    base = srv.start()
+    seed = RestClient(base_url=base)
+    seed.create(new_cluster_policy())
+    seed.create(mk_node("tpu-node-0"))
+    seed.create(new_tpu_driver("pool-a", {
+        "image": "libtpu", "repository": "gcr.io/tpu", "version": "1.0",
+        "nodeSelector": {consts.GKE_TPU_ACCELERATOR_LABEL:
+                         "tpu-v5-lite-podslice"}}))
+    kubelet = KubeletSimulator(RestClient(base_url=base), interval=0.05).start()
+    cached = CachedClient(RestClient(base_url=base))
+    hport = _free_port()
+    app = OperatorApp(cached, health_port=hport)
+    app.start()
+    debug = f"http://127.0.0.1:{hport}"
+    try:
+        # wait for the Ready Event the NotReady->Ready transition emits
+        deadline = time.monotonic() + 30
+        ready_events = []
+        while time.monotonic() < deadline:
+            ready_events = [
+                e for e in seed.list("v1", "Event", "tpu-operator")
+                if e["reason"] == "Ready"
+                and e["involvedObject"]["kind"] == "TPUDriver"]
+            if ready_events:
+                break
+            time.sleep(0.1)
+        assert ready_events, "TPUDriver never went Ready"
+        trace_id = ready_events[0]["metadata"]["annotations"][
+            tracing.TRACE_ID_ANNOTATION]
+
+        # the Event's trace ID retrieves exactly that reconcile's trace
+        body = rq.get(f"{debug}/debug/traces?trace={trace_id}",
+                      timeout=5).json()
+        assert body["count"] == 1
+        root = body["traces"][0]
+        assert root["name"] == "reconcile" and root["kind"] == "reconcile"
+        assert root["attributes"]["controller"] == "tpudriver"
+        assert root["attributes"]["request"] == "pool-a"
+
+        def spans(node):
+            yield node
+            for child in node["children"]:
+                yield from spans(child)
+
+        kinds = {}
+        for sp in spans(root):
+            kinds.setdefault(sp["kind"], []).append(sp)
+        phases = {sp["attributes"]["phase"] for sp in kinds["phase"]}
+        assert {"render", "apply", "status-update"} <= phases
+        assert kinds["api"], "no client API-call spans in the trace"
+        assert all(sp["duration_s"] is not None for sp in spans(root))
+
+        # filters: the trace is found by controller, absent under errors=true
+        by_ctl = rq.get(f"{debug}/debug/traces?controller=tpudriver",
+                        timeout=5).json()
+        assert any(t["trace_id"] == trace_id for t in by_ctl["traces"])
+        errs = rq.get(f"{debug}/debug/traces?controller=tpudriver&error=true",
+                      timeout=5).json()
+        assert all(t["trace_id"] != trace_id for t in errs["traces"])
+
+        # every phase observed into the latency histogram
+        for phase in ("render", "apply", "status-update"):
+            assert _sample(app.metrics,
+                           "tpu_operator_reconcile_phase_seconds_count",
+                           controller="tpudriver", phase=phase) >= 1.0
+
+        # with caches synced + controllers running the replica is ready
+        assert rq.get(f"{debug}/readyz", timeout=5).status_code == 200
+    finally:
+        app.stop()
+        cached.stop()
+        kubelet.stop()
+        srv.stop()

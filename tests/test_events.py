@@ -69,6 +69,34 @@ def test_long_object_name_keeps_unique_suffix(fake_client):
     assert len(fake_client.list("v1", "Event", "tpu-operator")) == 2
 
 
+def test_identical_events_aggregate_count(fake_client):
+    """client-go EventAggregator behavior: the same (involved object,
+    reason, message, type) bumps count + lastTimestamp on the existing
+    Event instead of minting a new object per emission."""
+    node = fake_client.create({"apiVersion": "v1", "kind": "Node",
+                               "metadata": {"name": "n1"}, "status": {}})
+    first = events.record(fake_client, "tpu-operator", node,
+                          events.WARNING, "DriverUpgradeFailed", "pod stuck")
+    for _ in range(3):
+        bumped = events.record(fake_client, "tpu-operator", node,
+                               events.WARNING, "DriverUpgradeFailed", "pod stuck")
+        assert bumped["metadata"]["name"] == first["metadata"]["name"]
+    stored = fake_client.list("v1", "Event", "tpu-operator")
+    assert len(stored) == 1
+    assert stored[0]["count"] == 4
+    assert stored[0]["firstTimestamp"] <= stored[0]["lastTimestamp"]
+    # any field differing breaks the aggregation key -> a distinct Event
+    events.record(fake_client, "tpu-operator", node,
+                  events.WARNING, "DriverUpgradeFailed", "different message")
+    events.record(fake_client, "tpu-operator", node,
+                  events.NORMAL, "DriverUpgradeFailed", "pod stuck")
+    other = fake_client.create({"apiVersion": "v1", "kind": "Node",
+                                "metadata": {"name": "n2"}, "status": {}})
+    events.record(fake_client, "tpu-operator", other,
+                  events.WARNING, "DriverUpgradeFailed", "pod stuck")
+    assert len(fake_client.list("v1", "Event", "tpu-operator")) == 4
+
+
 def test_record_never_raises(fake_client):
     """Best-effort contract: any failure (ApiError or transport) is swallowed."""
     class ExplodingClient:

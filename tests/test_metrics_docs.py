@@ -1,0 +1,55 @@
+"""CI guard: every metric family either registry can emit must be
+documented in docs/operations.md. An undocumented family is a metric an
+operator cannot act on — adding one without a docs row fails here, not in
+a support case."""
+
+import os
+
+from tpu_operator.controllers.metrics import OperatorMetrics
+from tpu_operator.validator.metrics import NodeMetrics
+
+DOCS_PATH = os.path.join(os.path.dirname(__file__), "..", "docs",
+                         "operations.md")
+
+
+def _family_names(registry):
+    names = set()
+    for family in registry.collect():
+        name = family.name
+        if family.type == "counter":
+            # prometheus_client strips the _total suffix in collect();
+            # the docs (and PromQL users) see the exposition name
+            name += "_total"
+        names.add(name)
+    return names
+
+
+def _docs_text():
+    with open(DOCS_PATH) as f:
+        return f.read()
+
+
+def test_every_operator_metric_family_is_documented():
+    docs = _docs_text()
+    missing = sorted(n for n in _family_names(OperatorMetrics().registry)
+                     if n not in docs)
+    assert not missing, (
+        f"metric families missing from docs/operations.md: {missing} — "
+        "add a row to the Metrics reference table")
+
+
+def test_every_node_metric_family_is_documented():
+    docs = _docs_text()
+    missing = sorted(n for n in _family_names(NodeMetrics().registry)
+                     if n not in docs)
+    assert not missing, (
+        f"node metric families missing from docs/operations.md: {missing} — "
+        "add a row to the Metrics reference table")
+
+
+def test_families_do_not_collide_across_registries():
+    """The operator and node exporters are scraped into one Prometheus;
+    a family registered in both with different label sets would make the
+    docs table (and queries) ambiguous."""
+    assert not (_family_names(OperatorMetrics().registry)
+                & _family_names(NodeMetrics().registry))

@@ -1,0 +1,225 @@
+"""Reconcile tracing + flight recorder (tpu_operator/tracing.py): span
+trees over contextvars, no-op outside a trace, trace-per-attempt across
+requeue/backoff, error-pinning ring eviction, the phase-latency histogram,
+and the Event/log cross-references that tie the three planes together."""
+
+import logging
+import time
+
+import pytest
+
+from tpu_operator import events, tracing
+from tpu_operator.client.fake import FakeClient
+from tpu_operator.controllers.metrics import OperatorMetrics
+from tpu_operator.controllers.runtime import Controller, Reconciler, Request, Result
+
+
+def _sample(metrics, metric, **labels):
+    value = metrics.registry.get_sample_value(metric, labels or None)
+    return 0.0 if value is None else value
+
+
+# -- span mechanics -----------------------------------------------------------
+
+def test_span_is_noop_outside_trace():
+    """Library code (clients, state manager) calls span() unconditionally;
+    without an active trace that must cost nothing and record nothing."""
+    assert tracing.current_trace_id() is None
+    with tracing.span("orphan") as sp:
+        assert sp is tracing.NOOP_SPAN
+        sp.set_attribute("k", "v")  # all recording calls are no-ops
+        sp.mark_error("ignored")
+    with tracing.api_span("GET", "/api/v1/nodes") as sp:
+        assert sp is tracing.NOOP_SPAN
+    assert tracing.current_trace_id() is None
+
+
+def test_trace_builds_span_tree_via_contextvars():
+    tracer = tracing.Tracer(tracing.FlightRecorder(8))
+    with tracer.trace("reconcile", controller="c", request="ns/obj") as root:
+        assert tracing.current_trace_id() == root.trace_id
+        with tracing.phase_span("render", pool="p1") as render:
+            assert render.parent_id == root.span_id
+            with tracing.api_span("POST", "/apis/apps/v1/daemonsets") as api:
+                assert api.parent_id == render.span_id
+                assert api.trace_id == root.trace_id
+        # contextvar restored after each child closes
+        assert tracing.current_span() is root
+    assert root.duration_s is not None and root.status == "ok"
+    assert [s.name for s in root.walk()] == ["reconcile", "render", "api.post"]
+    [recorded] = tracer.recorder.traces()
+    assert recorded is root
+    # the wire shape /debug/traces serves: nested children, ids, attributes
+    d = root.to_dict()
+    assert d["attributes"]["controller"] == "c"
+    assert d["children"][0]["kind"] == "phase"
+    assert d["children"][0]["children"][0]["attributes"]["verb"] == "POST"
+
+
+def test_exception_marks_trace_failed_and_reraises():
+    tracer = tracing.Tracer(tracing.FlightRecorder(8))
+    with pytest.raises(RuntimeError):
+        with tracer.trace("reconcile", controller="c", request="bad"):
+            with pytest.raises(ValueError):
+                with tracing.span("inner"):
+                    raise ValueError("inner fails first")
+            raise RuntimeError("then the reconcile body")
+    [root] = tracer.recorder.traces()
+    assert root.status == "error" and "RuntimeError" in root.error
+    inner = root.children[0]
+    assert inner.status == "error" and "ValueError" in inner.error
+    assert root.has_error
+    assert tracer.recorder.traces(errors_only=True) == [root]
+
+
+def test_child_error_pins_parent_as_error_trace():
+    """has_error is recursive: a trace whose reconcile 'succeeded' but
+    whose status write failed still counts as an error trace."""
+    tracer = tracing.Tracer(tracing.FlightRecorder(8))
+    with tracer.trace("reconcile", controller="c") as root:
+        with tracing.phase_span("status-update") as sp:
+            sp.mark_error("409 conflict")
+    assert root.status == "ok" and root.has_error
+    assert tracer.recorder.traces(errors_only=True) == [root]
+
+
+# -- flight recorder ----------------------------------------------------------
+
+def test_ring_eviction_keeps_pinned_error_traces():
+    """A burst of healthy reconciles must not evict the one failed trace a
+    support case needs: the error ring pins it past main-ring eviction."""
+    recorder = tracing.FlightRecorder(size=4, error_size=2)
+    tracer = tracing.Tracer(recorder)
+    with pytest.raises(RuntimeError):
+        with tracer.trace("reconcile", controller="c", request="bad"):
+            raise RuntimeError("boom")
+    error_id = recorder.traces(errors_only=True)[0].trace_id
+
+    for i in range(10):  # healthy storm: 2.5x the main ring capacity
+        with tracer.trace("reconcile", controller="c", request=f"ok-{i}"):
+            pass
+
+    ids = [r.trace_id for r in recorder.traces(limit=None)]
+    assert error_id in ids, "error trace evicted by healthy reconciles"
+    assert recorder.traces(errors_only=True)[0].trace_id == error_id
+    assert recorder.traces(trace_id=error_id)[0].trace_id == error_id
+    # both rings stay bounded
+    stats = recorder.stats()
+    assert stats["buffered"] <= 4 and stats["buffered_errors"] <= 2
+    assert stats["recorded_total"] == 11 and stats["error_total"] == 1
+    # newest-first ordering and the controller/limit filters
+    newest = recorder.traces(controller="c", limit=1)[0]
+    assert newest.attributes["request"] == "ok-9"
+    assert recorder.traces(controller="absent") == []
+
+
+def test_phase_spans_feed_latency_histogram():
+    metrics = OperatorMetrics()
+    tracer = tracing.Tracer(tracing.FlightRecorder(8), metrics)
+    with tracer.trace("reconcile", controller="ctl"):
+        with tracing.phase_span("render"):
+            pass
+        with tracing.phase_span("render"):  # two pools, same phase
+            pass
+        with tracing.phase_span("apply"):
+            pass
+        with tracing.span("api.get", kind="api"):  # api spans are NOT phases
+            pass
+    assert _sample(metrics, "tpu_operator_reconcile_phase_seconds_count",
+                   controller="ctl", phase="render") == 2.0
+    assert _sample(metrics, "tpu_operator_reconcile_phase_seconds_count",
+                   controller="ctl", phase="apply") == 1.0
+    assert _sample(metrics, "tpu_operator_reconcile_phase_seconds_count",
+                   controller="ctl", phase="api.get") == 0.0
+
+
+# -- runtime integration: requeue/backoff propagation -------------------------
+
+class _FailOnce(Reconciler):
+    name = "flaky"
+
+    def __init__(self):
+        self.calls = 0
+
+    def reconcile(self, request: Request) -> Result:
+        self.calls += 1
+        if self.calls == 1:
+            raise RuntimeError("transient")
+        return Result()
+
+
+def test_requeue_mints_new_trace_with_attempt_counter():
+    """The same Request surviving a requeue/backoff cycle gets a FRESH
+    trace per attempt, tied together by request + an incrementing attempt
+    counter (a reused trace id would make /debug/traces show one
+    ever-growing mega-trace per stuck object)."""
+    metrics = OperatorMetrics()
+    recorder = tracing.FlightRecorder(16)
+    tracer = tracing.Tracer(recorder, metrics)
+    controller = Controller(_FailOnce())
+    controller.instrument(metrics, tracer)
+    controller.start(FakeClient())
+    try:
+        controller.queue.add(Request(name="obj"))
+        deadline = time.monotonic() + 10
+        while (len(recorder.traces(controller="flaky")) < 2
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+    finally:
+        controller.stop()
+    roots = recorder.traces(controller="flaky")  # newest first
+    assert len(roots) == 2
+    retry, first = roots[0], roots[1]
+    assert first.trace_id != retry.trace_id
+    assert first.attributes["request"] == retry.attributes["request"] == "obj"
+    assert first.attributes["attempt"] == 1 and first.has_error
+    assert retry.attributes["attempt"] == 2 and not retry.has_error
+    # backoff state rides the root span: the retry knows it is a retry
+    assert first.attributes["backoff_failures"] == 0
+    assert retry.attributes["backoff_failures"] == 1
+    # full add->get latency is a trace attribute (the workqueue histogram
+    # deliberately excludes requeue delay; the trace carries both numbers)
+    assert retry.attributes["since_add_s"] >= retry.attributes["queue_wait_s"]
+    # the failed attempt is pinned in the error ring too
+    assert recorder.traces(controller="flaky", errors_only=True) == [first]
+
+
+# -- cross-plane references ---------------------------------------------------
+
+def test_event_carries_trace_id_annotation(fake_client):
+    node = fake_client.create({"apiVersion": "v1", "kind": "Node",
+                               "metadata": {"name": "n1"}, "status": {}})
+    tracer = tracing.Tracer(tracing.FlightRecorder(8))
+    with tracer.trace("reconcile", controller="c") as root:
+        event = events.record(fake_client, "tpu-operator", node,
+                              events.WARNING, "Probe", "failed")
+    assert (event["metadata"]["annotations"][tracing.TRACE_ID_ANNOTATION]
+            == root.trace_id)
+    # outside a trace no annotation is stamped
+    quiet = events.record(fake_client, "tpu-operator", node,
+                          events.WARNING, "Probe", "different message")
+    assert tracing.TRACE_ID_ANNOTATION not in quiet["metadata"].get(
+        "annotations", {})
+
+
+def test_log_records_carry_trace_id():
+    tracing.install_log_correlation()
+    tracing.install_log_correlation()  # idempotent
+    captured = []
+
+    class _Capture(logging.Handler):
+        def emit(self, record):
+            captured.append(record)
+
+    logger = logging.getLogger("test_tracing.correlation")
+    handler = _Capture()
+    logger.addHandler(handler)
+    try:
+        tracer = tracing.Tracer(tracing.FlightRecorder(8))
+        with tracer.trace("reconcile", controller="c") as root:
+            logger.warning("inside")
+        logger.warning("outside")
+    finally:
+        logger.removeHandler(handler)
+    assert captured[0].trace_id == root.trace_id
+    assert captured[1].trace_id == "-"

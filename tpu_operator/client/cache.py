@@ -31,6 +31,7 @@ import logging
 import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
+from .. import tracing
 from .errors import NotFoundError
 from .fake import match_field_selector, match_label_selector
 from .interface import Client, WatchEvent, WatchHandle
@@ -331,9 +332,16 @@ class CachedClient(Client):
         scope = self._scope(api_version, kind, namespace, for_name=True)
         informer = self._informer_for(api_version, kind, scope)
         if not informer.synced.is_set():
-            return self.inner.get(api_version, kind, name, namespace)
-        obj = informer.get(name, scope or "")
+            # inner RestClient records its own wire span; tag the fallback
+            with tracing.api_span("GET", f"{kind}/{name}", source="direct"):
+                return self.inner.get(api_version, kind, name, namespace)
+        with tracing.api_span("GET", f"{kind}/{name}", source="cache") as sp:
+            obj = informer.get(name, scope or "")
+            sp.set_attribute("code", 404 if obj is None else 200)
         if obj is None:
+            # raised OUTSIDE the span so a cache miss reads code=404 but not
+            # status=error — like the wire client, absence is an answer, not
+            # a failure that should pin the trace into the error ring
             raise NotFoundError(f"{kind} {namespace or ''}/{name} not found (cache)")
         return obj
 
@@ -342,11 +350,15 @@ class CachedClient(Client):
         scope = self._scope(api_version, kind, namespace, for_name=False)
         informer = self._informer_for(api_version, kind, scope)
         if not informer.synced.is_set():
-            return self.inner.list(api_version, kind, namespace,
-                                   label_selector, field_selector)
+            with tracing.api_span("LIST", kind, source="direct"):
+                return self.inner.list(api_version, kind, namespace,
+                                       label_selector, field_selector)
         # a scoped read served from the all-namespaces superset still filters
         want_ns = namespace if self.scheme.is_namespaced(api_version, kind) else None
-        return informer.list(want_ns, label_selector, field_selector)
+        with tracing.api_span("LIST", kind, source="cache") as sp:
+            out = informer.list(want_ns, label_selector, field_selector)
+            sp.set_attributes(code=200, items=len(out))
+            return out
 
     # -- writes (pass through + write-through) -------------------------------
     def create(self, obj: dict) -> dict:

@@ -16,6 +16,7 @@ from typing import Callable, List, Optional
 
 import requests
 
+from .. import tracing
 from .errors import (
     AlreadyExistsError,
     ApiError,
@@ -104,6 +105,27 @@ class RestClient(Client):
             except Exception:  # telemetry must never break the request path
                 pass
 
+    def _request(self, method: str, url: str, **kwargs) -> requests.Response:
+        """One traced apiserver round trip: inside an active reconcile trace
+        this records an api span (verb, path, status code); outside one the
+        span is a free no-op. Error statuses raise the typed ApiError AND
+        mark the span failed, so a trace shows exactly which write 409'd —
+        except 404, which stays status=ok (code=404 is still recorded):
+        absence is an answer, and ensure-exists probes (GET before create)
+        would otherwise pin every first reconcile into the error ring."""
+        path = url[len(self.base_url):] if url.startswith(self.base_url) else url
+        not_found = None
+        with tracing.api_span(method, path) as sp:
+            resp = self._session.request(method, url, **kwargs)
+            sp.set_attribute("code", resp.status_code)
+            try:
+                self._raise_for(resp)
+            except NotFoundError as e:
+                not_found = e
+        if not_found is not None:
+            raise not_found
+        return resp
+
     def _raise_for(self, resp: requests.Response) -> None:
         self._notify_response(resp.request.method or "?", resp.status_code)
         if resp.status_code < 400:
@@ -126,16 +148,14 @@ class RestClient(Client):
 
     # -- CRUD ----------------------------------------------------------------
     def get(self, api_version, kind, name, namespace=None) -> dict:
-        resp = self._session.get(self.resource_url(api_version, kind, namespace, name))
-        self._raise_for(resp)
+        resp = self._request("GET", self.resource_url(api_version, kind, namespace, name))
         return resp.json()
 
     def _list_body(self, api_version, kind, namespace=None, params=None) -> dict:
         """LIST returning the full List envelope (watch resume needs its
         ``metadata.resourceVersion``; plain list() discards it)."""
-        resp = self._session.get(self.resource_url(api_version, kind, namespace),
-                                 params=params or {}, timeout=60)
-        self._raise_for(resp)
+        resp = self._request("GET", self.resource_url(api_version, kind, namespace),
+                             params=params or {}, timeout=60)
         body = resp.json()
         # list items omit apiVersion/kind; restore them
         for item in body.get("items", []):
@@ -153,45 +173,37 @@ class RestClient(Client):
 
     def create(self, obj: dict) -> dict:
         ns = obj.get("metadata", {}).get("namespace")
-        resp = self._session.post(self.resource_url(obj["apiVersion"], obj["kind"], ns), json=obj)
-        self._raise_for(resp)
+        resp = self._request("POST", self.resource_url(obj["apiVersion"], obj["kind"], ns),
+                             json=obj)
         return resp.json()
 
     def update(self, obj: dict) -> dict:
         meta = obj["metadata"]
         url = self.resource_url(obj["apiVersion"], obj["kind"], meta.get("namespace"), meta["name"])
-        resp = self._session.put(url, json=obj)
-        self._raise_for(resp)
-        return resp.json()
+        return self._request("PUT", url, json=obj).json()
 
     def patch(self, api_version, kind, name, patch, namespace=None) -> dict:
         url = self.resource_url(api_version, kind, namespace, name)
-        resp = self._session.patch(url, data=json.dumps(patch),
-                                   headers={"Content-Type": "application/merge-patch+json"})
-        self._raise_for(resp)
+        resp = self._request("PATCH", url, data=json.dumps(patch),
+                             headers={"Content-Type": "application/merge-patch+json"})
         return resp.json()
 
     def delete(self, api_version, kind, name, namespace=None) -> None:
-        resp = self._session.delete(self.resource_url(api_version, kind, namespace, name))
-        self._raise_for(resp)
+        self._request("DELETE", self.resource_url(api_version, kind, namespace, name))
 
     def evict(self, name: str, namespace: Optional[str] = None) -> None:
         url = self.resource_url("v1", "Pod", namespace, name, "eviction")
         body = {"apiVersion": "policy/v1", "kind": "Eviction",
                 "metadata": {"name": name, "namespace": namespace}}
-        resp = self._session.post(url, json=body)
-        self._raise_for(resp)
+        self._request("POST", url, json=body)
 
     def update_status(self, obj: dict) -> dict:
         meta = obj["metadata"]
         url = self.resource_url(obj["apiVersion"], obj["kind"], meta.get("namespace"), meta["name"], "status")
-        resp = self._session.put(url, json=obj)
-        self._raise_for(resp)
-        return resp.json()
+        return self._request("PUT", url, json=obj).json()
 
     def server_version(self) -> str:
-        resp = self._session.get(f"{self.base_url}/version")
-        self._raise_for(resp)
+        resp = self._request("GET", f"{self.base_url}/version")
         return resp.json().get("gitVersion", "unknown")
 
     # -- watch ---------------------------------------------------------------

@@ -239,7 +239,13 @@ class MustGather:
             return
         endpoints = ((self.operator_metrics_port, "/metrics", "metrics.prom"),
                      (self.operator_health_port, "/debug/threads", "threads.txt"),
-                     (self.operator_health_port, "/debug/informers", "informers.json"))
+                     (self.operator_health_port, "/debug/informers", "informers.json"),
+                     # the flight recorder + queue/state introspection: the
+                     # per-reconcile story (what did each attempt do, what is
+                     # each worker stuck on) that metrics alone can't carry
+                     (self.operator_health_port, "/debug/traces", "traces.json"),
+                     (self.operator_health_port, "/debug/queue", "queue.json"),
+                     (self.operator_health_port, "/debug/state", "state.json"))
         for name, ip in targets:
             sources = []
             for port, path, fname in endpoints:

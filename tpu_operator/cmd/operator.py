@@ -23,6 +23,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-cache-reads", dest="cache_reads", action="store_false",
                    help="serve reconcile reads directly from the apiserver "
                         "instead of informer caches (debugging escape hatch)")
+    p.add_argument("--trace-buffer-size", type=int, default=256,
+                   help="reconcile traces kept in the flight recorder behind "
+                        "/debug/traces (error traces pinned in a separate "
+                        "quarter-sized ring)")
+    p.add_argument("--debug-endpoints", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="serve /debug/{traces,queue,state,informers,threads} "
+                        "on the health port (--no-debug-endpoints disables)")
     p.add_argument("--version", action="version", version=f"tpu-operator {__version__}")
     return p
 

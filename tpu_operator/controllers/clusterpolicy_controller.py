@@ -15,7 +15,7 @@ import os
 import time
 from typing import List, Optional
 
-from .. import consts, events
+from .. import consts, events, tracing
 from ..api.clusterpolicy import ClusterPolicy, State
 from ..client.errors import ConflictError, NotFoundError
 from ..client.interface import Client, WatchEvent
@@ -83,12 +83,13 @@ class ClusterPolicyReconciler(Reconciler):
         return ClusterPolicy.from_obj(primary)
 
     def _write_status(self, obj: dict) -> None:
-        try:
-            self.client.update_status(obj)
-        except (ConflictError, NotFoundError):
-            # benign write race with a concurrent editor; the level-driven
-            # requeue re-reads and self-heals (reference relies on the same)
-            pass
+        with tracing.phase_span("status-update") as sp:
+            try:
+                self.client.update_status(obj)
+            except (ConflictError, NotFoundError) as e:
+                # benign write race with a concurrent editor; the level-driven
+                # requeue re-reads and self-heals (reference relies on the same)
+                sp.set_attribute("write_race", str(e))
 
     def _ensure_psa_labels(self, policy: ClusterPolicy) -> None:
         """spec.psa.enabled: label the operator namespace privileged for
@@ -166,7 +167,9 @@ class ClusterPolicyReconciler(Reconciler):
         self._ensure_psa_labels(policy)
 
         # node labeling sweep (state_manager.go:857 labelGPUNodes analog)
-        label_result = label_tpu_nodes(self.client, policy, self.namespace)
+        with tracing.phase_span("label-nodes") as sp:
+            label_result = label_tpu_nodes(self.client, policy, self.namespace)
+            sp.set_attribute("tpu_nodes", label_result.tpu_nodes)
         self.metrics.tpu_nodes_total.set(label_result.tpu_nodes)
 
         catalog = InfoCatalog()
@@ -175,7 +178,9 @@ class ClusterPolicyReconciler(Reconciler):
         catalog[INFO_CLUSTER_INFO] = self.cluster_info
         catalog[INFO_NODES] = label_result.nodes
 
-        results = self.state_manager.sync_state(catalog)
+        with tracing.phase_span("sync-state") as sp:
+            results = self.state_manager.sync_state(catalog)
+            sp.set_attribute("ready", results.ready)
         # after the (crash-prone) state sweep, right before the status
         # writes: an exception between the Warning Event and the condition
         # landing on the CR would re-emit the event every backoff retry

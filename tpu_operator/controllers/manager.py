@@ -8,9 +8,10 @@ import json
 import logging
 import signal
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from .. import __version__
+from .. import __version__, tracing
 from ..client.rest import RestClient
 from .clusterpolicy_controller import (
     ClusterPolicyReconciler,
@@ -23,8 +24,9 @@ log = logging.getLogger(__name__)
 
 
 def serve_health_and_metrics(metrics: OperatorMetrics, metrics_port: int,
-                             health_port: int, client=None):
+                             health_port: int, app: "OperatorApp" = None):
     servers = []
+    client = app.client if app is not None else None
 
     class MetricsHandler(BaseHTTPRequestHandler):
         def log_message(self, *a):
@@ -46,19 +48,65 @@ def serve_health_and_metrics(metrics: OperatorMetrics, metrics_port: int,
         def log_message(self, *a):
             pass
 
+        def _send_json(self, payload, code: int = 200) -> None:
+            body = json.dumps(payload, indent=1, default=str).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _send_text(self, body: str, code: int = 200) -> None:
+            raw = body.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "text/plain")
+            self.send_header("Content-Length", str(len(raw)))
+            self.end_headers()
+            self.wfile.write(raw)
+
+        def _debug_traces(self, query: dict) -> None:
+            recorder = app.recorder
+            controller = (query.get("controller") or [None])[0]
+            errors_only = (query.get("error") or ["false"])[0].lower() in (
+                "1", "true", "yes")
+            trace_id = (query.get("trace") or [None])[0]
+            try:
+                limit = int((query.get("limit") or ["50"])[0])
+            except ValueError:
+                limit = 50
+            roots = recorder.traces(controller=controller,
+                                    errors_only=errors_only,
+                                    trace_id=trace_id, limit=limit)
+            self._send_json({
+                "stats": recorder.stats(),
+                "count": len(roots),
+                "traces": [r.to_dict() for r in roots],
+            })
+
         def do_GET(self):
-            path = self.path.rstrip("/")
-            if path == "/debug/informers":
+            parsed = urllib.parse.urlparse(self.path)
+            path = parsed.path.rstrip("/")
+            query = urllib.parse.parse_qs(parsed.query)
+            debug_on = app is not None and app.debug_endpoints
+            if path == "/debug/informers" and debug_on:
                 # cache introspection: which kinds are cached, synced, sizes
                 stats = client.stats() if hasattr(client, "stats") else []
-                body = json.dumps(stats, indent=1).encode()
-                self.send_response(200)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                self._send_json(stats)
                 return
-            if path == "/debug/threads":
+            if path == "/debug/traces" and debug_on:
+                # the flight recorder: last-N reconcile traces, error traces
+                # pinned; ?controller=&error=true&trace=<id>&limit=
+                self._debug_traces(query)
+                return
+            if path == "/debug/queue" and debug_on:
+                # per-controller workqueue depth, in-flight request, backoff
+                self._send_json([c.debug_state()
+                                 for c in app.manager.controllers])
+                return
+            if path == "/debug/state" and debug_on:
+                self._send_json(app.debug_state())
+                return
+            if path == "/debug/threads" and debug_on:
                 # pprof-style goroutine-dump analog for the threaded runtime
                 import sys
                 import traceback
@@ -70,20 +118,24 @@ def serve_health_and_metrics(metrics: OperatorMetrics, metrics_port: int,
                     lines.append(f"--- {thread.name} (daemon={thread.daemon}) ---")
                     if frame is not None:
                         lines.extend(l.rstrip() for l in traceback.format_stack(frame))
-                body = "\n".join(lines).encode()
-                self.send_response(200)
-                self.send_header("Content-Type", "text/plain")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                self._send_text("\n".join(lines))
                 return
-            body = json.dumps({"status": "ok", "version": __version__}).encode()
-            code = 200 if path in ("/healthz", "/readyz") else 404
-            self.send_response(code)
-            self.send_header("Content-Length", str(len(body)))
+            if path == "/healthz":
+                self._send_json({"status": "ok", "version": __version__})
+                return
+            if path == "/readyz":
+                # NOT liveness: 503 until leader election (when enabled) is
+                # won AND every watch cache synced — a replica that routes
+                # traffic before it can serve its caches answers from nothing
+                if app is None:
+                    self._send_json({"status": "ok", "version": __version__})
+                    return
+                ready, detail = app.readiness()
+                self._send_json(detail, code=200 if ready else 503)
+                return
+            self.send_response(404)
+            self.send_header("Content-Length", "0")
             self.end_headers()
-            if code == 200:
-                self.wfile.write(body)
 
     for port, handler in ((metrics_port, MetricsHandler), (health_port, HealthHandler)):
         if not port:
@@ -97,9 +149,19 @@ def serve_health_and_metrics(metrics: OperatorMetrics, metrics_port: int,
 class OperatorApp:
     """The assembled operator: client + controllers + metrics/health servers."""
 
-    def __init__(self, client, namespace=None, metrics_port: int = 0, health_port: int = 0):
+    def __init__(self, client, namespace=None, metrics_port: int = 0, health_port: int = 0,
+                 trace_buffer_size: int = tracing.DEFAULT_BUFFER_SIZE,
+                 debug_endpoints: bool = True):
         self.client = client
         self.metrics = OperatorMetrics()
+        # reconcile tracing: every worker loop roots a trace here, completed
+        # traces land in the flight recorder behind /debug/traces
+        self.recorder = tracing.FlightRecorder(trace_buffer_size)
+        self.tracer = tracing.Tracer(self.recorder, self.metrics)
+        tracing.set_default_tracer(self.tracer)
+        self.debug_endpoints = debug_endpoints
+        self.elector = None  # set by run_operator under --leader-elect
+        self._controllers_started = threading.Event()
         self.manager = ControllerManager(client)
         self.clusterpolicy_reconciler = ClusterPolicyReconciler(
             client, namespace=namespace, metrics=self.metrics)
@@ -117,7 +179,7 @@ class OperatorApp:
         self.upgrade_controller = self.manager.add(
             setup_upgrade_controller(client, self.upgrade_reconciler))
         for controller in self.manager.controllers:
-            controller.instrument(self.metrics)
+            controller.instrument(self.metrics, self.tracer)
         # rest_client_requests_total rides the innermost RestClient (the
         # cache wrapper forwards reads it serves itself, which is the point)
         rest = getattr(client, "inner", client)
@@ -137,14 +199,54 @@ class OperatorApp:
         its liveness/readiness probes, or the kubelet crash-loops it."""
         if not self._servers:
             self._servers = serve_health_and_metrics(
-                self.metrics, self._metrics_port, self._health_port, self.client)
+                self.metrics, self._metrics_port, self._health_port, self)
 
     def start_controllers(self) -> None:
         """Reconcile loops — only on the leader."""
         self.manager.start()
+        self._controllers_started.set()
         # kick an initial reconcile even if no watch event ever fires
         for policy in self.client.list("tpu.ai/v1", "ClusterPolicy"):
             self.clusterpolicy_controller.queue.add(Request(name=policy["metadata"]["name"]))
+
+    # -- introspection --------------------------------------------------------
+    def readiness(self):
+        """(ready, detail) for /readyz: 503 until leader election (when
+        enabled) is acquired AND every started watch cache is synced.
+        A degraded informer (sync timed out; reads fall back to direct)
+        counts as serving — degraded means slow, not wrong."""
+        if self.elector is not None:
+            leader_ok = self.elector.is_leader.is_set()
+            leader = {"enabled": True, "is_leader": leader_ok,
+                      "identity": self.elector.identity}
+        else:
+            leader_ok = self._controllers_started.is_set()
+            leader = {"enabled": False, "controllers_started": leader_ok}
+        stats = self.client.stats() if hasattr(self.client, "stats") else []
+        unsynced = [f"{s['apiVersion']}/{s['kind']}" for s in stats
+                    if not s["synced"] and not s.get("degraded")]
+        ready = leader_ok and not unsynced
+        detail = {
+            "status": "ok" if ready else "unready",
+            "version": __version__,
+            "leader": leader,
+            "unsynced_informers": unsynced,
+        }
+        return ready, detail
+
+    def debug_state(self) -> dict:
+        """/debug/state: one page with everything a 'why is it not working
+        yet' question needs — readiness verdict, leader status, informer
+        cache sync, controller/queue liveness, flight-recorder fill."""
+        ready, detail = self.readiness()
+        return {
+            "ready": ready,
+            "readiness": detail,
+            "informers": (self.client.stats()
+                          if hasattr(self.client, "stats") else []),
+            "controllers": [c.debug_state() for c in self.manager.controllers],
+            "flight_recorder": self.recorder.stats(),
+        }
 
     def stop(self) -> None:
         self.manager.stop()
@@ -154,9 +256,13 @@ class OperatorApp:
 
 
 def run_operator(args) -> int:
+    # log plane ↔ trace plane correlation: every record emitted under an
+    # active reconcile trace carries the trace id (match it against the
+    # Event annotation and /debug/traces)
+    tracing.install_log_correlation()
     logging.basicConfig(
         level=getattr(logging, args.log_level.upper()),
-        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+        format="%(asctime)s %(levelname)s %(name)s [trace=%(trace_id)s]: %(message)s")
     log.info("tpu-operator %s starting", __version__)
 
     direct_client = RestClient(base_url=args.api_server, token=args.token)
@@ -168,7 +274,10 @@ def run_operator(args) -> int:
         from ..client.cache import CachedClient
         client = CachedClient(direct_client)
     app = OperatorApp(client, namespace=args.namespace,
-                      metrics_port=args.metrics_port, health_port=args.health_port)
+                      metrics_port=args.metrics_port, health_port=args.health_port,
+                      trace_buffer_size=getattr(args, "trace_buffer_size",
+                                                tracing.DEFAULT_BUFFER_SIZE),
+                      debug_endpoints=getattr(args, "debug_endpoints", True))
 
     stop = threading.Event()
     exit_code = [0]
@@ -192,6 +301,7 @@ def run_operator(args) -> int:
         # election is correctness-critical and tiny — a Lease informer would
         # add a watch stream to save nothing
         elector = LeaderElector(direct_client, app.clusterpolicy_reconciler.namespace)
+        app.elector = elector  # /readyz + /debug/state reflect leadership
         app.start_servers()  # probes answer while standing by
         elector.run(on_started=app.start_controllers, on_stopped=on_lost)
         log.info("leader election enabled; waiting for leadership as %s", elector.identity)

@@ -84,6 +84,12 @@ class OperatorMetrics:
             "Wall-clock duration of a single reconcile call",
             ["name"], registry=self.registry,
             buckets=(.001, .01, .1, 1, 5, 10, 60))
+        self.reconcile_phase = Histogram(
+            "tpu_operator_reconcile_phase_seconds",
+            "Wall-clock duration of one reconcile phase (render, apply, "
+            "status-update, …), fed by the tracing layer's phase spans",
+            ["controller", "phase"], registry=self.registry,
+            buckets=(.001, .01, .1, 1, 5, 10, 60))
         self.reconcile_errors = Counter(
             "tpu_operator_reconcile_errors_total",
             "Reconcile calls that raised (and were requeued with backoff)",

@@ -15,7 +15,7 @@ import logging
 import os
 from typing import Dict, List, Optional
 
-from .. import consts, events
+from .. import consts, events, tracing
 from ..api.clusterpolicy import ClusterPolicy, State
 from ..api.tpudriver import TPUDriver
 from ..client.errors import ConflictError, NotFoundError
@@ -75,10 +75,11 @@ class TPUDriverReconciler(Reconciler):
         return ClusterPolicy.from_obj(policies[0])
 
     def _write_status(self, obj: dict) -> None:
-        try:
-            self.client.update_status(obj)
-        except (ConflictError, NotFoundError):
-            pass
+        with tracing.phase_span("status-update") as sp:
+            try:
+                self.client.update_status(obj)
+            except (ConflictError, NotFoundError) as e:
+                sp.set_attribute("write_race", str(e))
 
     def _set_state(self, driver: TPUDriver, state: str) -> None:
         driver.status["state"] = state
@@ -141,14 +142,22 @@ class TPUDriverReconciler(Reconciler):
                 extra_labels={INSTANCE_LABEL: driver.name,
                               "tpu.ai/node-pool": pool.name},
             )
-            objs = self.state_driver.render_objects(policy, self.namespace,
-                                                    overrides, driver_spec=driver.spec)
-            applied.extend(skel.create_or_update_objs(objs, owner=driver.obj))
+            with tracing.phase_span("render", pool=pool.name) as sp:
+                objs = self.state_driver.render_objects(policy, self.namespace,
+                                                        overrides, driver_spec=driver.spec)
+                sp.set_attribute("objects", len(objs))
+            with tracing.phase_span("apply", pool=pool.name):
+                applied.extend(skel.create_or_update_objs(objs, owner=driver.obj))
 
         self._cleanup_stale(skel, desired_names)
 
         status = skel.get_sync_state(applied, nodes=all_nodes)
         if status == SyncState.READY:
+            if driver.status.get("state") != State.READY:
+                # transition-gated like the ClusterPolicy Ready event: once
+                # per NotReady->Ready edge, not per resync sweep
+                events.record(self.client, self.namespace, driver.obj,
+                              events.NORMAL, "Ready", f"{len(pools)} pool(s) ready")
             driver.status["state"] = State.READY
             driver.status["pools"] = {p.name: p.size for p in pools}
             mark_ready(driver.obj, f"{len(pools)} pool(s) ready")

@@ -9,7 +9,7 @@ import logging
 import os
 from typing import List, Optional
 
-from .. import consts
+from .. import consts, tracing
 from ..api.clusterpolicy import ClusterPolicy
 from ..client.interface import Client, WatchEvent
 from ..nodeinfo import is_tpu_node
@@ -83,8 +83,10 @@ class UpgradeReconciler(Reconciler):
         return groups, rest
 
     def reconcile(self, request: Request) -> Result:
-        policy = self._policy()
-        nodes = self._tpu_nodes()
+        with tracing.phase_span("plan") as sp:
+            policy = self._policy()
+            nodes = self._tpu_nodes()
+            sp.set_attributes(nodes=len(nodes), policy_present=policy is not None)
         if policy is None:
             # mirror the TPUDriver controller's admission rule fully: without
             # a ClusterPolicy no driver is ever rendered, so TPUDriver
@@ -103,18 +105,19 @@ class UpgradeReconciler(Reconciler):
 
         total = UpgradeStateCounts()
         any_governed = False
-        for group_policy, members in groups:
-            machine = UpgradeStateMachine(self.client, self.namespace, group_policy)
-            if group_policy is None or not group_policy.auto_upgrade:
-                # frozen pool: upgrade-failed nodes keep their label and stay
-                # in the failed gauge (freezing must not launder a broken
-                # driver); everything else is cleared + uncordoned =
-                # available. clear_all reports what it did, so the gauges
-                # can't drift from the preservation rule.
-                total = total.merged(machine.clear_all(members, preserve_failed=True))
-                continue
-            any_governed = True
-            total = total.merged(machine.process(members))
+        with tracing.phase_span("process", groups=len(groups)):
+            for group_policy, members in groups:
+                machine = UpgradeStateMachine(self.client, self.namespace, group_policy)
+                if group_policy is None or not group_policy.auto_upgrade:
+                    # frozen pool: upgrade-failed nodes keep their label and
+                    # stay in the failed gauge (freezing must not launder a
+                    # broken driver); everything else is cleared + uncordoned
+                    # = available. clear_all reports what it did, so the
+                    # gauges can't drift from the preservation rule.
+                    total = total.merged(machine.clear_all(members, preserve_failed=True))
+                    continue
+                any_governed = True
+                total = total.merged(machine.process(members))
 
         # gauges are published on every sweep, even when nothing is governed,
         # so a deleted policy or freshly-frozen pool never leaves stale values

@@ -3,6 +3,17 @@
 Events give ``kubectl describe clusterpolicy`` the operational story
 (operand failures, upgrade failures, selector conflicts) without log
 spelunking. Best-effort: event write failures never break a reconcile.
+
+Repeated identical events (same involved object + reason + message + type)
+are AGGREGATED — the existing Event's ``count`` is bumped and its
+``lastTimestamp`` refreshed instead of minting a new object per reconcile,
+matching client-go's EventAggregator/eventLogger behavior. Without this, a
+standing failure plus the 5 s requeue would fill etcd with thousands of
+identical Events on a real cluster.
+
+When a reconcile trace is active, its trace ID is stamped on the Event as
+the ``tpu.ai/trace-id`` annotation so an Event cross-references the exact
+trace in ``/debug/traces`` (and the log lines carrying the same ID).
 """
 
 from __future__ import annotations
@@ -11,6 +22,7 @@ import logging
 import uuid
 from typing import Optional
 
+from . import tracing
 from .client.interface import Client
 from .utils import rfc3339_now
 
@@ -20,11 +32,57 @@ NORMAL = "Normal"
 WARNING = "Warning"
 
 
+def _find_existing(client: Client, namespace: str, involved_ref: dict,
+                   type_: str, reason: str, message: str,
+                   component: str) -> Optional[dict]:
+    """The aggregation target: a stored Event for the same (involved object,
+    type, reason, message, component) tuple. A list-scan per emission is
+    acceptable because emitters are transition-gated (is_new_error & co.),
+    so Events are rare; the namespace Event list stays small precisely
+    because this aggregation keeps it deduplicated."""
+    for event in client.list("v1", "Event", namespace):
+        if (event.get("reason") == reason
+                and event.get("type") == type_
+                and event.get("message") == message
+                and event.get("source", {}).get("component") == component):
+            ref = event.get("involvedObject", {})
+            if (ref.get("kind") == involved_ref.get("kind")
+                    and ref.get("name") == involved_ref.get("name")
+                    and ref.get("uid") == involved_ref.get("uid")):
+                return event
+    return None
+
+
 def record(client: Client, namespace: str, involved: dict,
            type_: str, reason: str, message: str,
            component: str = "tpu-operator") -> Optional[dict]:
     meta = involved.get("metadata", {})
     now = rfc3339_now()
+    message = message[:1024]
+    involved_ref = {
+        "apiVersion": involved.get("apiVersion"),
+        "kind": involved.get("kind"),
+        "name": meta.get("name"),
+        "namespace": meta.get("namespace", ""),
+        "uid": meta.get("uid", ""),
+    }
+    trace_id = tracing.current_trace_id()
+    try:
+        existing = _find_existing(client, namespace, involved_ref,
+                                  type_, reason, message, component)
+        if existing is not None:
+            existing["count"] = int(existing.get("count") or 1) + 1
+            existing["lastTimestamp"] = now
+            if trace_id:
+                # the LATEST occurrence's trace is the one worth debugging
+                existing.setdefault("metadata", {}).setdefault(
+                    "annotations", {})[tracing.TRACE_ID_ANNOTATION] = trace_id
+            return client.update(existing)
+    except Exception as e:
+        # aggregation is an optimization: any failure (list denied, update
+        # conflict with a concurrent bump) falls through to plain create
+        log.debug("event aggregation failed (%s %s): %s",
+                  reason, meta.get("name"), e)
     # truncate the object-name part, never the uniquifying suffix; the slice
     # may leave a trailing '-'/'.', which DNS-1123 validation rejects
     stem = meta.get("name", "unknown")[:50].rstrip("-.") or "unknown"
@@ -36,21 +94,18 @@ def record(client: Client, namespace: str, involved: dict,
             "name": name,
             "namespace": namespace,
         },
-        "involvedObject": {
-            "apiVersion": involved.get("apiVersion"),
-            "kind": involved.get("kind"),
-            "name": meta.get("name"),
-            "namespace": meta.get("namespace", ""),
-            "uid": meta.get("uid", ""),
-        },
+        "involvedObject": involved_ref,
         "type": type_,
         "reason": reason,
-        "message": message[:1024],
+        "message": message,
         "source": {"component": component},
         "firstTimestamp": now,
         "lastTimestamp": now,
         "count": 1,
     }
+    if trace_id:
+        event["metadata"]["annotations"] = {
+            tracing.TRACE_ID_ANNOTATION: trace_id}
     try:
         return client.create(event)
     except Exception as e:  # ApiError or transport failure — both best-effort

@@ -11,6 +11,7 @@ import dataclasses
 import logging
 from typing import Dict, List, Optional, Protocol
 
+from .. import tracing
 from .skel import SyncState
 
 log = logging.getLogger(__name__)
@@ -68,10 +69,13 @@ class Manager:
     def sync_state(self, catalog: InfoCatalog) -> Results:
         results = []
         for state in self.states:
-            try:
-                result = state.sync(catalog)
-            except Exception as e:  # a state crash must not kill the sweep
-                log.exception("state %s errored", state.name)
-                result = StateResult(state.name, SyncState.ERROR, str(e))
+            with tracing.span(f"state.{state.name}", kind="state") as sp:
+                try:
+                    result = state.sync(catalog)
+                except Exception as e:  # a state crash must not kill the sweep
+                    log.exception("state %s errored", state.name)
+                    result = StateResult(state.name, SyncState.ERROR, str(e))
+                    sp.mark_error(f"{type(e).__name__}: {e}")
+                sp.set_attribute("status", result.status.value)
             results.append(result)
         return Results(results)

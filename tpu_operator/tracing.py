@@ -1,0 +1,337 @@
+"""In-process reconcile tracing + flight recorder.
+
+OTel-style spans with zero external deps: the runtime's worker loop opens a
+root span per :class:`~.controllers.runtime.Request`, reconcilers open child
+spans per phase (render / apply / status-update), and the REST/cached
+clients open spans per API call — so one trace shows
+reconcile → renders → API writes end to end.
+
+Propagation rides :mod:`contextvars`: nested code calls :func:`span` (or
+:func:`phase_span` / :func:`api_span`) with no plumbing; outside an active
+trace those are free no-ops, which is what makes always-on instrumentation
+affordable (Podracer's "cheap, always-on introspection" requirement).
+
+Completed traces land in a bounded :class:`FlightRecorder` ring buffer
+(last N traces; error traces pinned in a separate ring so a burst of
+healthy reconciles cannot evict the one failure being debugged), exposed
+on the manager health server as ``/debug/traces``.
+
+The three observability planes cross-reference through the trace ID:
+
+* metrics — phase spans feed ``tpu_operator_reconcile_phase_seconds``
+* events — :func:`.events.record` stamps the active trace ID as the
+  ``tpu.ai/trace-id`` annotation
+* logs — :func:`install_log_correlation` adds ``%(trace_id)s`` to every
+  log record emitted under an active trace
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import logging
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Dict, Iterator, List, Optional
+
+#: Event annotation carrying the reconcile trace that emitted it
+TRACE_ID_ANNOTATION = "tpu.ai/trace-id"
+
+#: default flight-recorder capacity (``--trace-buffer-size``)
+DEFAULT_BUFFER_SIZE = 256
+
+_current_span: contextvars.ContextVar[Optional["Span"]] = contextvars.ContextVar(
+    "tpu_operator_current_span", default=None)
+
+
+def _new_id(nbytes: int) -> str:
+    return uuid.uuid4().hex[: nbytes * 2]
+
+
+class Span:
+    """One timed operation. Spans form a tree under a root reconcile span;
+    children are recorded in start order. Not thread-safe across threads —
+    a trace lives on the single worker thread that opened it (watch/informer
+    threads have no active trace and get no-ops)."""
+
+    __slots__ = ("trace_id", "span_id", "parent_id", "name", "kind",
+                 "attributes", "status", "error", "start_unix", "_t0",
+                 "duration_s", "children")
+
+    def __init__(self, name: str, kind: str = "internal",
+                 trace_id: Optional[str] = None,
+                 parent: Optional["Span"] = None,
+                 attributes: Optional[dict] = None):
+        self.name = name
+        self.kind = kind
+        self.trace_id = trace_id or (parent.trace_id if parent else _new_id(16))
+        self.span_id = _new_id(8)
+        self.parent_id = parent.span_id if parent else None
+        self.attributes: Dict[str, object] = dict(attributes or {})
+        self.status = "unset"
+        self.error: Optional[str] = None
+        self.start_unix = time.time()
+        self._t0 = time.perf_counter()
+        self.duration_s: Optional[float] = None
+        self.children: List[Span] = []
+
+    # -- recording ------------------------------------------------------------
+    def set_attribute(self, key: str, value) -> None:
+        self.attributes[key] = value
+
+    def set_attributes(self, **attrs) -> None:
+        self.attributes.update(attrs)
+
+    def finish(self, error: Optional[BaseException] = None) -> None:
+        if self.duration_s is not None:
+            return  # idempotent: double-finish keeps the first timing
+        self.duration_s = time.perf_counter() - self._t0
+        if error is not None:
+            self.status = "error"
+            self.error = f"{type(error).__name__}: {error}"
+        elif self.status == "unset":
+            self.status = "ok"
+
+    def mark_error(self, message: str) -> None:
+        self.status = "error"
+        self.error = message
+
+    # -- introspection --------------------------------------------------------
+    @property
+    def has_error(self) -> bool:
+        return (self.status == "error"
+                or any(c.has_error for c in self.children))
+
+    def walk(self) -> Iterator["Span"]:
+        yield self
+        for child in self.children:
+            yield from child.walk()
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "kind": self.kind,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "start_unix": self.start_unix,
+            "duration_s": self.duration_s,
+            "status": self.status,
+            "error": self.error,
+            "attributes": self.attributes,
+            "children": [c.to_dict() for c in self.children],
+        }
+
+
+class _NoopSpan:
+    """Returned by :func:`span` when no trace is active: every recording
+    call is a cheap no-op, so library code never needs a guard."""
+
+    __slots__ = ()
+    trace_id = None
+    span_id = None
+    status = "unset"
+    attributes: dict = {}
+
+    def set_attribute(self, key, value):
+        pass
+
+    def set_attributes(self, **attrs):
+        pass
+
+    def mark_error(self, message):
+        pass
+
+    def finish(self, error=None):
+        pass
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+def current_span() -> Optional[Span]:
+    return _current_span.get()
+
+
+def current_trace_id() -> Optional[str]:
+    sp = _current_span.get()
+    return sp.trace_id if sp is not None else None
+
+
+@contextlib.contextmanager
+def span(name: str, kind: str = "internal", **attributes):
+    """Open a child span of the active span; a no-op outside a trace."""
+    parent = _current_span.get()
+    if parent is None:
+        yield NOOP_SPAN
+        return
+    child = Span(name, kind=kind, parent=parent, attributes=attributes)
+    parent.children.append(child)
+    token = _current_span.set(child)
+    try:
+        yield child
+    except BaseException as e:
+        child.finish(error=e)
+        raise
+    else:
+        child.finish()
+    finally:
+        _current_span.reset(token)
+
+
+def phase_span(phase: str, **attributes):
+    """A reconcile-phase child span (render / apply / status-update / …):
+    feeds ``tpu_operator_reconcile_phase_seconds{controller,phase}`` when
+    the enclosing trace finishes."""
+    return span(phase, kind="phase", phase=phase, **attributes)
+
+
+def api_span(verb: str, path: str, **attributes):
+    """An apiserver (or cache-served) call child span."""
+    return span(f"api.{verb.lower()}", kind="api", verb=verb, path=path,
+                **attributes)
+
+
+class FlightRecorder:
+    """Bounded ring buffer of completed traces (root spans).
+
+    Two rings: the main ring keeps the last ``size`` traces regardless of
+    outcome; error traces are ALSO pinned into a separate ring of
+    ``error_size`` so a storm of healthy reconciles can't evict the one
+    failed trace a support case needs (CRIUgpu's capture-enough-to-
+    reconstruct-after-the-fact motivation)."""
+
+    def __init__(self, size: int = DEFAULT_BUFFER_SIZE,
+                 error_size: Optional[int] = None):
+        self.size = max(1, int(size))
+        self.error_size = max(1, int(error_size if error_size is not None
+                                    else self.size // 4 or 1))
+        self._lock = threading.Lock()
+        self._traces: deque = deque(maxlen=self.size)
+        self._errors: deque = deque(maxlen=self.error_size)
+        self.recorded_total = 0
+        self.error_total = 0
+
+    def record(self, root: Span) -> None:
+        with self._lock:
+            self.recorded_total += 1
+            self._traces.append(root)
+            if root.has_error:
+                self.error_total += 1
+                self._errors.append(root)
+
+    def traces(self, controller: Optional[str] = None,
+               errors_only: bool = False,
+               trace_id: Optional[str] = None,
+               limit: Optional[int] = None) -> List[Span]:
+        """Newest-first merged view of both rings (deduplicated)."""
+        with self._lock:
+            merged: Dict[str, Span] = {}
+            for root in list(self._traces) + list(self._errors):
+                merged[root.trace_id] = root
+        out = sorted(merged.values(), key=lambda r: r.start_unix, reverse=True)
+        if controller:
+            out = [r for r in out
+                   if r.attributes.get("controller") == controller]
+        if errors_only:
+            out = [r for r in out if r.has_error]
+        if trace_id:
+            out = [r for r in out if r.trace_id == trace_id]
+        if limit is not None:
+            out = out[:max(0, int(limit))]
+        return out
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "capacity": self.size,
+                "error_capacity": self.error_size,
+                "buffered": len(self._traces),
+                "buffered_errors": len(self._errors),
+                "recorded_total": self.recorded_total,
+                "error_total": self.error_total,
+            }
+
+
+class Tracer:
+    """Opens root spans and finalizes them into a :class:`FlightRecorder`
+    plus the per-phase latency histogram. One per process, shared by every
+    controller (the recorder is the shared sink; spans themselves are
+    thread-confined)."""
+
+    def __init__(self, recorder: Optional[FlightRecorder] = None,
+                 metrics=None):
+        self.recorder = recorder or FlightRecorder()
+        self.metrics = metrics
+
+    @contextlib.contextmanager
+    def trace(self, name: str, controller: str, **attributes):
+        """Open a ROOT span (a fresh trace). Re-raises whatever the body
+        raises after marking the trace failed — callers keep their own
+        error handling (the runtime worker requeues with backoff)."""
+        root = Span(name, kind="reconcile",
+                    attributes={"controller": controller, **attributes})
+        token = _current_span.set(root)
+        try:
+            yield root
+        except BaseException as e:
+            root.finish(error=e)
+            raise
+        else:
+            root.finish()
+        finally:
+            _current_span.reset(token)
+            self._finalize(root)
+
+    def _finalize(self, root: Span) -> None:
+        self.recorder.record(root)
+        if self.metrics is None:
+            return
+        controller = str(root.attributes.get("controller", ""))
+        for sp in root.walk():
+            if sp.kind == "phase" and sp.duration_s is not None:
+                try:
+                    self.metrics.reconcile_phase.labels(
+                        controller=controller,
+                        phase=str(sp.attributes.get("phase", sp.name)),
+                    ).observe(sp.duration_s)
+                except Exception:  # telemetry must never break a reconcile
+                    logging.getLogger(__name__).debug(
+                        "phase histogram observe failed", exc_info=True)
+
+
+#: process-wide default tracer for code paths that have no wiring channel;
+#: OperatorApp replaces it with one bound to its metrics + sized recorder
+_default_tracer = Tracer()
+
+
+def default_tracer() -> Tracer:
+    return _default_tracer
+
+
+def set_default_tracer(tracer: Tracer) -> None:
+    global _default_tracer
+    _default_tracer = tracer
+
+
+# -- log correlation ----------------------------------------------------------
+
+_orig_record_factory = None
+
+
+def install_log_correlation() -> None:
+    """Stamp ``record.trace_id`` on every log record so formats can include
+    ``%(trace_id)s`` — '-' outside a trace. Idempotent."""
+    global _orig_record_factory
+    if _orig_record_factory is not None:
+        return
+    _orig_record_factory = logging.getLogRecordFactory()
+
+    def factory(*args, **kwargs):
+        record = _orig_record_factory(*args, **kwargs)
+        record.trace_id = current_trace_id() or "-"
+        return record
+
+    logging.setLogRecordFactory(factory)
