@@ -10,6 +10,13 @@ test:
 e2e:
 	bash tests/scripts/end-to-end.sh
 
+CHAOS_SEED ?= 1729
+
+.PHONY: chaos
+chaos:  ## seeded fault-injection/soak suite: convergence under 30% API failure rate, watch chops, pod chaos, churn soaks
+	CHAOS_SEED=$(CHAOS_SEED) SOAK_SEED=$(CHAOS_SEED) $(PYTHON) -m pytest tests/ -q \
+		-k "chaos or fault or soak" --continue-on-collection-errors
+
 .PHONY: bench
 bench:
 	$(PYTHON) bench.py

@@ -1,0 +1,232 @@
+"""Convergence under client-stack chaos — the resilience layer's
+acceptance scenario.
+
+A :class:`~tpu_operator.client.chaos.ChaosClient` injects a 30% transient
+failure rate (429 with Retry-After, 503, connection resets) between the
+:class:`~tpu_operator.client.resilience.RetryingClient` and the fake
+cluster, while all three controllers (clusterpolicy, tpudriver, upgrade)
+run concurrently. Requirements:
+
+* every TPU node converges to Ready with advertised capacity, and a full
+  rolling driver upgrade completes, despite roughly one in three API
+  calls failing on the first attempt;
+* ZERO unhandled reconcile errors — every injected fault is absorbed by
+  the retry layer or surfaces as a clean requeue, never as a reconcile
+  exception (``tpu_operator_reconcile_errors_total`` stays empty);
+* the retry traffic is observable: ``tpu_operator_api_retries_total``
+  counts it and the breaker-state gauge is exported.
+
+Chaos is seeded (``CHAOS_SEED``, pinned by ``make chaos``) so a failing
+run replays with the same injection sequence.
+"""
+
+import os
+import time
+
+import pytest
+
+from tpu_operator import consts
+from tpu_operator.api.clusterpolicy import new_cluster_policy
+from tpu_operator.api.tpudriver import new_tpu_driver
+from tpu_operator.client import FakeClient
+from tpu_operator.client.chaos import ChaosClient, ChaosPolicy
+from tpu_operator.client.resilience import (
+    CircuitBreaker,
+    RetryingClient,
+    RetryPolicy,
+    TokenBucket,
+)
+from tpu_operator.controllers.clusterpolicy_controller import (
+    ClusterPolicyReconciler,
+    setup_clusterpolicy_controller,
+)
+from tpu_operator.controllers.metrics import OperatorMetrics
+from tpu_operator.controllers.runtime import Request
+from tpu_operator.controllers.tpudriver_controller import (
+    TPUDriverReconciler,
+    setup_tpudriver_controller,
+)
+from tpu_operator.controllers.upgrade_controller import (
+    UpgradeReconciler,
+    setup_upgrade_controller,
+)
+from tpu_operator.testing.kubelet import KubeletSimulator
+from tpu_operator.upgrade import machine as m
+from tpu_operator.upgrade import node_upgrade_state
+from tpu_operator.utils import deep_get
+
+NS = "tpu-operator"
+SEED = int(os.environ.get("CHAOS_SEED", "1729"))
+TPU_LABELS = {consts.GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice",
+              consts.GKE_TPU_TOPOLOGY_LABEL: "2x4"}
+
+
+@pytest.fixture(autouse=True)
+def default_images(monkeypatch):
+    for env in ("DRIVER_IMAGE", "VALIDATOR_IMAGE", "FEATURE_DISCOVERY_IMAGE",
+                "TELEMETRY_EXPORTER_IMAGE", "SLICE_PARTITIONER_IMAGE"):
+        monkeypatch.setenv(env, "gcr.io/tpu/tpu-validator:0.1.0")
+    monkeypatch.setenv("DEVICE_PLUGIN_IMAGE", "gcr.io/tpu/device-plugin:0.1.0")
+
+
+def wait_for(predicate, timeout=60.0, interval=0.05, message="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {message}")
+
+
+def chaotic_stack(raw, error_rate=0.3):
+    """RetryingClient(ChaosClient(FakeClient)) — the production wrapper
+    order with the chaos layer standing in for a flaky wire. Fast backoff
+    so a chaos run stays seconds, not minutes; generous attempt budget so
+    a 0.3^n losing streak is statistically impossible in one run."""
+    chaos = ChaosPolicy(error_rate=error_rate, retry_after_s=0.02, seed=SEED)
+    client = RetryingClient(
+        ChaosClient(raw, chaos),
+        policy=RetryPolicy(max_attempts=12, base_backoff_s=0.02,
+                           max_backoff_s=0.25, deadline_s=30.0),
+        limiter=TokenBucket(qps=0, burst=1),
+        breaker=CircuitBreaker(threshold=10, cooldown_s=0.3))
+    return client, chaos
+
+
+def start_controllers(client, metrics):
+    cp = setup_clusterpolicy_controller(
+        client, ClusterPolicyReconciler(client, metrics=metrics,
+                                        requeue_after=0.1))
+    td = setup_tpudriver_controller(
+        client, TPUDriverReconciler(client, requeue_after=0.1))
+    up = setup_upgrade_controller(
+        client, UpgradeReconciler(client, metrics=metrics,
+                                  requeue_after=0.1))
+    controllers = (cp, td, up)
+    for c in controllers:
+        c.instrument(metrics)
+        c.start(client)
+    cp.queue.add(Request(name="cluster-policy"))
+    return controllers
+
+
+def assert_zero_unhandled_errors(metrics, chaos):
+    scrape = metrics.scrape().decode()
+    assert chaos.injected_total() > 0, "chaos never fired: the run proves nothing"
+    # every injected fault was absorbed (retried / requeued), none leaked
+    # out of a reconcile as an exception
+    assert "tpu_operator_reconcile_errors_total{" not in scrape
+    assert "tpu_operator_reconciliation_failed_total 0.0" in scrape
+    # the retry traffic is observable, and the breaker gauge is exported
+    assert "tpu_operator_api_retries_total{" in scrape
+    assert "tpu_operator_api_breaker_state" in scrape
+
+
+@pytest.mark.slow
+def test_install_converges_under_30pct_chaos():
+    """Fresh install: ClusterPolicy + a TPUDriver pool instance + 5 TPU
+    nodes, with ~30% of API calls failing transiently. Every node must
+    reach Ready with TPU capacity and both CRs must go ready, with zero
+    unhandled reconcile errors."""
+    raw = FakeClient()
+    client, chaos = chaotic_stack(raw)
+    metrics = OperatorMetrics()
+    metrics.wire_resilience(client)
+
+    for i in range(4):
+        raw.create({"apiVersion": "v1", "kind": "Node",
+                    "metadata": {"name": f"tpu-{i}",
+                                 "labels": dict(TPU_LABELS)},
+                    "spec": {}, "status": {}})
+    raw.create({"apiVersion": "v1", "kind": "Node",
+                "metadata": {"name": "tpu-pool-0",
+                             "labels": {**TPU_LABELS, "pool": "a"}},
+                "spec": {}, "status": {}})
+    raw.create(new_cluster_policy(spec={
+        "driver": {"repository": "gcr.io/tpu", "image": "tpu-validator",
+                   "version": "1.0"},
+    }))
+    raw.create(new_tpu_driver("pool-a", {
+        "image": "libtpu", "repository": "gcr.io/tpu", "version": "1.0",
+        "nodeSelector": {"pool": "a"}}))
+
+    controllers = start_controllers(client, metrics)
+    kubelet = KubeletSimulator(raw, interval=0.03, create_pods=True).start()
+    try:
+        wait_for(lambda: deep_get(
+            raw.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy"),
+            "status", "state") == "ready",
+            timeout=90, message="ClusterPolicy ready under chaos")
+        wait_for(lambda: deep_get(
+            raw.get("tpu.ai/v1alpha1", "TPUDriver", "pool-a"),
+            "status", "state") == "ready",
+            timeout=90, message="TPUDriver pool ready under chaos")
+        wait_for(lambda: all(
+            deep_get(n, "status", "capacity", consts.TPU_RESOURCE_NAME)
+            for n in raw.list("v1", "Node")),
+            timeout=90, message="every node advertising TPU capacity")
+    finally:
+        for c in controllers:
+            c.stop()
+        kubelet.stop()
+    assert_zero_unhandled_errors(metrics, chaos)
+
+
+@pytest.mark.slow
+def test_rolling_upgrade_converges_under_30pct_chaos():
+    """Bump the driver version mid-chaos: the upgrade state machine runs
+    its cordon/drain/restart/validate cycle over a client where evictions,
+    patches, and status writes all randomly fail — and must still roll
+    every node to the new driver and uncordon it."""
+    raw = FakeClient()
+    client, chaos = chaotic_stack(raw)
+    metrics = OperatorMetrics()
+    metrics.wire_resilience(client)
+
+    for i in range(3):
+        raw.create({"apiVersion": "v1", "kind": "Node",
+                    "metadata": {"name": f"tpu-{i}",
+                                 "labels": dict(TPU_LABELS)},
+                    "spec": {}, "status": {}})
+    raw.create(new_cluster_policy(spec={
+        "driver": {"repository": "gcr.io/tpu", "image": "tpu-validator",
+                   "version": "1.0",
+                   "upgradePolicy": {"autoUpgrade": True,
+                                     "maxParallelUpgrades": 2}},
+    }))
+
+    controllers = start_controllers(client, metrics)
+    kubelet = KubeletSimulator(raw, interval=0.03, create_pods=True).start()
+    new_image = "gcr.io/tpu/tpu-validator:2.0"
+    try:
+        wait_for(lambda: deep_get(
+            raw.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy"),
+            "status", "state") == "ready",
+            timeout=90, message="initial install under chaos")
+
+        live = raw.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy")
+        live["spec"]["driver"]["version"] = "2.0"
+        raw.update(live)
+
+        def rolled():
+            images = {deep_get(p, "spec", "nodeName"):
+                      p["spec"]["containers"][0]["image"]
+                      for p in raw.list(
+                          "v1", "Pod", NS,
+                          label_selector={"app.kubernetes.io/component":
+                                          "tpu-driver"})}
+            return (len(images) == 3
+                    and set(images.values()) == {new_image})
+
+        wait_for(rolled, timeout=120,
+                 message="all driver pods rolled to 2.0 under chaos")
+        wait_for(lambda: all(
+            node_upgrade_state(n) in (m.UNKNOWN, m.DONE)
+            and not n["spec"].get("unschedulable")
+            for n in raw.list("v1", "Node")),
+            timeout=120, message="labels settled, nodes uncordoned")
+    finally:
+        for c in controllers:
+            c.stop()
+        kubelet.stop()
+    assert_zero_unhandled_errors(metrics, chaos)

@@ -1,0 +1,527 @@
+"""Unit tests for the client resilience layer (client/resilience.py):
+token-bucket rate limiter, full-jitter retry policy, circuit breaker,
+RetryingClient classification rules, and the runtime/manager integration
+(requeue-not-error on breaker open, degraded /readyz, metrics wiring)."""
+
+import random
+import time
+
+import pytest
+import requests
+
+from tpu_operator.client import FakeClient
+from tpu_operator.client.errors import (
+    ApiError,
+    BreakerOpenError,
+    ConflictError,
+    NotFoundError,
+    TooManyRequestsError,
+    is_transient,
+)
+from tpu_operator.client.resilience import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    CircuitBreaker,
+    RetryPolicy,
+    RetryingClient,
+    TokenBucket,
+    find_resilience,
+)
+from tpu_operator.client.rest import DEFAULT_TIMEOUT_S, parse_retry_after
+from tpu_operator.controllers.manager import OperatorApp
+from tpu_operator.controllers.metrics import OperatorMetrics
+from tpu_operator.controllers.runtime import (
+    Controller,
+    Reconciler,
+    Request,
+    Result,
+)
+
+
+class FakeClock:
+    """Deterministic clock whose sleep() advances time — no real waiting."""
+
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+        self.sleeps = []
+
+    def __call__(self) -> float:
+        return self.t
+
+    def sleep(self, seconds: float) -> None:
+        self.sleeps.append(seconds)
+        self.t += seconds
+
+
+class ScriptedInner:
+    """Stands in for the wrapped client: plays back a script of exceptions
+    and return values, one per call, then succeeds forever."""
+
+    def __init__(self, *script):
+        self.script = list(script)
+        self.calls = 0
+
+    def _next(self):
+        self.calls += 1
+        item = self.script.pop(0) if self.script else {"ok": True}
+        if isinstance(item, BaseException):
+            raise item
+        return item
+
+    def get(self, *a, **k):
+        return self._next()
+
+    def list(self, *a, **k):
+        return self._next()
+
+    def create(self, *a, **k):
+        return self._next()
+
+    def update(self, *a, **k):
+        return self._next()
+
+    def patch(self, *a, **k):
+        return self._next()
+
+    def delete(self, *a, **k):
+        return self._next()
+
+    def update_status(self, *a, **k):
+        return self._next()
+
+    def evict(self, *a, **k):
+        return self._next()
+
+    def server_version(self, *a, **k):
+        return self._next()
+
+    def watch(self, *a, **k):
+        self.calls += 1
+        return "watch-handle"
+
+    def stop(self):
+        pass
+
+
+def make_client(inner, clock=None, **kw):
+    clock = clock or FakeClock()
+    kw.setdefault("policy", RetryPolicy(max_attempts=4, base_backoff_s=0.1,
+                                        max_backoff_s=1.0, deadline_s=60.0))
+    kw.setdefault("limiter", TokenBucket(qps=0, burst=1))
+    kw.setdefault("breaker", CircuitBreaker(threshold=3, cooldown_s=5.0,
+                                            clock=clock))
+    kw.setdefault("rng", random.Random(7))
+    return RetryingClient(inner, clock=clock, sleep=clock.sleep, **kw)
+
+
+# -- error classification ------------------------------------------------------
+
+def test_is_transient_classification():
+    assert is_transient(TooManyRequestsError("slow down"))
+    assert is_transient(ApiError("boom", 503))
+    assert is_transient(ApiError("boom", 500))
+    assert is_transient(requests.ConnectionError("reset"))
+    assert is_transient(requests.Timeout("slow"))
+    assert not is_transient(NotFoundError("gone", 404))
+    assert not is_transient(ConflictError("conflict", 409))
+    assert not is_transient(ApiError("bad request", 400))
+    # the breaker's own short-circuit must never feed back into a retry loop
+    assert not is_transient(BreakerOpenError("open", retry_in=1.0))
+    assert not is_transient(ValueError("not an api error"))
+
+
+def test_parse_retry_after():
+    assert parse_retry_after("3") == 3.0
+    assert parse_retry_after("1.5") == 1.5
+    assert parse_retry_after(None) is None
+    assert parse_retry_after("garbage") is None
+    # HTTP-date form: seconds until a moment slightly in the future
+    from email.utils import formatdate
+    future = formatdate(time.time() + 30, usegmt=True)
+    parsed = parse_retry_after(future)
+    assert parsed is not None and 0 <= parsed <= 31
+    past = formatdate(time.time() - 30, usegmt=True)
+    assert parse_retry_after(past) == 0.0  # clamped, never negative
+
+
+# -- token bucket --------------------------------------------------------------
+
+def test_token_bucket_burst_then_steady_state():
+    clock = FakeClock()
+    bucket = TokenBucket(qps=10.0, burst=3, clock=clock, sleep=clock.sleep)
+    for _ in range(3):  # burst drains with zero wait
+        assert bucket.acquire() == 0.0
+    waited = bucket.acquire()  # empty: one token takes 1/qps
+    assert waited == pytest.approx(0.1, abs=0.01)
+
+
+def test_token_bucket_refills_while_idle():
+    clock = FakeClock()
+    bucket = TokenBucket(qps=10.0, burst=2, clock=clock, sleep=clock.sleep)
+    bucket.acquire()
+    bucket.acquire()
+    clock.t += 10.0  # plenty of idle time: refills to burst, not beyond
+    assert bucket.acquire() == 0.0
+    assert bucket.acquire() == 0.0
+    assert bucket.acquire() > 0.0
+
+
+def test_token_bucket_disabled_at_zero_qps():
+    clock = FakeClock()
+    bucket = TokenBucket(qps=0, burst=1, clock=clock, sleep=clock.sleep)
+    for _ in range(100):
+        assert bucket.acquire() == 0.0
+    assert clock.sleeps == []
+
+
+def test_token_bucket_respects_deadline():
+    clock = FakeClock()
+    bucket = TokenBucket(qps=0.1, burst=1, clock=clock, sleep=clock.sleep)
+    bucket.acquire()
+    with pytest.raises(ApiError) as exc:  # next token is 10s away
+        bucket.acquire(max_wait=1.0)
+    assert exc.value.code == 504
+
+
+# -- retry policy --------------------------------------------------------------
+
+def test_backoff_full_jitter_bounds():
+    policy = RetryPolicy(base_backoff_s=0.2, max_backoff_s=2.0)
+    rng = random.Random(42)
+    for attempt in range(1, 10):
+        cap = min(2.0, 0.2 * (2 ** (attempt - 1)))
+        for _ in range(50):
+            delay = policy.backoff(attempt, rng)
+            assert 0.0 <= delay <= cap
+
+
+# -- circuit breaker -----------------------------------------------------------
+
+def test_breaker_trips_after_consecutive_failures():
+    clock = FakeClock()
+    breaker = CircuitBreaker(threshold=3, cooldown_s=5.0, clock=clock)
+    for _ in range(2):
+        breaker.record_failure()
+    assert breaker.state == CLOSED  # below threshold
+    breaker.record_success()
+    for _ in range(2):
+        breaker.record_failure()
+    assert breaker.state == CLOSED  # success reset the streak
+    breaker.record_failure()
+    assert breaker.state == OPEN
+    with pytest.raises(BreakerOpenError) as exc:
+        breaker.before_call()
+    assert 0 < exc.value.retry_in <= 5.0
+
+
+def test_breaker_half_open_single_probe():
+    clock = FakeClock()
+    breaker = CircuitBreaker(threshold=1, cooldown_s=5.0, clock=clock)
+    breaker.record_failure()
+    assert breaker.state == OPEN
+    clock.t += 6.0  # cooldown elapsed: first caller becomes the probe
+    breaker.before_call()
+    assert breaker.state == HALF_OPEN
+    with pytest.raises(BreakerOpenError):  # second caller is held back
+        breaker.before_call()
+    breaker.record_success()
+    assert breaker.state == CLOSED
+    breaker.before_call()  # closed again: no gate
+
+
+def test_breaker_failed_probe_reopens():
+    clock = FakeClock()
+    breaker = CircuitBreaker(threshold=1, cooldown_s=5.0, clock=clock)
+    breaker.record_failure()
+    clock.t += 6.0
+    breaker.before_call()  # probe goes out
+    breaker.record_failure()  # ...and fails
+    assert breaker.state == OPEN
+    snap = breaker.snapshot()
+    assert snap["opened_total"] == 2
+    assert snap["retry_in_s"] > 0
+
+
+def test_breaker_state_change_hook():
+    clock = FakeClock()
+    breaker = CircuitBreaker(threshold=1, cooldown_s=5.0, clock=clock)
+    transitions = []
+    breaker.on_state_change = lambda old, new: transitions.append((old, new))
+    breaker.record_failure()
+    clock.t += 6.0
+    breaker.before_call()
+    breaker.record_success()
+    assert transitions == [(CLOSED, OPEN), (OPEN, HALF_OPEN),
+                           (HALF_OPEN, CLOSED)]
+
+
+# -- RetryingClient ------------------------------------------------------------
+
+def test_retries_transient_then_succeeds():
+    inner = ScriptedInner(ApiError("boom", 503),
+                          requests.ConnectionError("reset"),
+                          {"recovered": True})
+    retries = []
+    client = make_client(inner)
+    client.on_retry = lambda verb, reason: retries.append((verb, reason))
+    assert client.get("v1", "Pod", "p")["recovered"]
+    assert inner.calls == 3
+    assert retries == [("GET", "503"), ("GET", "transport")]
+
+
+def test_honors_retry_after_from_429():
+    clock = FakeClock()
+    inner = ScriptedInner(TooManyRequestsError("busy", retry_after=2.5),
+                          {"ok": True})
+    client = make_client(inner, clock=clock)
+    client.get("v1", "Pod", "p")
+    assert clock.sleeps == [2.5]  # the server's hint, not jittered backoff
+
+
+def test_semantic_4xx_never_retried():
+    for exc in (NotFoundError("gone", 404), ConflictError("conflict", 409),
+                ApiError("invalid", 422)):
+        inner = ScriptedInner(exc, {"never": "reached"})
+        client = make_client(inner)
+        with pytest.raises(type(exc)):
+            client.get("v1", "Pod", "p")
+        assert inner.calls == 1  # an answer, not a failure
+
+
+def test_max_attempts_exhausted():
+    inner = ScriptedInner(*[ApiError("down", 503)] * 10)
+    client = make_client(inner, breaker=CircuitBreaker(threshold=100))
+    with pytest.raises(ApiError):
+        client.get("v1", "Pod", "p")
+    assert inner.calls == 4  # policy.max_attempts
+
+
+def test_deadline_bounds_total_retry_time():
+    clock = FakeClock()
+    inner = ScriptedInner(*[TooManyRequestsError("busy", retry_after=50.0)] * 10)
+    client = make_client(
+        inner, clock=clock,
+        policy=RetryPolicy(max_attempts=10, deadline_s=60.0))
+    with pytest.raises(TooManyRequestsError):
+        client.get("v1", "Pod", "p")
+    # first retry sleeps 50s; a second 50s wait would blow the 60s deadline,
+    # so the second failure propagates instead of parking the worker
+    assert inner.calls == 2
+    assert clock.sleeps == [50.0]
+
+
+def test_evict_429_is_a_verdict_not_a_failure():
+    inner = ScriptedInner(TooManyRequestsError("PDB", retry_after=7.0),
+                          {"never": "reached"})
+    client = make_client(inner)
+    with pytest.raises(TooManyRequestsError) as exc:
+        client.evict("pod-1", "ns")
+    assert inner.calls == 1  # retrying would silently burn the drain budget
+    assert exc.value.retry_after == 7.0  # hint survives for the caller
+    # ...but a transport blip on the eviction subresource still retries
+    inner = ScriptedInner(requests.ConnectionError("reset"), {"ok": True})
+    client = make_client(inner)
+    client.evict("pod-1", "ns")
+    assert inner.calls == 2
+
+
+def test_breaker_opens_and_short_circuits():
+    clock = FakeClock()
+    inner = ScriptedInner(*[ApiError("down", 503)] * 20)
+    client = make_client(
+        inner, clock=clock,
+        policy=RetryPolicy(max_attempts=2, deadline_s=300.0),
+        breaker=CircuitBreaker(threshold=3, cooldown_s=30.0, clock=clock))
+    for _ in range(2):  # 2 calls x 2 attempts = 4 hard failures > threshold
+        with pytest.raises(ApiError):
+            client.get("v1", "Pod", "p")
+    assert client.breaker.state == OPEN
+    before = inner.calls
+    with pytest.raises(BreakerOpenError):
+        client.get("v1", "Pod", "p")
+    assert inner.calls == before  # short-circuited locally, no wire call
+
+
+def test_429_does_not_trip_breaker():
+    inner = ScriptedInner(*[TooManyRequestsError("busy", retry_after=0.1)] * 8)
+    client = make_client(
+        inner, policy=RetryPolicy(max_attempts=9, deadline_s=600.0),
+        breaker=CircuitBreaker(threshold=2))
+    client.get("v1", "Pod", "p")
+    # 8 consecutive 429s and the breaker never budged: the server is alive
+    # and prioritizing, which is the opposite of an outage
+    assert client.breaker.state == CLOSED
+
+
+def test_semantic_answer_resets_breaker_streak():
+    clock = FakeClock()
+    breaker = CircuitBreaker(threshold=3, cooldown_s=5.0, clock=clock)
+    inner = ScriptedInner(ApiError("down", 503), ApiError("down", 503),
+                          NotFoundError("gone", 404))
+    client = make_client(inner, clock=clock,
+                         policy=RetryPolicy(max_attempts=1, deadline_s=60.0),
+                         breaker=breaker)
+    for exc_type in (ApiError, ApiError, NotFoundError):
+        with pytest.raises(exc_type):
+            client.get("v1", "Pod", "p")
+    # the 404 proved the server is answering: failure streak cleared
+    assert breaker.snapshot()["consecutive_failures"] == 0
+
+
+def test_watch_bypasses_open_breaker():
+    clock = FakeClock()
+    breaker = CircuitBreaker(threshold=1, cooldown_s=60.0, clock=clock)
+    breaker.record_failure()
+    inner = ScriptedInner()
+    client = make_client(inner, clock=clock, breaker=breaker)
+    assert client.watch("v1", "Pod", handler=lambda e: None) == "watch-handle"
+    with pytest.raises(BreakerOpenError):  # while plain reads short-circuit
+        client.get("v1", "Pod", "p")
+
+
+def test_throttle_hook_reports_limiter_waits():
+    clock = FakeClock()
+    waits = []
+    client = make_client(
+        ScriptedInner(), clock=clock,
+        limiter=TokenBucket(qps=10.0, burst=1, clock=clock,
+                            sleep=clock.sleep))
+    client.on_throttle = waits.append
+    client.get("v1", "Pod", "a")  # burst token: free
+    client.get("v1", "Pod", "b")  # must wait ~0.1s
+    assert len(waits) == 1 and waits[0] == pytest.approx(0.1, abs=0.01)
+
+
+def test_find_resilience_walks_wrapper_chain():
+    retrying = make_client(ScriptedInner())
+
+    class Outer:
+        def __init__(self, inner):
+            self.inner = inner
+
+    assert find_resilience(Outer(retrying)) is retrying
+    assert find_resilience(retrying) is retrying
+    assert find_resilience(Outer(Outer(ScriptedInner()))) is None
+    loop = Outer(None)
+    loop.inner = loop  # cycle-safe
+    assert find_resilience(loop) is None
+
+
+# -- runtime integration -------------------------------------------------------
+
+class BreakerFlakyReconciler(Reconciler):
+    name = "flaky"
+
+    def __init__(self):
+        self.calls = 0
+        self.done = __import__("threading").Event()
+
+    def reconcile(self, request: Request) -> Result:
+        self.calls += 1
+        if self.calls <= 2:
+            raise BreakerOpenError("apiserver circuit open", retry_in=0.05)
+        self.done.set()
+        return Result()
+
+
+def test_runtime_requeues_on_breaker_open_without_error():
+    """BreakerOpenError is 'come back later', not a reconcile failure: the
+    request is requeued with a plain delay (no backoff growth) and the
+    error counter stays untouched."""
+    reconciler = BreakerFlakyReconciler()
+    controller = Controller(reconciler)
+    metrics = OperatorMetrics()
+    controller.instrument(metrics)
+    controller.start(FakeClient())
+    try:
+        controller.queue.add(Request(name="x"))
+        assert reconciler.done.wait(timeout=10)
+        controller.queue.add(Request(name="x"))  # should still be alive
+        deadline = time.monotonic() + 5
+        while reconciler.calls < 4 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert reconciler.calls >= 4
+    finally:
+        controller.stop()
+    scrape = metrics.scrape().decode()
+    assert 'tpu_operator_reconcile_errors_total{name="flaky"}' not in scrape
+
+
+def test_metrics_wire_resilience():
+    clock = FakeClock()
+    inner = ScriptedInner(ApiError("down", 503), {"ok": True},
+                          *[ApiError("down", 503)] * 6)
+    client = make_client(
+        inner, clock=clock,
+        policy=RetryPolicy(max_attempts=2, deadline_s=600.0),
+        breaker=CircuitBreaker(threshold=3, cooldown_s=30.0, clock=clock))
+    metrics = OperatorMetrics()
+    metrics.wire_resilience(client)
+    client.get("v1", "Pod", "p")  # one retried 503
+    for _ in range(3):  # then trip the breaker
+        with pytest.raises((ApiError, BreakerOpenError)):
+            client.get("v1", "Pod", "p")
+    scrape = metrics.scrape().decode()
+    assert ('tpu_operator_api_retries_total'
+            '{reason="503",verb="GET"}') in scrape
+    assert 'tpu_operator_api_breaker_state 2.0' in scrape
+    assert ('tpu_operator_api_breaker_transitions_total'
+            '{state="open"}') in scrape
+
+
+def test_readiness_reports_degraded_while_breaker_open():
+    client = RetryingClient(FakeClient(),
+                            breaker=CircuitBreaker(threshold=1,
+                                                   cooldown_s=60.0))
+    app = OperatorApp(client)
+    app._controllers_started.set()  # as after start_controllers()
+    ready, detail = app.readiness()
+    assert ready and detail["status"] == "ok"
+    client.breaker.record_failure()  # outage detected
+    ready, detail = app.readiness()
+    # still ready (200): restarting would trade a warm cache for a cold one
+    assert ready
+    assert detail["status"] == "degraded"
+    assert detail["breaker"]["state"] == "open"
+    assert detail["breaker"]["retry_in_s"] > 0
+    client.breaker.record_success()  # probe succeeded
+    ready, detail = app.readiness()
+    assert ready and detail["status"] == "ok"
+
+
+# -- RestClient defaults -------------------------------------------------------
+
+class RecordingSession(requests.Session):
+    """Answers every request with a canned 200 and records kwargs."""
+
+    def __init__(self):
+        super().__init__()
+        self.kwargs = []
+
+    def request(self, method, url, **kwargs):
+        self.kwargs.append(kwargs)
+        resp = requests.Response()
+        resp.status_code = 200
+        resp._content = b'{"kind":"Pod","metadata":{"name":"p"}}'
+        resp.headers["Content-Type"] = "application/json"
+        resp.url = url
+        resp.request = requests.Request(method, url).prepare()
+        return resp
+
+
+def test_rest_client_default_per_call_timeout():
+    from tpu_operator.client.rest import RestClient
+
+    session = RecordingSession()
+    client = RestClient(base_url="http://apiserver", session=session)
+    client.get("v1", "Pod", "p", "ns")
+    assert session.kwargs[-1]["timeout"] == DEFAULT_TIMEOUT_S
+    client.list("v1", "Pod", "ns")
+    assert session.kwargs[-1]["timeout"] == 60  # LIST keeps its larger bound
+
+    session = RecordingSession()
+    client = RestClient(base_url="http://apiserver", session=session,
+                        default_timeout=3.0)
+    client.get("v1", "Pod", "p", "ns")
+    assert session.kwargs[-1]["timeout"] == 3.0

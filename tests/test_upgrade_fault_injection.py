@@ -14,15 +14,13 @@ upgrade WITHOUT manual label surgery:
    converge with every node on the new driver.
 """
 
-import random
-import threading
 import time
 
 import pytest
 
 from tpu_operator import consts
 from tpu_operator.api.clusterpolicy import new_cluster_policy
-from tpu_operator.client import FakeClient, NotFoundError
+from tpu_operator.client import FakeClient
 from tpu_operator.controllers.clusterpolicy_controller import (
     ClusterPolicyReconciler,
     setup_clusterpolicy_controller,
@@ -32,6 +30,7 @@ from tpu_operator.controllers.upgrade_controller import (
     UpgradeReconciler,
     setup_upgrade_controller,
 )
+from tpu_operator.testing.chaos import PodChaos
 from tpu_operator.testing.kubelet import KubeletSimulator
 from tpu_operator.upgrade import machine as m
 from tpu_operator.upgrade import node_upgrade_state
@@ -174,20 +173,7 @@ def test_chaos_pod_deletion_during_rolling_upgrade():
                                      "maxParallelUpgrades": 2}},
     }))
     cp, up, kubelet = start_stack(client)
-    stop_chaos = threading.Event()
-    rng = random.Random(1729)  # deterministic chaos
-
-    def chaos():
-        while not stop_chaos.wait(0.05):
-            pods = client.list("v1", "Pod", NS)
-            if not pods:
-                continue
-            victim = rng.choice(pods)
-            try:
-                client.delete("v1", "Pod", victim["metadata"]["name"], NS)
-            except NotFoundError:
-                pass
-
+    chaos = PodChaos(client, NS, interval_s=0.05, seed=1729)
     try:
         wait_for(lambda: deep_get(
             client.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy"),
@@ -196,11 +182,10 @@ def test_chaos_pod_deletion_during_rolling_upgrade():
         live = client.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy")
         live["spec"]["driver"]["version"] = "2.0"
         client.update(live)
-        chaos_thread = threading.Thread(target=chaos, daemon=True)
-        chaos_thread.start()
+        chaos.start()
         time.sleep(3.0)           # let the carnage overlap the rollout
-        stop_chaos.set()
-        chaos_thread.join(timeout=5)
+        chaos.stop()
+        assert chaos.victim_count > 0  # the monkey actually struck
 
         wait_for(lambda: set(driver_pod_images(client).values()) == {NEW},
                  timeout=90, message="all driver pods rolled to 2.0")
@@ -210,7 +195,7 @@ def test_chaos_pod_deletion_during_rolling_upgrade():
             for n in client.list("v1", "Node")),
             timeout=90, message="labels settled, nodes uncordoned")
     finally:
-        stop_chaos.set()
+        chaos.stop()
         stop_stack(cp, up, kubelet)
 
 

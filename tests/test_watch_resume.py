@@ -15,6 +15,7 @@ import time
 
 import requests
 
+from tpu_operator.client.chaos import ChaosPolicy, ChaosSession
 from tpu_operator.client.rest import RestClient, _RestWatch
 from tpu_operator.testing import MiniApiServer
 
@@ -217,3 +218,80 @@ def test_restwatch_recovers_from_410_without_leaking_status(monkeypatch):
             handle.stop()
     finally:
         srv.stop()
+
+
+def _chaotic_watch_run(truncate_mode, monkeypatch):
+    """Shared body for the wire-fault watch tests: a ChaosSession chops
+    every watch stream after 2 events (``truncate_mode`` decides how it
+    dies), a plain writer keeps creating pods, and the watch loop must
+    deliver every pod with a bounded number of relists."""
+    srv = MiniApiServer()
+    base = srv.start()
+    try:
+        policy = ChaosPolicy(watch_chop_rate=1.0, truncate_mode=truncate_mode,
+                             chop_after_lines=2, seed=7)
+        watcher = RestClient(base_url=base, session=ChaosSession(policy))
+        writer = RestClient(base_url=base)
+        writer.create(_pod("seed"))
+
+        relists = {"n": 0}
+        real_relist = _RestWatch._relist
+
+        def counting_relist(self):
+            relists["n"] += 1
+            return real_relist(self)
+
+        monkeypatch.setattr(_RestWatch, "_relist", counting_relist)
+
+        events = []
+        lock = threading.Lock()
+
+        def handler(ev):
+            with lock:
+                events.append(ev)
+
+        def seen():
+            with lock:
+                return {e.object.get("metadata", {}).get("name")
+                        for e in events}
+
+        handle = watcher.watch("v1", "Pod", "ns1", handler)
+        try:
+            assert _wait_for(lambda: "seed" in seen())
+            expected = {"seed"}
+            for i in range(6):
+                writer.create(_pod(f"p{i}"))
+                expected.add(f"p{i}")
+            # no events lost: every pod arrives despite each stream dying
+            # after two events (chop rate 1.0 guarantees the fault fires)
+            assert _wait_for(lambda: expected <= seen(), timeout=30)
+            faults = policy.injected_total()
+            assert faults > 0
+            # no relist storm: one initial sync, plus at most one relist per
+            # chopped stream (a chop whose resume point is still current
+            # reconnects without any LIST at all)
+            assert relists["n"] <= 1 + faults
+            # recovery never leaks wire garbage to consumers: no ERROR
+            # events, no Status objects, no half-parsed JSON
+            with lock:
+                assert all(e.type in ("ADDED", "MODIFIED", "DELETED")
+                           for e in events)
+                assert all(e.object.get("kind") != "Status" for e in events)
+        finally:
+            handle.stop()
+    finally:
+        srv.stop()
+
+
+def test_watch_resumes_after_midstream_connection_drops(monkeypatch):
+    """ChaosSession kills every watch connection mid-event (connection
+    reset); the loop must resume from its last good rv, accept the 410 the
+    history-less server answers, and relist exactly once per loss."""
+    _chaotic_watch_run("drop", monkeypatch)
+
+
+def test_watch_resumes_after_truncated_json_lines(monkeypatch):
+    """ChaosSession ends every watch stream with half a JSON line — what a
+    dying LB does to chunked encoding. The parse failure must be treated
+    as a stream loss (resume + relist), never delivered downstream."""
+    _chaotic_watch_run("truncate", monkeypatch)

@@ -1,13 +1,24 @@
-from .errors import ApiError, ConflictError, KindNotServedError, NotFoundError
+from .errors import (
+    ApiError,
+    BreakerOpenError,
+    ConflictError,
+    KindNotServedError,
+    NotFoundError,
+    TooManyRequestsError,
+    is_transient,
+)
 from .interface import Client, WatchEvent
 from .fake import FakeClient
 from .scheme import Scheme, default_scheme
 
 __all__ = [
     "ApiError",
+    "BreakerOpenError",
     "ConflictError",
     "KindNotServedError",
     "NotFoundError",
+    "TooManyRequestsError",
+    "is_transient",
     "Client",
     "WatchEvent",
     "FakeClient",

@@ -274,6 +274,16 @@ class CachedClient(Client):
             for stale in doomed:
                 stale.stop()
         if wait_sync and not informer.synced.is_set():
+            breaker = getattr(self.inner, "breaker", None)
+            if breaker is not None and breaker.is_open:
+                # apiserver known-down (resilience layer's breaker open):
+                # the sync LIST cannot land until it recovers, so don't
+                # park the worker for the full timeout — fall through to
+                # the direct-read path now, which short-circuits with
+                # BreakerOpenError and the runtime requeues. Not recorded
+                # as sync_wait_failed: the informer is healthy, the
+                # server is not, and sync resumes the moment it returns.
+                return informer
             # pay the full sync timeout once; a watch that cannot sync
             # (RBAC-denied LIST, unserved kind) must degrade to direct
             # reads per call, not wedge every read for 30 s forever

@@ -1,0 +1,263 @@
+"""Reusable fault injection for the client stack.
+
+Chaos testing in the reference universe means killing pods with a shell
+script; here it is a first-class, seeded, reusable layer with two injection
+points matching the two client stacks the tests run:
+
+* :class:`ChaosClient` wraps any :class:`~.interface.Client` (in practice
+  :class:`~.fake.FakeClient`) and injects *call-level* faults: transient
+  429s carrying ``Retry-After``, 503s, transport-level connection resets,
+  and latency. This is what convergence-under-chaos tests feed to the
+  controller stack underneath a :class:`~.resilience.RetryingClient`.
+* :class:`ChaosSession` is a drop-in ``requests.Session`` for
+  :class:`~.rest.RestClient` that injects *wire-level* faults: whole
+  connections refused, error responses synthesized before the server is
+  reached, and — the part no Client-level wrapper can express — watch
+  streams dropped mid-event or truncated mid-JSON-line, exercising the
+  watch loop's resume machinery over real HTTP.
+
+Everything is driven by one seeded :class:`random.Random` so a failing
+chaos run replays exactly (`make chaos` pins ``CHAOS_SEED``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import threading
+import time
+from typing import Dict, List, Optional
+
+import requests
+
+from .errors import ApiError, TooManyRequestsError
+from .interface import Client, WatchHandle
+
+
+@dataclasses.dataclass
+class ChaosPolicy:
+    """What to inject, how often. Rates are per-call probabilities in
+    [0, 1]; the error mix is drawn uniformly from ``error_kinds``."""
+
+    #: probability a CRUD call fails with an injected transient error
+    error_rate: float = 0.0
+    #: the transient mix: "429" (Retry-After attached), "503", "reset"
+    error_kinds: tuple = ("429", "503", "reset")
+    #: Retry-After seconds attached to injected 429s
+    retry_after_s: float = 0.05
+    #: added latency range (seconds) per surviving call
+    latency_s: tuple = (0.0, 0.0)
+    #: probability a streaming watch connection is chopped: the stream
+    #: delivers a few events then dies (see ``truncate_mode``)
+    watch_chop_rate: float = 0.0
+    #: "drop" = connection reset mid-event; "truncate" = a JSON line cut
+    #: off mid-byte then EOF (what a dying LB does to chunked encoding)
+    truncate_mode: str = "drop"
+    #: max events a chopped stream delivers before dying
+    chop_after_lines: int = 2
+    seed: int = 0
+
+    def __post_init__(self):
+        self.rng = random.Random(self.seed)
+        self._lock = threading.Lock()
+        #: injected-fault accounting, by kind — tests assert the chaos
+        #: actually happened (a 0% effective rate proves nothing)
+        self.injected: Dict[str, int] = {}
+
+    def _count(self, kind: str) -> None:
+        with self._lock:
+            self.injected[kind] = self.injected.get(kind, 0) + 1
+
+    def injected_total(self) -> int:
+        with self._lock:
+            return sum(self.injected.values())
+
+    # -- injection decisions ---------------------------------------------------
+    def maybe_fail(self, verb: str) -> None:
+        """Raise an injected transient failure, or return to let the call
+        through. Thread-safe: the rng is guarded so concurrent workers
+        draw a deterministic (if interleaving-dependent) sequence."""
+        with self._lock:
+            roll = self.rng.random()
+            kind = self.rng.choice(self.error_kinds)
+        if roll >= self.error_rate:
+            return
+        self._count(kind)
+        if kind == "429":
+            raise TooManyRequestsError(
+                f"chaos: injected 429 on {verb}",
+                retry_after=self.retry_after_s)
+        if kind == "503":
+            raise ApiError(f"chaos: injected 503 on {verb}", 503)
+        raise requests.ConnectionError(
+            f"chaos: injected connection reset on {verb}")
+
+    def maybe_sleep(self) -> None:
+        lo, hi = self.latency_s
+        if hi <= 0:
+            return
+        with self._lock:
+            delay = self.rng.uniform(lo, hi)
+        time.sleep(delay)
+
+    def should_chop_watch(self) -> bool:
+        with self._lock:
+            hit = self.rng.random() < self.watch_chop_rate
+        if hit:
+            self._count(f"watch-{self.truncate_mode}")
+        return hit
+
+
+class ChaosClient(Client):
+    """Client-interface fault injector. Wraps the inner client so every
+    CRUD call may fail transiently / slow down before reaching it; watches
+    pass through untouched (Client-level streams are gap-free — wire-level
+    watch faults live in :class:`ChaosSession`). ``exempt`` verbs skip
+    injection (e.g. a test's own assertion reads)."""
+
+    def __init__(self, inner: Client, policy: ChaosPolicy,
+                 exempt: tuple = ()):
+        self.inner = inner
+        self.policy = policy
+        self.scheme = getattr(inner, "scheme", None)
+        self._exempt = set(exempt)
+
+    def _zap(self, verb: str) -> None:
+        if verb in self._exempt:
+            return
+        self.policy.maybe_sleep()
+        self.policy.maybe_fail(verb)
+
+    def get(self, api_version, kind, name, namespace=None) -> dict:
+        self._zap("GET")
+        return self.inner.get(api_version, kind, name, namespace)
+
+    def list(self, api_version, kind, namespace=None, label_selector=None,
+             field_selector=None) -> List[dict]:
+        self._zap("LIST")
+        return self.inner.list(api_version, kind, namespace,
+                               label_selector, field_selector)
+
+    def create(self, obj: dict) -> dict:
+        self._zap("POST")
+        return self.inner.create(obj)
+
+    def update(self, obj: dict) -> dict:
+        self._zap("PUT")
+        return self.inner.update(obj)
+
+    def patch(self, api_version, kind, name, patch, namespace=None) -> dict:
+        self._zap("PATCH")
+        return self.inner.patch(api_version, kind, name, patch, namespace)
+
+    def delete(self, api_version, kind, name, namespace=None) -> None:
+        self._zap("DELETE")
+        return self.inner.delete(api_version, kind, name, namespace)
+
+    def update_status(self, obj: dict) -> dict:
+        self._zap("PUT")
+        return self.inner.update_status(obj)
+
+    def evict(self, name: str, namespace: Optional[str] = None) -> None:
+        self._zap("EVICT")
+        return self.inner.evict(name, namespace)
+
+    def server_version(self) -> str:
+        self._zap("GET")
+        return self.inner.server_version()
+
+    def watch(self, api_version, kind, namespace=None, handler=None,
+              relist_handler=None) -> WatchHandle:
+        return self.inner.watch(api_version, kind, namespace, handler,
+                                relist_handler=relist_handler)
+
+    def stop(self) -> None:
+        self.inner.stop()
+
+
+class _ChoppedResponse:
+    """Proxy over a streaming ``requests.Response`` that delivers at most
+    ``after_lines`` watch lines, then dies the way a broken connection
+    does: ``drop`` raises mid-read, ``truncate`` emits a half JSON line
+    and ends the stream (what the client sees when chunked encoding is
+    cut at a byte boundary)."""
+
+    def __init__(self, inner: requests.Response, after_lines: int,
+                 mode: str):
+        self._inner = inner
+        self._after = after_lines
+        self._mode = mode
+
+    def __enter__(self):
+        self._inner.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._inner.__exit__(*exc)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def iter_lines(self, *args, **kwargs):
+        served = 0
+        for line in self._inner.iter_lines(*args, **kwargs):
+            if served >= self._after:
+                self._inner.close()
+                if self._mode == "truncate":
+                    # half an event: valid UTF-8, invalid JSON
+                    yield line[: max(1, len(line) // 2)]
+                    return
+                raise requests.ConnectionError(
+                    "chaos: watch connection reset mid-stream")
+            yield line
+            if line:
+                served += 1
+
+
+class ChaosSession(requests.Session):
+    """Wire-level injector for :class:`~.rest.RestClient`: pass as the
+    ``session=`` argument. Non-stream requests may be refused (connection
+    reset) or answered with synthesized 429/503 before reaching the
+    server; stream (watch) requests may be chopped mid-flight."""
+
+    def __init__(self, policy: ChaosPolicy):
+        super().__init__()
+        self.policy = policy
+
+    @staticmethod
+    def _synthesize(method: str, url: str, code: int,
+                    headers: Optional[dict] = None) -> requests.Response:
+        resp = requests.Response()
+        resp.status_code = code
+        resp._content = (
+            b'{"kind":"Status","message":"chaos: injected response",'
+            b'"code":%d}' % code)
+        resp.headers.update({"Content-Type": "application/json",
+                             **(headers or {})})
+        resp.url = url
+        resp.request = requests.Request(method, url).prepare()
+        return resp
+
+    def request(self, method, url, **kwargs):
+        policy = self.policy
+        if kwargs.get("stream"):
+            resp = super().request(method, url, **kwargs)
+            if resp.status_code < 400 and policy.should_chop_watch():
+                return _ChoppedResponse(resp, policy.chop_after_lines,
+                                        policy.truncate_mode)
+            return resp
+        policy.maybe_sleep()
+        with policy._lock:
+            roll = policy.rng.random()
+            kind = policy.rng.choice(policy.error_kinds)
+        if roll < policy.error_rate:
+            policy._count(kind)
+            if kind == "reset":
+                raise requests.ConnectionError(
+                    f"chaos: injected connection reset on {method}")
+            if kind == "429":
+                return self._synthesize(
+                    method, url, 429,
+                    {"Retry-After": str(policy.retry_after_s)})
+            return self._synthesize(method, url, 503)
+        return super().request(method, url, **kwargs)

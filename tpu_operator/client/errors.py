@@ -36,9 +36,17 @@ class InvalidError(ApiError):
 
 
 class TooManyRequestsError(ApiError):
-    """Eviction blocked by a PodDisruptionBudget (apiserver 429)."""
+    """Eviction blocked by a PodDisruptionBudget, or apiserver overload
+    (apiserver 429). ``retry_after`` carries the server's ``Retry-After``
+    interval in seconds when one was sent — eviction loops and the retry
+    layer wait exactly that long instead of a guessed backoff."""
 
     code = 429
+
+    def __init__(self, message: str, code: int | None = None,
+                 retry_after: float | None = None):
+        super().__init__(message, code)
+        self.retry_after = retry_after
 
 
 class ConflictError(ApiError):
@@ -47,3 +55,37 @@ class ConflictError(ApiError):
 
 class AlreadyExistsError(ConflictError):
     code = 409
+
+
+class BreakerOpenError(ApiError):
+    """The client-side circuit breaker is open: the apiserver failed enough
+    consecutive calls that further requests are short-circuited locally
+    instead of piling onto a struggling server. Deliberately NOT transient
+    from the retry layer's point of view (retrying immediately is exactly
+    what the breaker exists to prevent). ``retry_in`` is the seconds until
+    the breaker next half-opens — reconcilers requeue for that interval
+    rather than counting the sweep as an error."""
+
+    code = 503
+
+    def __init__(self, message: str, retry_in: float | None = None):
+        super().__init__(message, 503)
+        self.retry_in = retry_in
+
+
+def is_transient(exc: BaseException) -> bool:
+    """Would a retry plausibly succeed? True for apiserver overload (429),
+    server-side 5xx, and transport-level failures; False for 4xx semantics
+    (absent, conflicting, invalid — retrying cannot change the answer) and
+    for the breaker's own short-circuit."""
+    if isinstance(exc, BreakerOpenError):
+        return False
+    if isinstance(exc, TooManyRequestsError):
+        return True
+    if isinstance(exc, ApiError):
+        return exc.code >= 500
+    try:  # transport errors (connection reset, timeout, truncated body)
+        import requests
+        return isinstance(exc, requests.RequestException)
+    except ImportError:  # pragma: no cover - requests is a hard dep
+        return False

@@ -21,7 +21,16 @@ class WatchEvent:
 
 class Client:
     """Abstract k8s API client. Implementations: FakeClient, RestClient,
-    CachedClient."""
+    CachedClient, RetryingClient (resilience), ChaosClient (fault
+    injection). Wrappers expose the wrapped client as ``.inner`` so
+    cross-cutting wiring (metrics hooks, breaker discovery) can walk the
+    chain without caring about stacking order.
+
+    Error contract: implementations raise the typed
+    :mod:`~tpu_operator.client.errors` hierarchy. Callers must additionally
+    tolerate :class:`~.errors.BreakerOpenError` from any call when the
+    stack includes the resilience layer — the runtime translates it into a
+    plain requeue (degraded mode), never a reconcile error."""
 
     def stop(self) -> None:
         """Release background resources (informer watches, streams). No-op
@@ -84,6 +93,12 @@ class Client:
         the replace-boundary to expire entries deleted during a
         missed-event window. Implementations must accept the kwarg; ones
         with gap-free streams may call it exactly once at registration."""
+        raise NotImplementedError
+
+    # -- discovery -----------------------------------------------------------
+    def server_version(self) -> str:
+        """The apiserver's version string (also the circuit breaker's
+        cheapest probe target)."""
         raise NotImplementedError
 
 

@@ -30,6 +30,30 @@ from .scheme import Scheme, default_scheme
 
 SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
+#: default per-call deadline (``--api-timeout``): no CRUD round trip may
+#: hang a reconcile worker forever. LIST keeps its longer 60s budget and
+#: the watch stream its own 330s read timeout.
+DEFAULT_TIMEOUT_S = 30.0
+
+
+def parse_retry_after(value: Optional[str]) -> Optional[float]:
+    """``Retry-After`` header → seconds (None when absent/unparseable).
+    Handles both forms RFC 9110 allows: delta-seconds and HTTP-date."""
+    if not value:
+        return None
+    try:
+        return max(0.0, float(value))
+    except ValueError:
+        pass
+    try:
+        from email.utils import parsedate_to_datetime
+        import datetime
+        when = parsedate_to_datetime(value)
+        now = datetime.datetime.now(when.tzinfo)
+        return max(0.0, (when - now).total_seconds())
+    except (TypeError, ValueError):
+        return None
+
 
 def _in_cluster_config() -> dict:
     host = os.environ.get("KUBERNETES_SERVICE_HOST")
@@ -55,6 +79,7 @@ class RestClient(Client):
         verify=None,
         scheme: Optional[Scheme] = None,
         session: Optional[requests.Session] = None,
+        default_timeout: Optional[float] = DEFAULT_TIMEOUT_S,
     ):
         if base_url is None:
             cfg = _in_cluster_config()
@@ -65,6 +90,7 @@ class RestClient(Client):
         if token:
             self._session.headers["Authorization"] = f"Bearer {token}"
         self._session.verify = verify if verify is not None else True
+        self.default_timeout = default_timeout
         #: optional telemetry hook called (method, status_code) per response
         #: (client-go's rest_client_requests_total analog)
         self.on_response: Optional[Callable[[str, int], None]] = None
@@ -114,6 +140,8 @@ class RestClient(Client):
         absence is an answer, and ensure-exists probes (GET before create)
         would otherwise pin every first reconcile into the error ring."""
         path = url[len(self.base_url):] if url.startswith(self.base_url) else url
+        if self.default_timeout is not None:
+            kwargs.setdefault("timeout", self.default_timeout)
         not_found = None
         with tracing.api_span(method, path) as sp:
             resp = self._session.request(method, url, **kwargs)
@@ -143,7 +171,9 @@ class RestClient(Client):
         if resp.status_code == 422:
             raise InvalidError(message)
         if resp.status_code == 429:
-            raise TooManyRequestsError(message)
+            raise TooManyRequestsError(
+                message,
+                retry_after=parse_retry_after(resp.headers.get("Retry-After")))
         raise ApiError(message, resp.status_code)
 
     # -- CRUD ----------------------------------------------------------------

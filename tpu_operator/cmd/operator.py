@@ -20,6 +20,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--log-level", default="info", choices=["debug", "info", "warning", "error"])
     p.add_argument("--leader-elect", action="store_true",
                    help="enable Lease-based leader election (multi-replica deployments)")
+    p.add_argument("--api-timeout", type=float, default=30.0,
+                   help="per-request apiserver deadline in seconds; no CRUD "
+                        "call may hang a reconcile worker past this (the "
+                        "watch stream keeps its own 330s read timeout)")
+    p.add_argument("--api-qps", type=float, default=20.0,
+                   help="client-side steady-state apiserver request rate "
+                        "(token bucket; 0 disables rate limiting)")
+    p.add_argument("--api-burst", type=int, default=40,
+                   help="client-side rate limiter burst size")
+    p.add_argument("--breaker-threshold", type=int, default=5,
+                   help="consecutive hard apiserver failures (5xx/transport) "
+                        "before the circuit breaker opens and the operator "
+                        "enters degraded mode")
     p.add_argument("--no-cache-reads", dest="cache_reads", action="store_false",
                    help="serve reconcile reads directly from the apiserver "
                         "instead of informer caches (debugging escape hatch)")

@@ -181,10 +181,19 @@ class OperatorApp:
         for controller in self.manager.controllers:
             controller.instrument(self.metrics, self.tracer)
         # rest_client_requests_total rides the innermost RestClient (the
-        # cache wrapper forwards reads it serves itself, which is the point)
-        rest = getattr(client, "inner", client)
+        # cache/resilience wrappers forward what they don't serve/absorb,
+        # which is the point); the resilience layer, wherever it sits in
+        # the chain, feeds the retry/breaker/throttle families
+        from ..client.resilience import find_resilience
+
+        rest = client
+        while hasattr(rest, "inner"):
+            rest = rest.inner
         if hasattr(rest, "on_response"):
             rest.on_response = self.metrics.observe_rest_response
+        self.resilience = find_resilience(client)
+        if self.resilience is not None:
+            self.metrics.wire_resilience(self.resilience)
         self._metrics_port = metrics_port
         self._health_port = health_port
         self._servers: list = []
@@ -214,7 +223,11 @@ class OperatorApp:
         """(ready, detail) for /readyz: 503 until leader election (when
         enabled) is acquired AND every started watch cache is synced.
         A degraded informer (sync timed out; reads fall back to direct)
-        counts as serving — degraded means slow, not wrong."""
+        counts as serving — degraded means slow, not wrong. Likewise an
+        OPEN circuit breaker reports ``status: degraded`` but stays 200:
+        the leader keeps its lease and cached reads keep serving through
+        an apiserver outage — restarting the pod (what a 503 invites)
+        would only trade a warm cache for a cold one."""
         if self.elector is not None:
             leader_ok = self.elector.is_leader.is_set()
             leader = {"enabled": True, "is_leader": leader_ok,
@@ -225,13 +238,19 @@ class OperatorApp:
         stats = self.client.stats() if hasattr(self.client, "stats") else []
         unsynced = [f"{s['apiVersion']}/{s['kind']}" for s in stats
                     if not s["synced"] and not s.get("degraded")]
+        breaker = (self.resilience.breaker.snapshot()
+                   if self.resilience is not None else None)
+        degraded = breaker is not None and breaker["state"] != "closed"
         ready = leader_ok and not unsynced
         detail = {
-            "status": "ok" if ready else "unready",
+            "status": ("degraded" if ready and degraded
+                       else "ok" if ready else "unready"),
             "version": __version__,
             "leader": leader,
             "unsynced_informers": unsynced,
         }
+        if breaker is not None:
+            detail["breaker"] = breaker
         return ready, detail
 
     def debug_state(self) -> dict:
@@ -265,14 +284,30 @@ def run_operator(args) -> int:
         format="%(asctime)s %(levelname)s %(name)s [trace=%(trace_id)s]: %(message)s")
     log.info("tpu-operator %s starting", __version__)
 
-    direct_client = RestClient(base_url=args.api_server, token=args.token)
-    client = direct_client
+    direct_client = RestClient(base_url=args.api_server, token=args.token,
+                               default_timeout=getattr(args, "api_timeout",
+                                                       30.0))
+    # resilience layer between the cache and the wire: retry/backoff for
+    # transient failures, client-side rate limiting, circuit breaker with
+    # degraded mode (client-go flowcontrol + reflector retry equivalents)
+    from ..client.resilience import (
+        CircuitBreaker,
+        RetryingClient,
+        TokenBucket,
+    )
+
+    client = RetryingClient(
+        direct_client,
+        limiter=TokenBucket(qps=getattr(args, "api_qps", 20.0),
+                            burst=getattr(args, "api_burst", 40)),
+        breaker=CircuitBreaker(
+            threshold=getattr(args, "breaker_threshold", 5)))
     if getattr(args, "cache_reads", True):
         # reconcile reads come from informer caches, as in controller-runtime
         # (the reference never GETs in its hot loop; main.go:111-117) —
-        # writes still hit the apiserver directly
+        # writes still hit the apiserver, through the resilience layer
         from ..client.cache import CachedClient
-        client = CachedClient(direct_client)
+        client = CachedClient(client)
     app = OperatorApp(client, namespace=args.namespace,
                       metrics_port=args.metrics_port, health_port=args.health_port,
                       trace_buffer_size=getattr(args, "trace_buffer_size",
@@ -297,9 +332,12 @@ def run_operator(args) -> int:
             exit_code[0] = 1
             stop.set()
 
-        # leases bypass the cache (controller-runtime does the same): leader
-        # election is correctness-critical and tiny — a Lease informer would
-        # add a watch stream to save nothing
+        # leases bypass the cache AND the resilience layer (controller-runtime
+        # does the same): leader election is correctness-critical, tiny, and
+        # timing-sensitive — a retry loop sleeping out backoff inside a lease
+        # renewal could blow the renew deadline, and the breaker must never
+        # short-circuit the renewals that keep the lease held through an
+        # apiserver brownout (degraded mode explicitly keeps leadership)
         elector = LeaderElector(direct_client, app.clusterpolicy_reconciler.namespace)
         app.elector = elector  # /readyz + /debug/state reflect leadership
         app.start_servers()  # probes answer while standing by

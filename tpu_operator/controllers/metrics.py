@@ -99,9 +99,48 @@ class OperatorMetrics:
             "HTTP requests issued to the apiserver, by method and code",
             ["method", "code"], registry=self.registry)
 
+        # resilience layer (RetryingClient: retry/backoff, token bucket,
+        # circuit breaker — client-go flowcontrol/reflector equivalents)
+        self.api_retries = Counter(
+            "tpu_operator_api_retries_total",
+            "Transient apiserver failures retried by the client resilience "
+            "layer, by verb and reason (429 / 5xx code / transport)",
+            ["verb", "reason"], registry=self.registry)
+        self.api_breaker_state = Gauge(
+            "tpu_operator_api_breaker_state",
+            "Apiserver circuit breaker state: 0=closed, 1=half-open, 2=open "
+            "(open = degraded mode: calls short-circuit, reconcilers requeue)",
+            registry=self.registry)
+        self.api_breaker_transitions = Counter(
+            "tpu_operator_api_breaker_transitions_total",
+            "Circuit breaker state transitions, by state entered",
+            ["state"], registry=self.registry)
+        self.api_throttle_seconds = Counter(
+            "tpu_operator_api_client_throttle_seconds_total",
+            "Cumulative time requests waited on the client-side token-bucket "
+            "rate limiter (client-go flowcontrol analog)",
+            registry=self.registry)
+
     def observe_rest_response(self, method: str, code: int) -> None:
         """RestClient.on_response hook target."""
         self.rest_requests.labels(method=method, code=str(code)).inc()
+
+    def wire_resilience(self, resilience) -> None:
+        """Attach the RetryingClient's hooks: retry counter, throttle
+        budget, breaker-state gauge + transition counter."""
+        from ..client.resilience import STATE_VALUES
+
+        resilience.on_retry = (
+            lambda verb, reason:
+            self.api_retries.labels(verb=verb, reason=reason).inc())
+        resilience.on_throttle = self.api_throttle_seconds.inc
+        self.api_breaker_state.set_function(
+            lambda: STATE_VALUES.get(resilience.breaker.state, 0))
+
+        def on_state_change(old: str, new: str) -> None:
+            self.api_breaker_transitions.labels(state=new).inc()
+
+        resilience.breaker.on_state_change = on_state_change
 
     def scrape(self) -> bytes:
         return generate_latest(self.registry)

@@ -17,6 +17,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..client.errors import BreakerOpenError
 from ..client.interface import Client, WatchEvent
 
 log = logging.getLogger(__name__)
@@ -286,6 +287,19 @@ class Controller:
                     result = self.reconciler.reconcile(request)
                     if root is not None and result and result.requeue_after is not None:
                         root.set_attribute("requeue_after_s", result.requeue_after)
+            except BreakerOpenError as e:
+                # degraded mode: the apiserver circuit is open, so NOTHING
+                # this reconciler does can land right now. Not an error —
+                # no reconcile_errors increment, no exponential backoff
+                # growth — just wait out the breaker's cooldown and try
+                # again. Backoff would compound with the breaker's own
+                # cooldown; errors would page on an outage the operator is
+                # already handling as designed.
+                delay = max(0.5, e.retry_in or 0.0)
+                log.warning("%s: apiserver circuit open; requeueing %s in "
+                            "%.1fs", self.reconciler.name, request, delay)
+                self.queue.add(request, delay)
+                continue
             except Exception:
                 log.exception("%s: reconcile %s failed", self.reconciler.name, request)
                 if self._metrics is not None:

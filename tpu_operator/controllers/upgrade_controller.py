@@ -105,6 +105,7 @@ class UpgradeReconciler(Reconciler):
 
         total = UpgradeStateCounts()
         any_governed = False
+        retry_hints: List[float] = []
         with tracing.phase_span("process", groups=len(groups)):
             for group_policy, members in groups:
                 machine = UpgradeStateMachine(self.client, self.namespace, group_policy)
@@ -118,6 +119,8 @@ class UpgradeReconciler(Reconciler):
                     continue
                 any_governed = True
                 total = total.merged(machine.process(members))
+                if machine.retry_after_hint is not None:
+                    retry_hints.append(machine.retry_after_hint)
 
         # gauges are published on every sweep, even when nothing is governed,
         # so a deleted policy or freshly-frozen pool never leaves stale values
@@ -126,6 +129,12 @@ class UpgradeReconciler(Reconciler):
             return Result()
         if total.pending or total.in_progress:
             log.info("upgrade sweep: %s", total.as_dict())
+        if retry_hints:
+            # a PDB-blocked eviction told us exactly when to come back
+            # (Retry-After): honoring it beats both extremes — hammering
+            # the budget every sweep and sleeping out the full period
+            return Result(requeue_after=min(self.requeue_after,
+                                            max(0.5, min(retry_hints))))
         return Result(requeue_after=self.requeue_after)
 
     def _publish(self, total: UpgradeStateCounts) -> None:

@@ -1,3 +1,4 @@
 from .apiserver import MiniApiServer
+from .chaos import PodChaos
 
-__all__ = ["MiniApiServer"]
+__all__ = ["MiniApiServer", "PodChaos"]

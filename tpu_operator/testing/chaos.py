@@ -1,0 +1,71 @@
+"""Cluster-level chaos helpers for convergence tests.
+
+:mod:`~tpu_operator.client.chaos` injects faults into the *client stack*
+(call failures, wire truncation); this module injects faults into the
+*cluster state itself* — the chaos-monkey side of fault injection. The
+first user is the rolling-upgrade chaos e2e, which previously carried its
+own ad-hoc deletion thread.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Optional
+
+from ..client.errors import ApiError, NotFoundError
+from ..client.interface import Client
+
+
+class PodChaos:
+    """Background thread deleting random pods in a namespace at a fixed
+    cadence — the classic chaos monkey. Deterministic via ``seed``;
+    ``victim_count`` records the carnage so tests can assert the chaos
+    actually ran. Use as a context manager or start()/stop()."""
+
+    def __init__(self, client: Client, namespace: str,
+                 interval_s: float = 0.05, seed: int = 1729,
+                 label_selector: Optional[dict] = None):
+        self.client = client
+        self.namespace = namespace
+        self.interval_s = interval_s
+        self.label_selector = label_selector
+        self.victim_count = 0
+        self._rng = random.Random(seed)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                pods = self.client.list("v1", "Pod", self.namespace,
+                                        label_selector=self.label_selector)
+            except ApiError:
+                continue  # chaos must tolerate the chaos it causes
+            if not pods:
+                continue
+            victim = self._rng.choice(pods)
+            try:
+                self.client.delete("v1", "Pod",
+                                   victim["metadata"]["name"],
+                                   self.namespace)
+                self.victim_count += 1
+            except (NotFoundError, ApiError):
+                pass
+
+    def start(self) -> "PodChaos":
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="pod-chaos")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def __enter__(self) -> "PodChaos":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

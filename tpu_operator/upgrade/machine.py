@@ -108,6 +108,10 @@ class UpgradeStateMachine:
         self.namespace = namespace
         self.policy = policy or UpgradePolicySpec()
         self._now = now  # injectable clock for timeout tests
+        #: smallest server-requested ``Retry-After`` seen this sweep (PDB-
+        #: blocked evictions carry one): the controller requeues the next
+        #: sweep after exactly this instead of the full planned period
+        self.retry_after_hint: Optional[float] = None
 
     # -- cluster inspection ---------------------------------------------------
     def _pods_on(self, node_name: str, component: Optional[str] = None,
@@ -317,7 +321,11 @@ class UpgradeStateMachine:
             self.client.evict(pod["metadata"]["name"],
                               pod["metadata"].get("namespace"))
             return True
-        except TooManyRequestsError:
+        except TooManyRequestsError as e:
+            retry_after = getattr(e, "retry_after", None)
+            if retry_after is not None and (self.retry_after_hint is None
+                                            or retry_after < self.retry_after_hint):
+                self.retry_after_hint = retry_after
             return False
         except NotFoundError:
             return True
