@@ -14,6 +14,7 @@ from tpu_operator.client.errors import (
     ApiError,
     BreakerOpenError,
     ConflictError,
+    DeadlineExceededError,
     NotFoundError,
     TooManyRequestsError,
     is_transient,
@@ -128,6 +129,8 @@ def test_is_transient_classification():
     assert not is_transient(ApiError("bad request", 400))
     # the breaker's own short-circuit must never feed back into a retry loop
     assert not is_transient(BreakerOpenError("open", retry_in=1.0))
+    # client-side throttling is not an apiserver 5xx, despite the 504 code
+    assert not is_transient(DeadlineExceededError("limiter deadline"))
     assert not is_transient(ValueError("not an api error"))
 
 
@@ -179,9 +182,12 @@ def test_token_bucket_respects_deadline():
     clock = FakeClock()
     bucket = TokenBucket(qps=0.1, burst=1, clock=clock, sleep=clock.sleep)
     bucket.acquire()
-    with pytest.raises(ApiError) as exc:  # next token is 10s away
+    with pytest.raises(DeadlineExceededError) as exc:  # next token is 10s away
         bucket.acquire(max_wait=1.0)
     assert exc.value.code == 504
+    # a dedicated type, NOT a transient apiserver failure: retry layers and
+    # metrics must not misattribute local throttling as a server-side 5xx
+    assert not is_transient(exc.value)
 
 
 # -- retry policy --------------------------------------------------------------
@@ -241,6 +247,19 @@ def test_breaker_failed_probe_reopens():
     snap = breaker.snapshot()
     assert snap["opened_total"] == 2
     assert snap["retry_in_s"] > 0
+
+
+def test_breaker_probe_aborted_releases_slot():
+    clock = FakeClock()
+    breaker = CircuitBreaker(threshold=1, cooldown_s=5.0, clock=clock)
+    breaker.record_failure()
+    clock.t += 6.0
+    breaker.before_call()  # this caller becomes the probe...
+    breaker.probe_aborted()  # ...but its call never reached the server
+    assert breaker.state == HALF_OPEN
+    breaker.before_call()  # next caller takes over the probe slot
+    breaker.record_success()
+    assert breaker.state == CLOSED
 
 
 def test_breaker_state_change_hook():
@@ -352,6 +371,88 @@ def test_429_does_not_trip_breaker():
     # 8 consecutive 429s and the breaker never budged: the server is alive
     # and prioritizing, which is the opposite of an outage
     assert client.breaker.state == CLOSED
+
+
+def test_429_during_half_open_probe_settles_breaker():
+    """Regression: a recovering apiserver commonly answers 429 first. The
+    probe's 429 must settle the breaker (a 429 proves the server is alive),
+    not leave the probe slot dangling so every later call self-rejects with
+    'probe in flight' until the operator is restarted."""
+    clock = FakeClock()
+    breaker = CircuitBreaker(threshold=1, cooldown_s=5.0, clock=clock)
+    breaker.record_failure()
+    assert breaker.state == OPEN
+    clock.t += 6.0  # cooldown over: the next call becomes the probe
+    inner = ScriptedInner(TooManyRequestsError("recovering", retry_after=0.5),
+                          {"ok": True})
+    client = make_client(inner, clock=clock, breaker=breaker)
+    assert client.get("v1", "Pod", "p")["ok"]  # probe gets 429, retry lands
+    assert breaker.state == CLOSED
+    assert clock.sleeps == [0.5]  # waited exactly the server's hint
+    client.get("v1", "Pod", "p")  # and the breaker keeps admitting calls
+    assert inner.calls == 3
+
+
+def test_evict_429_during_half_open_probe_settles_breaker():
+    """The evict path re-raises 429 immediately (retry_429=False); during a
+    half-open probe that immediate exit must still settle the breaker."""
+    clock = FakeClock()
+    breaker = CircuitBreaker(threshold=1, cooldown_s=5.0, clock=clock)
+    breaker.record_failure()
+    clock.t += 6.0
+    inner = ScriptedInner(TooManyRequestsError("PDB", retry_after=7.0),
+                          {"ok": True})
+    client = make_client(inner, clock=clock, breaker=breaker)
+    with pytest.raises(TooManyRequestsError):
+        client.evict("pod-1", "ns")
+    assert breaker.state == CLOSED  # the 429 verdict proves the server lives
+    client.get("v1", "Pod", "p")  # no wedge: calls keep flowing
+    assert inner.calls == 2
+
+
+def test_limiter_deadline_during_probe_releases_slot():
+    """A probe that dies on the client-side rate limiter never reached the
+    server: no verdict, but the probe slot must be released so the next
+    caller can become the probe instead of everyone self-rejecting."""
+    clock = FakeClock()
+    breaker = CircuitBreaker(threshold=1, cooldown_s=5.0, clock=clock)
+    breaker.record_failure()
+    clock.t += 6.0
+    limiter = TokenBucket(qps=0.001, burst=1, clock=clock, sleep=clock.sleep)
+    limiter.acquire()  # drain: next token is 1000s away, past any deadline
+    inner = ScriptedInner({"ok": True})
+    client = make_client(inner, clock=clock, breaker=breaker, limiter=limiter)
+    with pytest.raises(DeadlineExceededError):
+        client.get("v1", "Pod", "p")
+    assert breaker.state == HALF_OPEN  # no verdict — but the slot is free
+    clock.t += 2000.0  # bucket refilled
+    assert client.get("v1", "Pod", "p")["ok"]  # next caller probes fine
+    assert breaker.state == CLOSED
+
+
+def test_open_breaker_short_circuits_before_limiter():
+    """While the breaker is open, a call must fail fast: it must not park
+    on the token bucket nor drain tokens for requests that never go out."""
+    clock = FakeClock()
+    breaker = CircuitBreaker(threshold=1, cooldown_s=60.0, clock=clock)
+    breaker.record_failure()
+
+    class CountingBucket(TokenBucket):
+        def __init__(self, *a, **k):
+            super().__init__(*a, **k)
+            self.acquires = 0
+
+        def acquire(self, max_wait=None):
+            self.acquires += 1
+            return super().acquire(max_wait)
+
+    limiter = CountingBucket(qps=1.0, burst=1, clock=clock, sleep=clock.sleep)
+    client = make_client(ScriptedInner(), clock=clock, breaker=breaker,
+                         limiter=limiter)
+    with pytest.raises(BreakerOpenError):
+        client.get("v1", "Pod", "p")
+    assert limiter.acquires == 0
+    assert clock.sleeps == []
 
 
 def test_semantic_answer_resets_breaker_streak():

@@ -2,6 +2,7 @@ from .errors import (
     ApiError,
     BreakerOpenError,
     ConflictError,
+    DeadlineExceededError,
     KindNotServedError,
     NotFoundError,
     TooManyRequestsError,
@@ -15,6 +16,7 @@ __all__ = [
     "ApiError",
     "BreakerOpenError",
     "ConflictError",
+    "DeadlineExceededError",
     "KindNotServedError",
     "NotFoundError",
     "TooManyRequestsError",

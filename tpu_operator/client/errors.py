@@ -49,6 +49,17 @@ class TooManyRequestsError(ApiError):
         self.retry_after = retry_after
 
 
+class DeadlineExceededError(ApiError):
+    """A client-side deadline expired before the request could go out —
+    e.g. the rate limiter's token wait would overrun the logical call
+    deadline. Code 504 by HTTP analogy only: the condition is local
+    throttling, not an apiserver failure, so it is explicitly NOT
+    transient (the deadline that produced it is already spent) and must
+    never be attributed to the server by metrics/log consumers."""
+
+    code = 504
+
+
 class ConflictError(ApiError):
     code = 409
 
@@ -78,7 +89,7 @@ def is_transient(exc: BaseException) -> bool:
     server-side 5xx, and transport-level failures; False for 4xx semantics
     (absent, conflicting, invalid — retrying cannot change the answer) and
     for the breaker's own short-circuit."""
-    if isinstance(exc, BreakerOpenError):
+    if isinstance(exc, (BreakerOpenError, DeadlineExceededError)):
         return False
     if isinstance(exc, TooManyRequestsError):
         return True

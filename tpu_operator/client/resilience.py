@@ -20,7 +20,9 @@ hand-rolled, so the same discipline lives here as one wrapper:
   loop cannot stampede the apiserver even before the server-side limiter
   pushes back.
 * **Circuit breaker with degraded mode** — after ``threshold`` consecutive
-  hard failures (5xx/transport; 429 means the server is alive) the breaker
+  hard failures (5xx/transport; a 429 proves the server is alive, so it
+  counts as breaker success — resetting the streak and settling a
+  half-open probe) the breaker
   opens: non-watch calls short-circuit locally with
   :class:`~.errors.BreakerOpenError` instead of piling onto a struggling
   server. After ``cooldown_s`` it half-opens, letting exactly one probe
@@ -43,12 +45,11 @@ import threading
 import time
 from typing import Callable, List, Optional
 
-import requests
-
 from .. import tracing
 from .errors import (
     ApiError,
     BreakerOpenError,
+    DeadlineExceededError,
     TooManyRequestsError,
     is_transient,
 )
@@ -115,9 +116,9 @@ class TokenBucket:
                     return waited
                 need = (1.0 - self._tokens) / self.qps
             if max_wait is not None and waited + need > max_wait:
-                raise ApiError(
+                raise DeadlineExceededError(
                     f"client-side rate limiter: waiting {need:.2f}s for a "
-                    f"token would exceed the call deadline", 504)
+                    f"token would exceed the call deadline")
             self._sleep(need)
             waited += need
 
@@ -207,6 +208,17 @@ class CircuitBreaker:
             if self._state != CLOSED:
                 self._transition_locked(CLOSED)
 
+    def probe_aborted(self) -> None:
+        """Release the probe slot without a verdict: the call admitted by
+        :meth:`before_call` never produced an answer from the server (rate-
+        limiter deadline, a nested breaker's short-circuit, or an exception
+        escaping between the gate and the wire call). State is left as-is —
+        if half-open, the next caller simply becomes the probe. Without
+        this, an unclassified escape would leave ``_probe_inflight`` stuck
+        and every future call rejected until restart."""
+        with self._lock:
+            self._probe_inflight = False
+
     def record_failure(self) -> None:
         with self._lock:
             self._consecutive_failures += 1
@@ -264,56 +276,82 @@ class RetryingClient(Client):
         deadline = self._clock() + self.policy.deadline_s
         attempt = 1
         while True:
-            waited = self.limiter.acquire(
-                max_wait=max(0.0, deadline - self._clock()))
-            if waited > 0 and self.on_throttle is not None:
-                try:
-                    self.on_throttle(waited)
-                except Exception:
-                    pass
+            # Breaker gate BEFORE the rate limiter: while the breaker is
+            # open a call must short-circuit immediately — parking on the
+            # token bucket (up to the whole deadline) and draining tokens
+            # for requests that never go out would defeat the point of
+            # short-circuiting locally.
             self.breaker.before_call()
+            # Every admitted call must hand the breaker a verdict
+            # (record_success / record_failure); any path escaping without
+            # one — limiter deadline, a nested breaker's short-circuit, an
+            # unexpected exception — releases the probe slot in the
+            # ``finally`` below, else a half-open probe would wedge the
+            # breaker and reject every future call until restart.
+            settled = False
             try:
-                if attempt == 1:
-                    result = fn()
-                else:
-                    # retried attempts show up in reconcile traces as their
-                    # own spans wrapping the inner api span — a trace of a
-                    # flaky apiserver reads attempt-by-attempt
-                    with tracing.span("api.retry", kind="api", verb=verb,
-                                      attempt=attempt):
+                waited = self.limiter.acquire(
+                    max_wait=max(0.0, deadline - self._clock()))
+                if waited > 0 and self.on_throttle is not None:
+                    try:
+                        self.on_throttle(waited)
+                    except Exception:
+                        pass
+                try:
+                    if attempt == 1:
                         result = fn()
-            except Exception as e:  # noqa: BLE001 - classified below
-                transient = is_transient(e)
-                # 429 means the server is alive and prioritizing — only
-                # hard failures (5xx, transport) count toward the breaker
-                if transient and not isinstance(e, TooManyRequestsError):
-                    self.breaker.record_failure()
-                elif not transient and not isinstance(e, BreakerOpenError):
-                    self.breaker.record_success()  # the server answered
-                if not transient or (not retry_429
-                                     and isinstance(e, TooManyRequestsError)):
-                    raise
-                if attempt >= self.policy.max_attempts:
-                    raise
-                retry_after = getattr(e, "retry_after", None)
-                delay = (retry_after if retry_after is not None
-                         else self.policy.backoff(attempt, self._rng))
-                if self._clock() + delay > deadline:
-                    raise
-                reason = self._reason(e)
-                self._notify_retry(verb, reason)
-                sp = tracing.current_span()
-                if sp is not None:
-                    sp.set_attributes(retries=attempt,
-                                      last_retry_reason=reason)
-                log.debug("api %s transient failure (%s); retry %d/%d in "
-                          "%.2fs", verb, reason, attempt,
-                          self.policy.max_attempts - 1, delay)
-                self._sleep(delay)
-                attempt += 1
-                continue
-            self.breaker.record_success()
-            return result
+                    else:
+                        # retried attempts show up in reconcile traces as
+                        # their own spans wrapping the inner api span — a
+                        # trace of a flaky apiserver reads attempt-by-attempt
+                        with tracing.span("api.retry", kind="api", verb=verb,
+                                          attempt=attempt):
+                            result = fn()
+                except Exception as e:  # noqa: BLE001 - classified below
+                    transient = is_transient(e)
+                    if isinstance(e, TooManyRequestsError):
+                        # 429 proves the server is alive and prioritizing —
+                        # the opposite of an outage. It resets the failure
+                        # streak and, crucially, settles a half-open probe
+                        # (a recovering apiserver commonly answers 429
+                        # first; wedging on it would reject every call
+                        # until restart).
+                        self.breaker.record_success()
+                        settled = True
+                    elif transient:  # hard failures: 5xx, transport
+                        self.breaker.record_failure()
+                        settled = True
+                    elif not isinstance(e, BreakerOpenError):
+                        self.breaker.record_success()  # the server answered
+                        settled = True
+                    if not transient or (not retry_429 and
+                                         isinstance(e, TooManyRequestsError)):
+                        raise
+                    if attempt >= self.policy.max_attempts:
+                        raise
+                    retry_after = getattr(e, "retry_after", None)
+                    delay = (retry_after if retry_after is not None
+                             else self.policy.backoff(attempt, self._rng))
+                    if self._clock() + delay > deadline:
+                        raise
+                    reason = self._reason(e)
+                    self._notify_retry(verb, reason)
+                    sp = tracing.current_span()
+                    if sp is not None:
+                        sp.set_attributes(retries=attempt,
+                                          last_retry_reason=reason)
+                    log.debug("api %s transient failure (%s); retry %d/%d in "
+                              "%.2fs", verb, reason, attempt,
+                              self.policy.max_attempts - 1, delay)
+                    self._sleep(delay)
+                    attempt += 1
+                    continue
+                self.breaker.record_success()
+                settled = True
+                return result
+            finally:
+                if not settled:
+                    self.breaker.probe_aborted()
 
     # -- reads -----------------------------------------------------------------
     def get(self, api_version, kind, name, namespace=None) -> dict:
