@@ -10,6 +10,19 @@ test:
 e2e:
 	bash tests/scripts/end-to-end.sh
 
+.PHONY: lint
+lint:  ## ruff (when installed) then opalint; fails on any non-baselined finding
+	@if command -v ruff >/dev/null 2>&1; then \
+		ruff check .; \
+	else \
+		echo "lint: ruff not installed; skipping (opalint still runs)"; \
+	fi
+	$(PYTHON) -m tpu_operator.cmd.lint
+
+.PHONY: lint-baseline
+lint-baseline:  ## regenerate .opalint-baseline.json from the current tree (deliberate act — review the diff)
+	$(PYTHON) -m tpu_operator.cmd.lint --write-baseline
+
 CHAOS_SEED ?= 1729
 
 .PHONY: chaos

@@ -3,8 +3,6 @@ validator/main.go:694-708): GKE TPU nodes arrive with libtpu preinstalled
 and Google's device plugin already advertising google.com/tpu — the
 operator must adopt, not fight, that stack."""
 
-import pytest
-
 from tpu_operator import consts
 from tpu_operator.api.clusterpolicy import ClusterPolicy, new_cluster_policy
 from tpu_operator.controllers.clusterpolicy_controller import ClusterPolicyReconciler

@@ -1,0 +1,571 @@
+"""opalint (tpu_operator.analysis): per-rule positive/negative/suppressed
+fixtures, suppression mechanics, baseline round-trip, CLI exit codes, and a
+regression gate that the real tree stays clean under the committed baseline.
+"""
+
+import ast
+import io
+import json
+import os
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from tpu_operator.analysis import baseline as baseline_mod
+from tpu_operator.analysis.core import (
+    FileContext,
+    LintConfig,
+    all_checkers,
+    apply_suppressions,
+    suppressions,
+)
+from tpu_operator.analysis.runner import main, run
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+def lint(src, relpath, rule, docs_text=None):
+    """(kept, dropped) findings of one rule over one in-memory file."""
+    src = textwrap.dedent(src)
+    ctx = FileContext(relpath, src, ast.parse(src), LintConfig(docs_text=docs_text))
+    found = list(all_checkers()[rule]().check(ctx))
+    return apply_suppressions(found, suppressions(src))
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# -- lock-discipline ----------------------------------------------------------
+
+LOCKED_CLASS = """
+    import threading
+
+    class Pool:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.items = []
+
+        def add(self, x):
+            with self._lock:
+                self.items.append(x)
+
+        def drain(self):
+            {drain_body}
+"""
+
+
+def test_lock_discipline_positive():
+    src = LOCKED_CLASS.format(drain_body="self.items = []")
+    kept, _ = lint(src, "controllers/pool.py", "lock-discipline")
+    assert rules_of(kept) == ["lock-discipline"]
+    assert "Pool.items" in kept[0].message
+
+
+def test_lock_discipline_negative_guarded_and_init():
+    src = LOCKED_CLASS.format(
+        drain_body="with self._lock:\n                self.items = []")
+    kept, _ = lint(src, "controllers/pool.py", "lock-discipline")
+    assert kept == []  # guarded everywhere; __init__ write exempt
+
+
+def test_lock_discipline_negative_locked_suffix_convention():
+    # *_locked methods are callee-side lock-held by convention: they build
+    # the guard map without being flagged themselves
+    src = """
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.items = []
+
+            def add(self, x):
+                with self._lock:
+                    self._add_locked(x)
+
+            def _add_locked(self, x):
+                self.items.append(x)
+    """
+    kept, _ = lint(src, "controllers/pool.py", "lock-discipline")
+    assert kept == []
+
+
+def test_lock_discipline_unguarded_vs_locked_method_flagged():
+    # a plain method writing a field that *_locked methods guard IS flagged
+    src = """
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.items = []
+
+            def _add_locked(self, x):
+                self.items.append(x)
+
+            def reset(self):
+                self.items = []
+    """
+    kept, _ = lint(src, "controllers/pool.py", "lock-discipline")
+    assert rules_of(kept) == ["lock-discipline"]
+    assert "caller-held lock" in kept[0].message
+
+
+def test_lock_discipline_suppressed():
+    src = LOCKED_CLASS.format(
+        drain_body="self.items = []  # opalint: disable=lock-discipline — drained post-join")
+    kept, dropped = lint(src, "controllers/pool.py", "lock-discipline")
+    assert kept == [] and dropped == 1
+
+
+# -- api-bypass ---------------------------------------------------------------
+
+def test_api_bypass_positive_requests_and_restclient():
+    src = """
+        import requests
+        from tpu_operator.client.rest import RestClient
+
+        def refresh(url):
+            requests.get(url, timeout=5)
+            return RestClient()
+    """
+    kept, _ = lint(src, "controllers/sync.py", "api-bypass")
+    assert rules_of(kept) == ["api-bypass", "api-bypass"]
+
+
+def test_api_bypass_negative_client_cmd_and_exception_types():
+    src = "import requests\nrequests.get('u', timeout=5)\n"
+    kept, _ = lint(src, "client/rest.py", "api-bypass")
+    assert kept == []  # the stack itself is the allowed zone
+
+    src = "RestClient(base_url='u')\n"
+    kept, _ = lint(src, "cmd/operator.py", "api-bypass")
+    assert kept == []  # composition roots may construct the raw client
+
+    src = """
+        import requests
+
+        def fetch(call):
+            try:
+                return call()
+            except requests.RequestException:
+                return None
+    """
+    kept, _ = lint(src, "validator/workload.py", "api-bypass")
+    assert kept == []  # exception-type references are not calls
+
+
+def test_api_bypass_suppressed():
+    src = "RestClient()  # opalint: disable=api-bypass — wrapped on the next line\n"
+    kept, dropped = lint(src, "validator/main.py", "api-bypass")
+    assert kept == [] and dropped == 1
+
+
+# -- blocking-call ------------------------------------------------------------
+
+def test_blocking_call_positive():
+    src = """
+        import time
+        import urllib.request
+
+        def reconcile(req, thread):
+            time.sleep(1.0)
+            thread.join()
+            urllib.request.urlopen("http://kubelet/healthz")
+    """
+    kept, _ = lint(src, "controllers/runtime.py", "blocking-call")
+    assert rules_of(kept) == ["blocking-call"] * 3
+
+
+def test_blocking_call_negative_bounded_and_out_of_scope():
+    src = """
+        import urllib.request
+
+        def reconcile(req, thread, evt, parts):
+            thread.join(timeout=5.0)
+            evt.wait(2.0)
+            urllib.request.urlopen("http://kubelet/healthz", timeout=3)
+            return ",".join(parts)
+    """
+    kept, _ = lint(src, "state/driver.py", "blocking-call")
+    assert kept == []  # bounded waits + str.join are all fine
+
+    src = "import time\ntime.sleep(5)\n"
+    kept, _ = lint(src, "validator/perf.py", "blocking-call")
+    assert kept == []  # validator is not a reconcile path
+
+
+def test_blocking_call_suppressed():
+    src = "import time\ntime.sleep(1)  # opalint: disable=blocking-call — test helper\n"
+    kept, dropped = lint(src, "controllers/runtime.py", "blocking-call")
+    assert kept == [] and dropped == 1
+
+
+# -- exception-hygiene --------------------------------------------------------
+
+def test_exception_hygiene_positive():
+    src = """
+        def a(call):
+            try:
+                call()
+            except:
+                return None
+
+        def b(call):
+            try:
+                call()
+            except Exception:
+                pass
+    """
+    kept, _ = lint(src, "validator/driver.py", "exception-hygiene")
+    assert rules_of(kept) == ["exception-hygiene"] * 2
+    assert "bare" in kept[0].message
+
+
+def test_exception_hygiene_negative():
+    src = """
+        import logging
+
+        def a(call):
+            try:
+                call()
+            except KeyError:
+                pass  # narrow swallow is idiomatic
+
+        def b(call):
+            try:
+                call()
+            except Exception:
+                logging.exception("call failed")
+    """
+    kept, _ = lint(src, "validator/driver.py", "exception-hygiene")
+    assert kept == []
+
+
+def test_exception_hygiene_suppressed():
+    src = """
+        def a(call):
+            try:
+                call()
+            except Exception:  # opalint: disable=exception-hygiene — telemetry guard
+                pass
+    """
+    kept, dropped = lint(src, "validator/driver.py", "exception-hygiene")
+    assert kept == [] and dropped == 1
+
+
+# -- breaker-swallow ----------------------------------------------------------
+
+def test_breaker_swallow_positive():
+    src = """
+        import logging
+
+        def sync(state):
+            try:
+                state.sync()
+            except Exception as e:
+                logging.warning("state failed: %s", e)
+    """
+    kept, _ = lint(src, "state/manager.py", "breaker-swallow")
+    assert rules_of(kept) == ["breaker-swallow"]
+
+
+def test_breaker_swallow_negative_sibling_reraise_and_path():
+    src = """
+        import logging
+        from tpu_operator.client.errors import BreakerOpenError
+
+        def sync(state):
+            try:
+                state.sync()
+            except BreakerOpenError:
+                raise
+            except Exception as e:
+                logging.warning("state failed: %s", e)
+    """
+    kept, _ = lint(src, "state/manager.py", "breaker-swallow")
+    assert kept == []  # sibling handler surfaces the breaker
+
+    src = """
+        def sync(state):
+            try:
+                state.sync()
+            except Exception:
+                raise
+    """
+    kept, _ = lint(src, "controllers/runtime.py", "breaker-swallow")
+    assert kept == []  # re-raising broad handler propagates it
+
+    src = """
+        def sync(state):
+            try:
+                state.sync()
+            except Exception:
+                return None
+    """
+    kept, _ = lint(src, "validator/main.py", "breaker-swallow")
+    assert kept == []  # outside reconcile paths the rule is silent
+
+
+def test_breaker_swallow_suppressed():
+    src = """
+        def sync(state):
+            try:
+                state.sync()
+            except Exception:  # opalint: disable=breaker-swallow — elector must survive
+                return None
+    """
+    kept, dropped = lint(src, "controllers/leader.py", "breaker-swallow")
+    assert kept == [] and dropped == 1
+
+
+# -- metrics-discipline -------------------------------------------------------
+
+def test_metrics_discipline_positive():
+    src = """
+        from prometheus_client import Counter
+
+        ERRS = Counter("reconcile_errors", "doc", ["pod"])
+    """
+    kept, _ = lint(src, "controllers/metrics.py", "metrics-discipline",
+                   docs_text="nothing documented here")
+    msgs = " | ".join(f.message for f in kept)
+    assert len(kept) == 3  # no registry=, undocumented, unbounded label
+    assert "registry=" in msgs
+    assert "reconcile_errors_total" in msgs  # counter exposition suffix
+    assert "'pod'" in msgs
+
+
+def test_metrics_discipline_negative():
+    src = """
+        import collections
+        from prometheus_client import CollectorRegistry, Counter, Gauge
+
+        REG = CollectorRegistry()
+        ERRS = Counter("reconcile_errors", "doc", ["controller"], registry=REG)
+        UP = Gauge("operator_up", "doc", registry=REG)
+        COUNTS = collections.Counter("abc")
+    """
+    docs = "| `reconcile_errors_total` | ... | | `operator_up` | ... |"
+    kept, _ = lint(src, "controllers/metrics.py", "metrics-discipline",
+                   docs_text=docs)
+    assert kept == []  # registered, documented, bounded; collections.Counter ignored
+
+
+def test_metrics_discipline_dynamic_name_skips_doc_check():
+    src = """
+        from prometheus_client import CollectorRegistry, Gauge
+
+        def make(reg, name):
+            return Gauge(name, "doc", registry=reg)
+    """
+    kept, _ = lint(src, "validator/telemetry.py", "metrics-discipline",
+                   docs_text="no families documented")
+    assert kept == []
+
+
+def test_metrics_discipline_no_docs_text_disables_doc_check_only():
+    src = """
+        from prometheus_client import Counter
+
+        ERRS = Counter("reconcile_errors", "doc")
+    """
+    kept, _ = lint(src, "controllers/metrics.py", "metrics-discipline",
+                   docs_text=None)
+    assert rules_of(kept) == ["metrics-discipline"]  # registry check still applies
+    assert "registry=" in kept[0].message
+
+
+def test_metrics_discipline_suppressed():
+    src = """
+        from prometheus_client import Counter
+
+        ERRS = Counter("x", "doc")  # opalint: disable=metrics-discipline — scratch registry
+    """
+    kept, dropped = lint(src, "controllers/metrics.py", "metrics-discipline",
+                         docs_text="")
+    assert kept == [] and dropped == 2
+
+
+# -- suppression mechanics ----------------------------------------------------
+
+def test_suppression_comment_only_line_targets_next_line():
+    src = ("# opalint: disable=exception-hygiene — guard explained here\n"
+           "try:\n"
+           "    pass\n"
+           "except Exception:\n"
+           "    pass\n")
+    sup = suppressions(src)
+    assert sup == {2: {"exception-hygiene"}}
+
+
+def test_suppression_multiple_rules_and_all():
+    sup = suppressions("x = 1  # opalint: disable=api-bypass,blocking-call\n"
+                       "y = 2  # opalint: disable=all\n")
+    assert sup[1] == {"api-bypass", "blocking-call"}
+    assert sup[2] == {"all"}
+
+
+# -- baseline round-trip ------------------------------------------------------
+
+def _tree(tmp_path, files):
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return tmp_path
+
+
+BAD_SYNC = """
+    import time
+
+    def reconcile(req):
+        time.sleep(1.0)
+"""
+
+
+def test_baseline_round_trip(tmp_path):
+    root = _tree(tmp_path, {"tpu_operator/controllers/sync.py": BAD_SYNC})
+    findings, _, nfiles = run(str(root), ["tpu_operator"])
+    assert nfiles == 1 and rules_of(findings) == ["blocking-call"]
+
+    bl_path = str(root / ".opalint-baseline.json")
+    baseline_mod.save(bl_path, findings)
+    loaded = baseline_mod.load(bl_path)
+    new, baselined, stale = baseline_mod.apply(findings, loaded)
+    assert new == [] and baselined == 1 and stale == []
+
+    # a NEW finding is reported even with the old one grandfathered
+    (root / "tpu_operator/controllers/sync.py").write_text(textwrap.dedent("""
+        import time
+
+        def reconcile(req, thread):
+            time.sleep(1.0)
+            thread.join()
+    """))
+    findings2, _, _ = run(str(root), ["tpu_operator"])
+    new, baselined, stale = baseline_mod.apply(findings2, loaded)
+    assert baselined == 1 and stale == []
+    assert [f.line_text for f in new] == ["thread.join()"]
+
+    # fixing the grandfathered finding surfaces a stale entry to prune
+    (root / "tpu_operator/controllers/sync.py").write_text(
+        "def reconcile(req):\n    return None\n")
+    findings3, _, _ = run(str(root), ["tpu_operator"])
+    new, baselined, stale = baseline_mod.apply(findings3, loaded)
+    assert new == [] and baselined == 0 and len(stale) == 1
+    assert stale[0]["rule"] == "blocking-call"
+
+
+def test_baseline_fingerprint_disambiguates_identical_lines(tmp_path):
+    root = _tree(tmp_path, {"tpu_operator/controllers/sync.py": """
+        import time
+
+        def reconcile(req):
+            time.sleep(1.0)
+            time.sleep(1.0)
+    """})
+    findings, _, _ = run(str(root), ["tpu_operator"])
+    pairs = baseline_mod.fingerprints(findings)
+    assert len(pairs) == 2
+    assert pairs[0][1] != pairs[1][1]  # same text, distinct occurrence index
+
+
+def test_baseline_version_mismatch_rejected(tmp_path):
+    p = tmp_path / ".opalint-baseline.json"
+    p.write_text(json.dumps({"version": 99, "findings": []}))
+    with pytest.raises(ValueError, match="unsupported version"):
+        baseline_mod.load(str(p))
+
+
+# -- CLI ----------------------------------------------------------------------
+
+POSITIVE_FIXTURES = {
+    "lock-discipline": ("tpu_operator/state/pool.py",
+                        LOCKED_CLASS.format(drain_body="self.items = []")),
+    "api-bypass": ("tpu_operator/controllers/sync.py",
+                   "import requests\n\nrequests.get('u', timeout=5)\n"),
+    "blocking-call": ("tpu_operator/controllers/sync.py", BAD_SYNC),
+    "exception-hygiene": ("tpu_operator/validator/x.py",
+                          "try:\n    pass\nexcept Exception:\n    pass\n"),
+    "breaker-swallow": ("tpu_operator/state/x.py", """
+        def sync(s):
+            try:
+                s.sync()
+            except Exception:
+                return None
+    """),
+    "metrics-discipline": ("tpu_operator/controllers/metrics.py", """
+        from prometheus_client import Counter
+
+        C = Counter("x", "doc")
+    """),
+}
+
+
+@pytest.mark.parametrize("rule", sorted(POSITIVE_FIXTURES))
+def test_cli_exits_nonzero_on_each_positive_fixture(rule, tmp_path):
+    rel, src = POSITIVE_FIXTURES[rule]
+    root = _tree(tmp_path, {rel: src})
+    out = io.StringIO()
+    assert main(["--root", str(root), "--no-baseline"], out=out) == 1
+    assert f"[{rule}]" in out.getvalue()
+
+
+def test_cli_clean_tree_exits_zero(tmp_path):
+    root = _tree(tmp_path, {
+        "tpu_operator/controllers/ok.py": "def reconcile(req):\n    return None\n"})
+    out = io.StringIO()
+    assert main(["--root", str(root)], out=out) == 0
+    assert "ok: 0 new finding(s)" in out.getvalue()
+
+
+def test_cli_write_baseline_then_clean(tmp_path):
+    root = _tree(tmp_path, {"tpu_operator/controllers/sync.py": BAD_SYNC})
+    out = io.StringIO()
+    assert main(["--root", str(root), "--write-baseline"], out=out) == 0
+    assert main(["--root", str(root)], out=out) == 0  # grandfathered
+    assert main(["--root", str(root), "--no-baseline"], out=out) == 1
+
+
+def test_cli_json_format(tmp_path):
+    root = _tree(tmp_path, {"tpu_operator/controllers/sync.py": BAD_SYNC})
+    out = io.StringIO()
+    assert main(["--root", str(root), "--no-baseline", "--format", "json"],
+                out=out) == 1
+    doc = json.loads(out.getvalue())
+    assert [f["rule"] for f in doc["findings"]] == ["blocking-call"]
+    assert doc["files"] == 1
+
+
+def test_cli_parse_error_is_a_finding(tmp_path):
+    root = _tree(tmp_path, {"tpu_operator/broken.py": "def oops(:\n"})
+    out = io.StringIO()
+    assert main(["--root", str(root), "--no-baseline"], out=out) == 1
+    assert "[parse-error]" in out.getvalue()
+
+
+def test_cli_rules_subset_and_unknown_rule(tmp_path):
+    root = _tree(tmp_path, {"tpu_operator/controllers/sync.py": BAD_SYNC})
+    out = io.StringIO()
+    assert main(["--root", str(root), "--no-baseline",
+                 "--rules", "api-bypass"], out=out) == 0  # sleep not in subset
+    assert main(["--root", str(root), "--rules", "no-such-rule"], out=out) == 2
+
+
+def test_cli_list_rules():
+    out = io.StringIO()
+    assert main(["--list-rules"], out=out) == 0
+    listed = {line.split(":")[0] for line in out.getvalue().splitlines()}
+    assert listed == set(POSITIVE_FIXTURES)
+
+
+def test_real_tree_clean_under_committed_baseline():
+    """The gate CI runs: the shipped tree must lint clean (inline
+    suppressions + committed baseline accounted for)."""
+    out = io.StringIO()
+    code = main(["--root", str(REPO_ROOT)], out=out)
+    assert code == 0, out.getvalue()
+    assert "0 stale baseline" in out.getvalue()

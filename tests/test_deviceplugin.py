@@ -2,7 +2,6 @@
 sockets (ListAndWatch stream, Allocate, Registration round-trip)."""
 
 import os
-import threading
 import time
 from concurrent import futures
 

@@ -12,7 +12,6 @@ end-to-end before CI ever points it at the genuine article.
 import json
 import os
 import subprocess
-import sys
 
 from tpu_operator.client.rest import RestClient
 from tpu_operator.testing import MiniApiServer

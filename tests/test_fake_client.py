@@ -1,6 +1,6 @@
 import pytest
 
-from tpu_operator.client import ConflictError, FakeClient, NotFoundError
+from tpu_operator.client import ConflictError, NotFoundError
 from tpu_operator.client.errors import AlreadyExistsError
 
 

@@ -4,7 +4,6 @@ live harness cluster and assert every section lands in the tarball."""
 import json
 import os
 import subprocess
-import sys
 import tarfile
 import threading
 

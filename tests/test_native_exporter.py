@@ -1,6 +1,5 @@
 """Build and drive the native tpu-exporter binary (native/tpu-exporter)."""
 
-import json
 import os
 import shutil
 import socket

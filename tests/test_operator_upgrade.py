@@ -210,7 +210,7 @@ def test_helm_upgrade_over_live_crs(cluster):
     rendered = helm.render_all()
     assert rendered, "chart rendered nothing"
     # apply like `helm upgrade`: create-or-update every rendered object
-    from tpu_operator.client.errors import AlreadyExistsError, NotFoundError
+    from tpu_operator.client.errors import AlreadyExistsError
     applied = 0
     for obj in rendered:
         if obj.get("kind") == "ClusterPolicy":

@@ -1,5 +1,3 @@
-import json
-
 import pytest
 
 from tpu_operator import consts
@@ -265,8 +263,6 @@ def test_sync_clear_removes_state_and_handoff(fake_client, config_path, tmp_path
 
 
 def test_cli_component(fake_client, config_path, tmp_path, monkeypatch):
-    from tpu_operator.validator.main import run as validator_run
-
     monkeypatch.setenv("NODE_NAME", "n1")
     mk_node(fake_client, config="v5e-2x2-pair")
     monkeypatch.setattr("tpu_operator.partitioner.partitioner.DEFAULT_HANDOFF_DIR",
@@ -304,8 +300,6 @@ def test_stale_handoff_from_old_version_recomputed(fake_client, config_path,
     groups, no grid) under the SAME partition name must be recomputed on
     upgrade — the success early-exit verifies content, not just the name,
     or the device plugin keeps advertising non-adjacent groups forever."""
-    from tpu_operator.partitioner.partitioner import write_handoff
-
     handoff = str(tmp_path / "handoff")
     mk_node(fake_client, config="v5e-2x2-pair", state="success")
     # old-version artifact: sequential fiction, no grid key

@@ -18,7 +18,7 @@ import yaml
 
 from tpu_operator.api import schema_gen, schema_validate
 from tpu_operator.api.clusterpolicy import ClusterPolicySpec, new_cluster_policy
-from tpu_operator.api.specbase import SpecBase, to_camel
+from tpu_operator.api.specbase import to_camel
 from tpu_operator.api.tpudriver import TPUDriverSpec, new_tpu_driver
 
 REPO = pathlib.Path(__file__).resolve().parent.parent

@@ -9,8 +9,6 @@ keys the schema knows about. Any drift between schema_gen, schema_validate
 and SpecBase shows up here as a counterexample.
 """
 
-import re
-
 from hypothesis import HealthCheck, given, settings
 from hypothesis import strategies as st
 

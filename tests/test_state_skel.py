@@ -1,5 +1,5 @@
 from tpu_operator import consts
-from tpu_operator.client import FakeClient, NotFoundError
+from tpu_operator.client import NotFoundError
 from tpu_operator.state import StateSkel, SyncState
 from tpu_operator.state.skel import is_daemonset_ready
 

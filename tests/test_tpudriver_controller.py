@@ -12,7 +12,6 @@ from tpu_operator.controllers.tpudriver_controller import (
 )
 from tpu_operator.state.nodepool import get_node_pools
 from tpu_operator.testing.kubelet import KubeletSimulator
-from tpu_operator.utils import deep_get
 
 
 @pytest.fixture(autouse=True)

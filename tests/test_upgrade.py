@@ -1,12 +1,9 @@
 from tpu_operator import consts
 from tpu_operator.api.clusterpolicy import new_cluster_policy
 from tpu_operator.api.common import UpgradePolicySpec
-from tpu_operator.client.errors import NotFoundError
-from tpu_operator.controllers.runtime import Request
 from tpu_operator.controllers.upgrade_controller import SINGLETON_REQUEST, UpgradeReconciler
 from tpu_operator.upgrade import UpgradeStateMachine, node_upgrade_state
 from tpu_operator.upgrade import machine as m
-from tpu_operator.utils import deep_get
 
 NS = "tpu-operator"
 

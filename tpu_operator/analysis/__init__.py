@@ -1,0 +1,35 @@
+"""opalint: AST-based operator invariant checking.
+
+The reference operator keeps its 26k-line concurrent control plane honest
+with Go's toolchain — ``go vet``, ``golangci-lint``, and the race detector.
+This package is the Python port's equivalent for the invariants that are
+*operator-specific* and therefore invisible to any generic linter:
+
+* every apiserver call routes through :class:`~..client.resilience.RetryingClient`
+  (``api-bypass``)
+* fields guarded by a lock somewhere are guarded everywhere (``lock-discipline``)
+* reconcile paths never sleep, join unboundedly, or issue timeout-less
+  network calls (``blocking-call``)
+* broad exception handlers never silently swallow — and reconcile paths
+  never swallow ``BreakerOpenError`` (``exception-hygiene``,
+  ``breaker-swallow``)
+* every metric is registered on an explicit registry, documented in
+  ``docs/operations.md``, and bounded-cardinality (``metrics-discipline``)
+
+Entry points: ``python -m tpu_operator.cmd.lint`` / ``make lint``.
+Inline suppression: ``# opalint: disable=<rule>[,<rule>...]`` on the
+flagged line (or alone on the line above). Grandfathered findings live in
+the committed ``.opalint-baseline.json``; regenerate it deliberately with
+``make lint-baseline``. See ``docs/static-analysis.md``.
+"""
+
+from .core import Checker, FileContext, Finding, LintConfig, all_checkers, register
+
+__all__ = [
+    "Checker",
+    "FileContext",
+    "Finding",
+    "LintConfig",
+    "all_checkers",
+    "register",
+]

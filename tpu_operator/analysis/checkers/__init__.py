@@ -1,0 +1,11 @@
+"""Checker registry population: importing this package registers every
+shipped rule. Add new checkers here."""
+
+from . import (  # noqa: F401
+    api_bypass,
+    blocking,
+    breaker_swallow,
+    exception_hygiene,
+    lock_discipline,
+    metrics_discipline,
+)

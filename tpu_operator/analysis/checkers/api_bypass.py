@@ -1,0 +1,57 @@
+"""api-bypass: every apiserver call routes through the client stack.
+
+The resilience contract (deadlines, retry budget, token-bucket limiter,
+circuit breaker — ``client/resilience.py``) only holds if nothing talks to
+the apiserver behind the stack's back. Direct ``requests`` HTTP calls and
+``RestClient`` construction outside the sanctioned layers are exactly the
+bypass that voids it.
+
+Allowed zones: ``client/`` (the stack itself) for everything; the ``cmd/``
+composition roots may additionally construct ``RestClient`` (they build the
+wrapper chain). Referencing ``requests`` exception types for handling
+(``except requests.RequestException``) is fine anywhere — only *calls* are
+flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import Checker, FileContext, Finding, register
+
+HTTP_VERBS = {"get", "post", "put", "patch", "delete", "head", "options",
+              "request"}
+
+
+@register
+class ApiBypass(Checker):
+    name = "api-bypass"
+    description = ("direct requests/RestClient use outside tpu_operator/"
+                   "client/ bypasses the resilience stack")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if ctx.is_client_code:
+            return
+        allow_restclient = ctx.in_dirs(ctx.config.entrypoint_dirs)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if (isinstance(func, ast.Attribute)
+                    and isinstance(func.value, ast.Name)
+                    and func.value.id == "requests"):
+                if func.attr in HTTP_VERBS or func.attr == "Session":
+                    yield ctx.finding(
+                        node, self,
+                        f"direct requests.{func.attr}() bypasses "
+                        f"RetryingClient (per-call deadline, retry budget, "
+                        f"rate limiter, circuit breaker); route apiserver "
+                        f"traffic through tpu_operator.client")
+            if (isinstance(func, ast.Name) and func.id == "RestClient"
+                    and not allow_restclient):
+                yield ctx.finding(
+                    node, self,
+                    "RestClient constructed outside client//cmd/: the raw "
+                    "client has no retry/limiter/breaker — build the stack "
+                    "via the cmd/ composition root or wrap in RetryingClient")

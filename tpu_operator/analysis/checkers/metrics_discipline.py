@@ -1,0 +1,142 @@
+"""metrics-discipline: registered, documented, bounded-cardinality metrics.
+
+Subsumes (and extends to the AST level) the invariant behind
+``tests/test_metrics_docs.py``: a metric an operator cannot look up in
+``docs/operations.md`` is a metric they cannot act on. Three checks per
+``prometheus_client`` metric instantiation:
+
+* **registered** — ``registry=`` must be explicit. A metric on the
+  process-global ``REGISTRY`` collides across tests and double-exports
+  when two components run in one process (the exact failure mode
+  ``OperatorMetrics``' dedicated registry exists to prevent).
+* **documented** — the exposition name (counters get ``_total``) must
+  appear in the operations doc. Only literal names are checkable;
+  dynamically-named metrics (the telemetry exporter's per-refresh gauges)
+  are skipped — their family tables are enforced by their own docs rows.
+* **bounded cardinality** — label names that identify an unbounded
+  population (uids, pods, requests, URLs, raw errors) explode Prometheus
+  series; aggregate or move the detail into traces/logs.
+
+Name resolution is import-aware: only names actually bound from
+``prometheus_client`` are treated as metric classes, so
+``collections.Counter`` never false-positives.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional, Set
+
+from ..core import (
+    Checker,
+    FileContext,
+    Finding,
+    has_double_star,
+    has_keyword,
+    register,
+)
+
+METRIC_CLASSES = {"Counter", "Gauge", "Histogram", "Summary", "Info", "Enum"}
+
+#: label names whose value space grows with cluster activity, not cluster
+#: shape — each unique value is a new series forever
+UNBOUNDED_LABELS = {"uid", "pod", "pod_name", "pod_uid", "container_id",
+                    "request", "request_id", "trace_id", "span_id",
+                    "timestamp", "ts", "message", "error", "path", "url",
+                    "ip", "address"}
+
+
+def _prometheus_bindings(tree: ast.Module) -> Set[str]:
+    """Local names bound to prometheus_client metric classes."""
+    bound: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module \
+                and node.module.split(".")[0] == "prometheus_client":
+            for alias in node.names:
+                if alias.name in METRIC_CLASSES:
+                    bound.add(alias.asname or alias.name)
+    return bound
+
+
+def _metric_class(call: ast.Call, bound: Set[str]) -> Optional[str]:
+    func = call.func
+    if isinstance(func, ast.Name) and func.id in bound:
+        return func.id
+    if isinstance(func, ast.Attribute) and func.attr in METRIC_CLASSES \
+            and isinstance(func.value, ast.Name) \
+            and func.value.id == "prometheus_client":
+        return func.attr
+    return None
+
+
+def _literal_str(node: Optional[ast.AST]) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _kwarg(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+@register
+class MetricsDiscipline(Checker):
+    name = "metrics-discipline"
+    description = ("metrics must pass registry=, be documented in docs/"
+                   "operations.md, and carry bounded-cardinality labels")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        bound = _prometheus_bindings(ctx.tree)
+        has_module_import = any(
+            isinstance(n, ast.Import) and any(
+                a.name == "prometheus_client" for a in n.names)
+            for n in ast.walk(ctx.tree))
+        if not bound and not has_module_import:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cls = _metric_class(node, bound)
+            if cls is None:
+                continue
+            name = _literal_str(node.args[0] if node.args else
+                                _kwarg(node, "name"))
+            label = f"{cls}({name!r})" if name else f"dynamically-named {cls}"
+
+            if not has_keyword(node, "registry") and not has_double_star(node):
+                yield ctx.finding(
+                    node, self,
+                    f"{label} lands in the process-global REGISTRY; pass an "
+                    f"explicit registry= (collides across tests and "
+                    f"co-resident components otherwise)")
+            if name is not None and ctx.config.docs_text is not None:
+                exposition = name
+                if cls == "Counter" and not name.endswith("_total"):
+                    exposition += "_total"
+                if exposition not in ctx.config.docs_text:
+                    yield ctx.finding(
+                        node, self,
+                        f"metric {exposition!r} is not documented in "
+                        f"docs/operations.md — add a row to the metrics "
+                        f"reference table (an operator cannot act on an "
+                        f"undocumented metric)")
+            yield from self._check_labels(ctx, node, label)
+
+    def _check_labels(self, ctx: FileContext, call: ast.Call,
+                      label: str) -> Iterator[Finding]:
+        labels_node = _kwarg(call, "labelnames")
+        if labels_node is None and len(call.args) >= 3:
+            labels_node = call.args[2]
+        if not isinstance(labels_node, (ast.List, ast.Tuple)):
+            return
+        for elt in labels_node.elts:
+            value = _literal_str(elt)
+            if value is not None and value.lower() in UNBOUNDED_LABELS:
+                yield ctx.finding(
+                    elt, self,
+                    f"{label} label {value!r} is unbounded-cardinality "
+                    f"(one series per {value} forever); aggregate it or "
+                    f"carry the detail in traces/logs instead")

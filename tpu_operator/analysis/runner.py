@@ -1,0 +1,188 @@
+"""opalint runner: walk a tree, run every checker, apply suppressions and
+the committed baseline, emit human or JSON output with CI exit codes.
+
+Exit codes: 0 = no non-baselined findings; 1 = findings (or unparseable
+source); 2 = usage/internal error. ``--write-baseline`` regenerates the
+grandfathered-findings file and always exits 0 — that regeneration is a
+deliberate act (``make lint-baseline``), reviewed like any other diff.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import sys
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from . import baseline as baseline_mod
+from .core import (
+    Checker,
+    FileContext,
+    Finding,
+    LintConfig,
+    all_checkers,
+    apply_suppressions,
+    suppressions,
+)
+
+DOCS_RELPATH = os.path.join("docs", "operations.md")
+#: path fragments never linted: generated protobuf code and caches
+SKIP_PARTS = ("__pycache__", os.path.join("deviceplugin", "proto"))
+
+
+def iter_py_files(root: str, paths: Iterable[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        full = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(full):
+            out.append(full)
+            continue
+        for dirpath, dirnames, filenames in os.walk(full):
+            dirnames.sort()
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+    return [f for f in out
+            if not any(part in f for part in SKIP_PARTS)]
+
+
+def lint_file(path: str, root: str, checkers: List[Checker],
+              config: LintConfig) -> Tuple[List[Finding], int]:
+    """(findings, suppressed_count) for one file."""
+    relpath = os.path.relpath(path, root).replace(os.sep, "/")
+    with open(path, encoding="utf-8") as fh:
+        src = fh.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [Finding(rule="parse-error", path=relpath,
+                        line=e.lineno or 1, col=(e.offset or 0) + 1,
+                        message=f"cannot parse: {e.msg}",
+                        line_text="")], 0
+    ctx = FileContext(relpath, src, tree, config)
+    found: List[Finding] = []
+    for checker in checkers:
+        found.extend(checker.check(ctx))
+    return apply_suppressions(found, suppressions(src))
+
+
+def run(root: str, paths: Iterable[str],
+        rules: Optional[Iterable[str]] = None,
+        docs_path: Optional[str] = None
+        ) -> Tuple[List[Finding], int, int]:
+    """(findings, suppressed_total, files_linted) over a tree."""
+    registry = all_checkers()
+    if rules is not None:
+        unknown = sorted(set(rules) - set(registry))
+        if unknown:
+            raise ValueError(f"unknown rule(s): {', '.join(unknown)} "
+                             f"(see --list-rules)")
+        registry = {k: v for k, v in registry.items() if k in set(rules)}
+    checkers = [cls() for _, cls in sorted(registry.items())]
+
+    docs_file = docs_path or os.path.join(root, DOCS_RELPATH)
+    docs_text = None
+    if os.path.exists(docs_file):
+        with open(docs_file, encoding="utf-8") as fh:
+            docs_text = fh.read()
+    config = LintConfig(root=root, docs_text=docs_text)
+
+    findings: List[Finding] = []
+    suppressed_total = 0
+    files = iter_py_files(root, paths)
+    for path in files:
+        found, suppressed = lint_file(path, root, checkers, config)
+        findings.extend(found)
+        suppressed_total += suppressed
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings, suppressed_total, len(files)
+
+
+# -- CLI ----------------------------------------------------------------------
+
+def _print_human(new: List[Finding], baselined: int, suppressed: int,
+                 stale: List[dict], nfiles: int, out) -> None:
+    for f in new:
+        print(f"{f.location()}: [{f.rule}] {f.message}", file=out)
+    for entry in stale:
+        print(f"note: stale baseline entry {entry['fingerprint']} "
+              f"({entry['rule']} at {entry['path']}): finding no longer "
+              f"present — run `make lint-baseline` to prune", file=out)
+    verdict = "FAIL" if new else "ok"
+    print(f"opalint: {verdict}: {len(new)} new finding(s), {baselined} "
+          f"baselined, {suppressed} suppressed, {len(stale)} stale baseline "
+          f"entr{'y' if len(stale) == 1 else 'ies'} across {nfiles} files",
+          file=out)
+
+
+def main(argv: Optional[List[str]] = None, out=None) -> int:
+    out = out or sys.stdout
+    parser = argparse.ArgumentParser(
+        prog="python -m tpu_operator.cmd.lint",
+        description="opalint: AST-based operator invariant checker")
+    parser.add_argument("paths", nargs="*", default=None,
+                        help="files/dirs to lint (default: tpu_operator)")
+    parser.add_argument("--root", default=".",
+                        help="project root (baseline + docs live here)")
+    parser.add_argument("--format", choices=("human", "json"),
+                        default="human")
+    parser.add_argument("--baseline", default=None,
+                        help=f"baseline file (default: "
+                             f"<root>/{baseline_mod.DEFAULT_BASELINE})")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore the baseline: report every finding")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="regenerate the baseline from current findings "
+                             "and exit 0 (deliberate act: make lint-baseline)")
+    parser.add_argument("--rules", default=None,
+                        help="comma-separated subset of rules to run")
+    parser.add_argument("--list-rules", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for name, cls in sorted(all_checkers().items()):
+            print(f"{name}: {cls.description}", file=out)
+        return 0
+
+    root = os.path.abspath(args.root)
+    paths = args.paths or ["tpu_operator"]
+    rules = ([r.strip() for r in args.rules.split(",") if r.strip()]
+             if args.rules else None)
+    try:
+        findings, suppressed, nfiles = run(root, paths, rules=rules)
+    except (ValueError, OSError) as e:
+        print(f"opalint: error: {e}", file=sys.stderr)
+        return 2
+
+    baseline_path = args.baseline or os.path.join(
+        root, baseline_mod.DEFAULT_BASELINE)
+    if args.write_baseline:
+        doc = baseline_mod.save(baseline_path, findings)
+        print(f"opalint: wrote {len(doc['findings'])} finding(s) to "
+              f"{baseline_path}", file=out)
+        return 0
+
+    baseline: Dict[str, dict] = {}
+    if not args.no_baseline:
+        try:
+            baseline = baseline_mod.load(baseline_path)
+        except (ValueError, json.JSONDecodeError) as e:
+            print(f"opalint: error: {e}", file=sys.stderr)
+            return 2
+    new, baselined, stale = baseline_mod.apply(findings, baseline)
+
+    if args.format == "json":
+        json.dump({
+            "findings": [f.to_dict() for f in new],
+            "baselined": baselined,
+            "suppressed": suppressed,
+            "stale_baseline": stale,
+            "files": nfiles,
+        }, out, indent=2)
+        print(file=out)
+    else:
+        _print_human(new, baselined, suppressed, stale, nfiles, out)
+    return 1 if new else 0

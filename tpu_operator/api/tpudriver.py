@@ -16,7 +16,7 @@ from typing import Any, Dict, List, Optional
 
 from .common import ComponentSpec, SpecValidationError, UpgradePolicySpec
 from .k8s_schemas import NODE_AFFINITY, TOLERATIONS
-from .specbase import SpecBase, spec_field
+from .specbase import spec_field
 
 TPU_DRIVER_API_VERSION = "tpu.ai/v1alpha1"
 TPU_DRIVER_KIND = "TPUDriver"

@@ -353,8 +353,13 @@ def status(base_url=None, namespace="tpu-operator", out=None,
     # apiserver that answered (403 RBAC, 404 CRDs-not-installed) as a
     # connectivity problem
     try:
-        client = (RestClient(base_url=base_url, token=token) if base_url
-                  else RestClient())
+        # raw RestClient by design: a triage CLI reads once and exits —
+        # fail-fast with the cluster's own answer beats a resilience layer
+        # retrying/masking it
+        if base_url:
+            client = RestClient(base_url=base_url, token=token)  # opalint: disable=api-bypass
+        else:
+            client = RestClient()  # opalint: disable=api-bypass
         return _status(client, namespace, out)
     except ApiError as e:
         hint = (" — check RBAC and that the tpu.ai CRDs are installed"

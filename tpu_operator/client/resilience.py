@@ -176,7 +176,7 @@ class CircuitBreaker:
         if hook is not None and old != new_state:
             try:
                 hook(old, new_state)
-            except Exception:  # telemetry must never break the request path
+            except Exception:  # opalint: disable=exception-hygiene — telemetry must never break the request path
                 pass
 
     # -- call protocol ---------------------------------------------------------
@@ -269,7 +269,7 @@ class RetryingClient(Client):
         if self.on_retry is not None:
             try:
                 self.on_retry(verb, reason)
-            except Exception:
+            except Exception:  # opalint: disable=exception-hygiene — telemetry must never break the request path
                 pass
 
     def _call(self, verb: str, fn: Callable, retry_429: bool = True):
@@ -295,7 +295,7 @@ class RetryingClient(Client):
                 if waited > 0 and self.on_throttle is not None:
                     try:
                         self.on_throttle(waited)
-                    except Exception:
+                    except Exception:  # opalint: disable=exception-hygiene — telemetry must never break the request path
                         pass
                 try:
                     if attempt == 1:

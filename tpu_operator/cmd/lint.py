@@ -1,0 +1,15 @@
+"""``python -m tpu_operator.cmd.lint`` / ``tpuop-lint`` — opalint CLI.
+
+The operator-invariant checker (`make lint`): lock discipline, API-bypass,
+blocking calls in reconcile paths, exception & metrics hygiene. See
+``tpu_operator/analysis/`` and ``docs/static-analysis.md``.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from ..analysis.runner import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -147,7 +147,7 @@ class LeaderElector:
             start = time.monotonic()
             try:
                 acquired = self.try_acquire_or_renew()
-            except Exception:
+            except Exception:  # opalint: disable=breaker-swallow — elector survives open breakers too; rationale below
                 # the elector thread must survive ANY apiserver failure
                 # (transport error, 500, 429): a dead elector is the worst
                 # outcome — a leader that reconciles forever without

@@ -284,7 +284,10 @@ def run_operator(args) -> int:
         format="%(asctime)s %(levelname)s %(name)s [trace=%(trace_id)s]: %(message)s")
     log.info("tpu-operator %s starting", __version__)
 
-    direct_client = RestClient(base_url=args.api_server, token=args.token,
+    # composition root: the one place the raw transport is built before being
+    # wrapped in the resilience layer just below (leases also borrow it, by
+    # design — see the elector comment)
+    direct_client = RestClient(base_url=args.api_server, token=args.token,  # opalint: disable=api-bypass
                                default_timeout=getattr(args, "api_timeout",
                                                        30.0))
     # resilience layer between the cache and the wire: retry/backoff for
@@ -347,7 +350,7 @@ def run_operator(args) -> int:
         app.start()
 
     log.info("controllers running; metrics :%s health :%s", args.metrics_port, args.health_port)
-    stop.wait()
+    stop.wait()  # opalint: disable=blocking-call — main thread parks until the shutdown signal; not a reconcile worker
     log.info("shutting down")
     if elector is not None:
         elector.release()

@@ -233,6 +233,15 @@ class Controller:
                 try:
                     for request in _spec.mapper(event):
                         self.queue.add(request)
+                except BreakerOpenError as e:
+                    # a mapper doing cached reads can hit an open breaker:
+                    # degraded mode, not a mapper bug — no stack trace, and
+                    # the periodic resync re-derives the dropped mapping
+                    # once the breaker closes
+                    log.warning("%s: watch mapper skipped (apiserver "
+                                "circuit open, retry in %.1fs); resync "
+                                "will recover", self.reconciler.name,
+                                e.retry_in or 0.0)
                 except Exception:
                     log.exception("%s: watch mapper failed", self.reconciler.name)
             self._handles.append(client.watch(spec.api_version, spec.kind, spec.namespace, handler))
@@ -249,6 +258,14 @@ class Controller:
             try:
                 for request in self._resync_fn():
                     self.queue.add(request)
+            except BreakerOpenError as e:
+                # degraded mode: the resync LIST short-circuited. Quiet
+                # skip — the next period retries, and log.exception here
+                # would page once per period for an outage the operator is
+                # already handling as designed
+                log.warning("%s: resync skipped (apiserver circuit open, "
+                            "retry in %.1fs)", self.reconciler.name,
+                            e.retry_in or 0.0)
             except Exception:
                 log.exception("%s: resync failed", self.reconciler.name)
 
@@ -345,11 +362,11 @@ class Controller:
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             if len(self.queue) == 0:
-                time.sleep(settle)
+                time.sleep(settle)  # opalint: disable=blocking-call — test helper, runs on the test's thread
                 if len(self.queue) == 0:
                     return True
             else:
-                time.sleep(0.01)
+                time.sleep(0.01)  # opalint: disable=blocking-call — test helper, runs on the test's thread
         return False
 
 

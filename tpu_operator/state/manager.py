@@ -9,9 +9,10 @@ from __future__ import annotations
 
 import dataclasses
 import logging
-from typing import Dict, List, Optional, Protocol
+from typing import List, Optional, Protocol
 
 from .. import tracing
+from ..client.errors import BreakerOpenError
 from .skel import SyncState
 
 log = logging.getLogger(__name__)
@@ -72,6 +73,14 @@ class Manager:
             with tracing.span(f"state.{state.name}", kind="state") as sp:
                 try:
                     result = state.sync(catalog)
+                except BreakerOpenError:
+                    # surfaced by opalint's breaker-swallow rule: folding
+                    # this into a StateResult ERROR made an open breaker
+                    # look like N failed states (error'd conditions, a
+                    # counted reconcile error, backoff growth) when NOTHING
+                    # this sweep does can land. Propagate: the runtime
+                    # worker requeues quietly after the breaker's cooldown.
+                    raise
                 except Exception as e:  # a state crash must not kill the sweep
                     log.exception("state %s errored", state.name)
                     result = StateResult(state.name, SyncState.ERROR, str(e))

@@ -25,7 +25,7 @@ import calendar
 import logging
 import os
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 from .. import consts, events
 from ..api.clusterpolicy import ClusterPolicy

@@ -13,7 +13,7 @@ import copy
 import enum
 import logging
 import os
-from typing import Dict, List, Optional
+from typing import List, Optional
 
 from .. import consts, events
 from ..client.errors import ApiError, ConflictError, KindNotServedError, NotFoundError

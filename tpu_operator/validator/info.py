@@ -10,12 +10,15 @@ barrier status, and measured throughput if perf validation has run.
 from __future__ import annotations
 
 import json
+import logging
 import os
 from typing import Optional
 
 from .. import consts
 from .driver import discover_devices, is_valid_libtpu, libtpu_path
 from .status import StatusFiles
+
+log = logging.getLogger(__name__)
 
 CHECK = "ok"
 MISS = "--"
@@ -77,11 +80,16 @@ def collect(install_dir: str = consts.DEFAULT_LIBTPU_DIR,
                     limit = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
                     if limit:
                         chip["hbm_total_bytes"] = limit
-                except Exception:
-                    pass
+                except Exception as e:
+                    # memory_stats is best-effort (not every backend/driver
+                    # serves it) but a silent pass here hid real breakage
+                    # too (opalint exception-hygiene); keep the chip row,
+                    # leave a trail
+                    log.debug("chip %s memory_stats unavailable: %s", d.id, e)
                 info["chips"].append(chip)
-        except Exception:
-            pass  # no runtime in this container: device nodes still shown
+        except Exception as e:
+            # no runtime in this container: device nodes still shown
+            log.debug("jax device enumeration unavailable: %s", e)
     return info
 
 

@@ -102,9 +102,19 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def make_client():
+    """Validator's apiserver client. Wrapped in the same resilience layer
+    the operator uses (surfaced by opalint's api-bypass rule: the raw
+    RestClient had no retry budget, so one 429/5xx blip failed a whole
+    validation cycle): transient failures retry with backoff under a
+    per-call deadline, and a sustained outage short-circuits locally via
+    the breaker (BreakerOpenError is an ApiError, which every validator
+    path already treats as a failed cycle and retries next interval)."""
+    from ..client.resilience import RetryingClient
     from ..client.rest import RestClient
 
-    return RestClient(base_url=os.environ.get("KUBE_API_URL"))
+    # the validator binary's composition root: raw transport built only to be
+    # wrapped in the resilience layer on the same line
+    return RetryingClient(RestClient(base_url=os.environ.get("KUBE_API_URL")))  # opalint: disable=api-bypass
 
 
 def revalidate_local(status, matrix_dim: int, timeout: float = 600.0):
