@@ -26,6 +26,7 @@
 #include <unistd.h>
 
 #include <algorithm>
+#include <cctype>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
@@ -126,12 +127,50 @@ void Gauge(std::string* out, const char* name, const char* help, double value) {
   out->append(line);
 }
 
-// A barrier counts as ready when present AND not recording a failed sweep
-// (validators overwrite the file with "passed": false on regression —
-// matching StatusFiles.is_ready in the Python exporter).
+// Cheap structural validity check: the body must be a single JSON object —
+// first non-space byte '{', strings terminated, braces/brackets balanced
+// (string-aware), and nothing but whitespace after the object closes. Not a
+// full parser (it cannot reject every malformed token), but it catches the
+// corruption classes that occur in practice — truncated writes, non-JSON
+// garbage, and valid-but-non-dict JSON — exactly the inputs for which the
+// Python StatusFiles.read returns None and metrics.py takes its corrupt
+// fail-safe branch.
+bool JsonDictValid(const std::string& body) {
+  size_t i = 0;
+  while (i < body.size() && isspace(static_cast<unsigned char>(body[i]))) ++i;
+  if (i >= body.size() || body[i] != '{') return false;
+  int depth = 0;
+  bool in_string = false;
+  bool escaped = false;
+  for (; i < body.size(); ++i) {
+    const char c = body[i];
+    if (in_string) {
+      if (escaped) escaped = false;
+      else if (c == '\\') escaped = true;
+      else if (c == '"') in_string = false;
+      continue;
+    }
+    if (c == '"') in_string = true;
+    else if (c == '{' || c == '[') ++depth;
+    else if (c == '}' || c == ']') {
+      if (--depth < 0) return false;
+      if (depth == 0) break;  // top-level object closed
+    }
+  }
+  if (depth != 0 || in_string) return false;
+  for (++i; i < body.size(); ++i)
+    if (!isspace(static_cast<unsigned char>(body[i]))) return false;
+  return true;
+}
+
+// A barrier counts as ready when present, structurally valid AND not
+// recording a failed sweep (validators overwrite the file with
+// "passed": false on regression — matching StatusFiles.is_ready in the
+// Python exporter, whose read() returns None on corrupt/non-dict content).
 bool BarrierReady(const std::string& path) {
   if (!FileExists(path)) return false;
   const std::string body = ReadFile(path);
+  if (!JsonDictValid(body)) return false;
   size_t pos = body.find("\"passed\"");
   if (pos == std::string::npos) return true;
   pos = body.find_first_not_of(" \t:", pos + strlen("\"passed\""));
@@ -173,7 +212,13 @@ std::string RenderMetrics(const std::string& status_dir) {
     const bool has_map = JsonIntArray(workload, "local_chips", &local_map);
     const bool full_coverage =
         has_map ? static_cast<int>(local_map.size()) == n_devices : true;
-    if (BarrierReady(workload_path)) {
+    if (!JsonDictValid(workload)) {
+      // present-but-corrupt barrier (truncated write, garbage, non-dict
+      // JSON): fail CLOSED on every chip, mirroring metrics.py's corrupt
+      // branch — a file that can't be parsed certifies nothing
+      for (int i = 0; i < n_devices; ++i)
+        chip_healthy[static_cast<size_t>(i)] = false;
+    } else if (BarrierReady(workload_path)) {
       double n_swept = 0;
       const bool partial =
           (has_map && !full_coverage) ||

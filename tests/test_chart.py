@@ -208,3 +208,14 @@ def test_rbac_rules_identical_across_install_channels(rendered):
             for r in rules)
 
     assert norm(chart_rules) == norm(deploy_rules) == norm(csv_rules)
+
+    # least privilege: no channel may grant wildcard verbs/resources/groups
+    # — "*" silently includes deletecollection today and every verb added
+    # to the API tomorrow, and OperatorHub flags wildcard CSV permissions
+    for channel, rules in (("chart", chart_rules), ("deploy", deploy_rules),
+                           ("csv", csv_rules)):
+        for rule in rules:
+            for field in ("apiGroups", "resources", "verbs"):
+                assert "*" not in rule.get(field, []), (
+                    f"{channel} ClusterRole rule {rule} uses a wildcard "
+                    f"{field}; enumerate the exact {field} instead")

@@ -220,3 +220,89 @@ def test_slice_partition_failure_surfaces_on_cr(fake_client):
     cond = get_condition(live, SLICE_PARTITION_FAILED)
     assert cond is not None and cond["status"] == "False"
     assert r.metrics.slice_partition_failed_nodes._value.get() == 0
+
+
+def test_health_sweep_drives_machine_and_surfaces_on_cr(fake_client):
+    """The reconcile sweep drives the chip-health machine: a node whose
+    published workload-health annotation regresses walks degraded ->
+    quarantined, the per-state gauges follow, and a NodeHealthDegraded
+    condition + one Warning Event land on the ClusterPolicy."""
+    from tpu_operator.conditions import NODE_HEALTH_DEGRADED
+    from tpu_operator.health import DEGRADED, QUARANTINED, node_health_state
+
+    fake_client.create(new_cluster_policy())
+    fake_client.create(mk_node("tpu-1", dict(GKE_TPU_LABELS)))
+    r = ClusterPolicyReconciler(fake_client)
+    kubelet = KubeletSimulator(fake_client)
+    r.reconcile(Request("cluster-policy"))
+    kubelet.tick()
+    r.reconcile(Request("cluster-policy"))
+    assert get_policy(fake_client)["status"]["state"] == "ready"
+    assert r._last_health_counts["healthy"] >= 1
+
+    fake_client.patch("v1", "Node", "tpu-1", {"metadata": {"annotations": {
+        consts.WORKLOAD_HEALTH_ANNOTATION: "failed:2"}}})
+    r.reconcile(Request("cluster-policy"))
+    node = fake_client.get("v1", "Node", "tpu-1")
+    assert node_health_state(node) == DEGRADED
+    live = get_policy(fake_client)
+    cond = get_condition(live, NODE_HEALTH_DEGRADED)
+    assert cond is not None and cond["status"] == "True"
+    assert "degraded" in cond["message"]
+    assert r.metrics.node_health_state.labels(
+        state="degraded")._value.get() == 1
+    assert r.debug_state()["node_health"]["degraded"] == 1
+
+    r.reconcile(Request("cluster-policy"))
+    assert node_health_state(fake_client.get("v1", "Node", "tpu-1")) \
+        == QUARANTINED
+
+    # recovery: verdict passes -> recovered -> healthy; condition clears
+    fake_client.patch("v1", "Node", "tpu-1", {"metadata": {"annotations": {
+        consts.WORKLOAD_HEALTH_ANNOTATION: "passed"}}})
+    r.reconcile(Request("cluster-policy"))
+    r.reconcile(Request("cluster-policy"))
+    node = fake_client.get("v1", "Node", "tpu-1")
+    assert node_health_state(node) == ""
+    cond = get_condition(get_policy(fake_client), NODE_HEALTH_DEGRADED)
+    assert cond is not None and cond["status"] == "False"
+
+
+def test_health_disabled_clears_machine_state(fake_client):
+    from tpu_operator.health import node_health_state
+
+    policy = new_cluster_policy()
+    fake_client.create(policy)
+    labels = dict(GKE_TPU_LABELS)
+    labels[consts.HEALTH_STATE_LABEL] = "quarantined"
+    fake_client.create(mk_node("tpu-1", labels))
+    fake_client.patch("tpu.ai/v1", "ClusterPolicy", "cluster-policy",
+                      {"spec": {"health": {"enabled": False}}})
+    r = ClusterPolicyReconciler(fake_client)
+    r.reconcile(Request("cluster-policy"))
+    assert node_health_state(fake_client.get("v1", "Node", "tpu-1")) == ""
+    assert r._last_health_counts == {"healthy": 1, "degraded": 0,
+                                     "quarantined": 0, "remediating": 0,
+                                     "recovered": 0, "failed": 0}
+
+
+def test_retile_transitions_feed_counter(fake_client):
+    fake_client.create(new_cluster_policy())
+    labels = dict(GKE_TPU_LABELS)
+    labels[consts.TPU_SLICE_CONFIG_LABEL] = "single-chip"
+    labels[consts.TPU_SLICE_STATE_LABEL] = "retiled"
+    fake_client.create(mk_node("tpu-1", labels))
+    r = ClusterPolicyReconciler(fake_client)
+    r.reconcile(Request("cluster-policy"))
+    assert r.metrics.partition_retile_total._value.get() == 1
+    # observing the same state again is NOT a new re-tile
+    r.reconcile(Request("cluster-policy"))
+    assert r.metrics.partition_retile_total._value.get() == 1
+    # restore then re-tile again: second event, second tick
+    fake_client.patch("v1", "Node", "tpu-1", {"metadata": {"labels": {
+        consts.TPU_SLICE_STATE_LABEL: "success"}}})
+    r.reconcile(Request("cluster-policy"))
+    fake_client.patch("v1", "Node", "tpu-1", {"metadata": {"labels": {
+        consts.TPU_SLICE_STATE_LABEL: "retiled"}}})
+    r.reconcile(Request("cluster-policy"))
+    assert r.metrics.partition_retile_total._value.get() == 2

@@ -172,6 +172,20 @@ def test_failed_barrier_record_is_unhealthy(plugin):
     assert all(d.health == "Unhealthy" for d in next(stream).devices)
 
 
+def test_non_dict_barrier_fails_safe(plugin):
+    """Valid-but-non-dict JSON in the barrier (broken producer writing a
+    bare list) must take the corrupt fail-safe branch, not crash the health
+    loop with AttributeError on .get()."""
+    from tpu_operator.validator.status import StatusFiles
+
+    p, _, tmp_path = plugin
+    status = StatusFiles(str(tmp_path / "validations"))
+    os.makedirs(status.directory, exist_ok=True)
+    with open(status.path("workload"), "w") as f:
+        f.write('[1, 2]')
+    assert p._validation_health() == ("Unhealthy", None)
+
+
 def _health_by_id(response):
     return {d.ID: d.health for d in response.devices}
 

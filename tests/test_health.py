@@ -1,0 +1,443 @@
+"""Chip-health degraded-state machine (tpu_operator/health/machine.py).
+
+Each test drives the machine the way the ClusterPolicy sweep does: fresh
+node snapshots per pass, state persisted only in node labels/annotations —
+so every test doubles as a resume-after-operator-restart test by
+constructing a NEW machine per sweep.
+"""
+
+import pytest
+
+from tpu_operator import consts
+from tpu_operator.api.clusterpolicy import HealthSpec
+from tpu_operator.health import (
+    DEGRADED,
+    FAILED,
+    HEALTHY,
+    HealthStateMachine,
+    QUARANTINED,
+    RECOVERED,
+    REMEDIATING,
+    node_health_state,
+    parse_workload_health,
+)
+from tpu_operator.health.machine import failed_chips_from_annotation
+
+NS = "tpu-operator"
+
+
+def mk_node(name="tpu-0", verdict=None):
+    node = {"apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": name, "labels": {
+                consts.GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice",
+                consts.deploy_label("driver"): "true"}},
+            "spec": {}, "status": {}}
+    if verdict is not None:
+        node["metadata"]["annotations"] = {
+            consts.WORKLOAD_HEALTH_ANNOTATION: verdict}
+    return node
+
+
+def mk_driver_ds(image="img:1"):
+    return {"apiVersion": "apps/v1", "kind": "DaemonSet",
+            "metadata": {"name": "libtpu-driver", "namespace": NS},
+            "spec": {"template": {
+                "metadata": {"labels": {"app.kubernetes.io/component": "tpu-driver"}},
+                "spec": {"nodeSelector": {consts.deploy_label("driver"): "true"},
+                         "containers": [{"name": "i", "image": image}]}}}}
+
+
+def mk_pod(name, node, component):
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "namespace": NS,
+                         "labels": {"app.kubernetes.io/component": component}},
+            "spec": {"nodeName": node},
+            "status": {"phase": "Running"}}
+
+
+class Clock:
+    def __init__(self, t=1_000_000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture
+def clock():
+    return Clock()
+
+
+def setup(fake_client, verdict="failed"):
+    fake_client.create(mk_driver_ds())
+    fake_client.create(mk_node(verdict=verdict))
+    fake_client.create(mk_pod("val-0", "tpu-0", "tpu-operator-validator"))
+    fake_client.create(mk_pod("drv-0", "tpu-0", "tpu-driver"))
+
+
+def sweep(fake_client, clock, **spec):
+    """One reconcile-driven sweep with a BRAND NEW machine: resumability
+    from cluster state alone is exercised on every step."""
+    sm = HealthStateMachine(fake_client, NS,
+                            HealthSpec.from_dict(spec), now=clock)
+    counts = sm.process(fake_client.list("v1", "Node"))
+    return sm, counts
+
+
+def get_node(fake_client, name="tpu-0"):
+    return fake_client.get("v1", "Node", name)
+
+
+def set_verdict(fake_client, verdict, name="tpu-0"):
+    fake_client.patch("v1", "Node", name, {"metadata": {"annotations": {
+        consts.WORKLOAD_HEALTH_ANNOTATION: verdict}}})
+
+
+def events_with_reason(fake_client, reason):
+    return [e for e in fake_client.list("v1", "Event", NS)
+            if e.get("reason") == reason]
+
+
+# -- verdict parsing ----------------------------------------------------------
+
+def test_verdict_parsing():
+    assert parse_workload_health(mk_node(verdict="passed")) is True
+    assert parse_workload_health(mk_node(verdict="failed")) is False
+    assert parse_workload_health(mk_node(verdict="failed:1,3")) is False
+    assert parse_workload_health(mk_node(verdict="corrupt")) is False
+    assert parse_workload_health(mk_node()) is None, \
+        "absence is no-information, never failure"
+    assert failed_chips_from_annotation(mk_node(verdict="failed:1,3")) == [1, 3]
+    assert failed_chips_from_annotation(mk_node(verdict="failed")) is None
+    assert failed_chips_from_annotation(mk_node(verdict="passed")) is None
+
+
+# -- steady state -------------------------------------------------------------
+
+def test_healthy_nodes_get_no_writes(fake_client, clock):
+    setup(fake_client, verdict="passed")
+    rv_before = get_node(fake_client)["metadata"]["resourceVersion"]
+    _, counts = sweep(fake_client, clock)
+    assert counts.healthy == 1
+    node = get_node(fake_client)
+    assert node_health_state(node) == HEALTHY
+    assert node["metadata"]["resourceVersion"] == rv_before, \
+        "the steady state must not touch the node"
+
+
+def test_no_verdict_is_not_failure(fake_client, clock):
+    setup(fake_client, verdict=None)
+    _, counts = sweep(fake_client, clock)
+    assert counts.healthy == 1
+    assert node_health_state(get_node(fake_client)) == HEALTHY
+
+
+# -- the full remediation flow ------------------------------------------------
+
+def test_full_degrade_quarantine_remediate_fail_flow(fake_client, clock):
+    setup(fake_client, verdict="failed:2")
+
+    _, counts = sweep(fake_client, clock)
+    node = get_node(fake_client)
+    assert node_health_state(node) == DEGRADED
+    assert counts.degraded == 1
+    assert node["metadata"]["annotations"][consts.HEALTH_STATE_SINCE_ANNOTATION]
+    assert events_with_reason(fake_client, "NodeHealthDegraded")
+
+    clock.t += 30  # still failing on the next sweep: confirmed
+    _, counts = sweep(fake_client, clock)
+    assert node_health_state(get_node(fake_client)) == QUARANTINED
+    assert counts.quarantined == 1
+    assert events_with_reason(fake_client, "NodeHealthQuarantined")
+
+    clock.t += 30
+    sm, counts = sweep(fake_client, clock)
+    node = get_node(fake_client)
+    assert node_health_state(node) == REMEDIATING
+    assert node["metadata"]["annotations"][consts.HEALTH_ATTEMPTS_ANNOTATION] == "1"
+    assert sm.attempts_fired == 1
+    # attempt 1 recycles the validator pod (forced revalidation), driver stays
+    pods = [p["metadata"]["name"] for p in fake_client.list("v1", "Pod", NS)]
+    assert "val-0" not in pods and "drv-0" in pods
+
+    # within the wait budget: no escalation, no extra writes
+    clock.t += 30
+    sm, counts = sweep(fake_client, clock)
+    assert sm.attempts_fired == 0
+    assert get_node(fake_client)["metadata"]["annotations"][
+        consts.HEALTH_ATTEMPTS_ANNOTATION] == "1"
+
+    # budget exhausted, still failing: attempt 2 escalates to driver restart
+    fake_client.create(mk_pod("val-1", "tpu-0", "tpu-operator-validator"))
+    clock.t += 601
+    sm, counts = sweep(fake_client, clock)
+    node = get_node(fake_client)
+    assert node["metadata"]["annotations"][consts.HEALTH_ATTEMPTS_ANNOTATION] == "2"
+    assert sm.attempts_fired == 1
+    pods = [p["metadata"]["name"] for p in fake_client.list("v1", "Pod", NS)]
+    assert "drv-0" not in pods and "val-1" not in pods
+
+    clock.t += 601  # attempt 3 (the default max)
+    sweep(fake_client, clock)
+    assert get_node(fake_client)["metadata"]["annotations"][
+        consts.HEALTH_ATTEMPTS_ANNOTATION] == "3"
+
+    clock.t += 601  # attempts exhausted -> sticky failed
+    _, counts = sweep(fake_client, clock)
+    node = get_node(fake_client)
+    assert node_health_state(node) == FAILED
+    assert counts.failed == 1
+    assert node["metadata"]["annotations"][consts.HEALTH_FAILED_TEMPLATE_ANNOTATION]
+    assert events_with_reason(fake_client, "NodeHealthFailed")
+
+    # sticky: later sweeps leave it alone
+    clock.t += 601
+    _, counts = sweep(fake_client, clock)
+    assert node_health_state(get_node(fake_client)) == FAILED
+
+
+def test_recovery_mid_remediation(fake_client, clock):
+    setup(fake_client, verdict="failed")
+    for _ in range(3):  # degraded -> quarantined -> remediating
+        sweep(fake_client, clock)
+        clock.t += 30
+    assert node_health_state(get_node(fake_client)) == REMEDIATING
+
+    set_verdict(fake_client, "passed")
+    _, counts = sweep(fake_client, clock)
+    node = get_node(fake_client)
+    assert node_health_state(node) == RECOVERED
+    assert counts.recovered == 1
+    assert consts.HEALTH_ATTEMPTS_ANNOTATION not in node["metadata"]["annotations"]
+    assert events_with_reason(fake_client, "NodeHealthRecovered")
+
+    clock.t += 30  # settled: label cleared, machine left
+    _, counts = sweep(fake_client, clock)
+    node = get_node(fake_client)
+    assert node_health_state(node) == HEALTHY
+    assert counts.healthy == 1
+    assert consts.HEALTH_STATE_SINCE_ANNOTATION not in node["metadata"].get(
+        "annotations", {})
+
+
+def test_one_sweep_blip_recovers_directly(fake_client, clock):
+    setup(fake_client, verdict="failed")
+    sweep(fake_client, clock)
+    assert node_health_state(get_node(fake_client)) == DEGRADED
+    set_verdict(fake_client, "passed")
+    clock.t += 30
+    sweep(fake_client, clock)
+    assert node_health_state(get_node(fake_client)) == HEALTHY
+
+
+def test_cordon_on_quarantine_knob(fake_client, clock):
+    setup(fake_client, verdict="failed")
+    sweep(fake_client, clock, cordonOnQuarantine=True)
+    clock.t += 30
+    sweep(fake_client, clock, cordonOnQuarantine=True)
+    node = get_node(fake_client)
+    assert node_health_state(node) == QUARANTINED
+    assert node["spec"]["unschedulable"] is True
+
+    set_verdict(fake_client, "passed")
+    clock.t += 30
+    sweep(fake_client, clock, cordonOnQuarantine=True)
+    node = get_node(fake_client)
+    assert node_health_state(node) == RECOVERED
+    assert not node["spec"].get("unschedulable")
+
+
+# -- flap damping -------------------------------------------------------------
+
+def flap_once(fake_client, clock, **spec):
+    """healthy -> degraded -> healthy (one full flap)."""
+    set_verdict(fake_client, "failed")
+    sweep(fake_client, clock, **spec)
+    set_verdict(fake_client, "passed")
+    clock.t += 60
+    sweep(fake_client, clock, **spec)
+    clock.t += 60
+
+
+def test_flap_damping_goes_sticky_with_one_event(fake_client, clock):
+    setup(fake_client, verdict="passed")
+    flap_once(fake_client, clock)
+    flap_once(fake_client, clock)
+    assert node_health_state(get_node(fake_client)) == HEALTHY
+
+    # third degradation inside the window trips the damper
+    set_verdict(fake_client, "failed")
+    sweep(fake_client, clock)
+    node = get_node(fake_client)
+    assert node_health_state(node) == QUARANTINED
+    assert node["metadata"]["annotations"][consts.HEALTH_FLAP_STICKY_ANNOTATION]
+    assert len(events_with_reason(fake_client, "NodeHealthFlapping")) == 1
+
+    # sticky: bounded writes — further sweeps are pure reads
+    rv = get_node(fake_client)["metadata"]["resourceVersion"]
+    for _ in range(5):
+        clock.t += 60
+        _, counts = sweep(fake_client, clock)
+        assert counts.quarantined == 1
+    node = get_node(fake_client)
+    assert node["metadata"]["resourceVersion"] == rv, \
+        "flap-damped node must not be written again"
+    assert len(events_with_reason(fake_client, "NodeHealthFlapping")) == 1
+
+
+def test_flap_window_prunes_old_entries(fake_client, clock):
+    setup(fake_client, verdict="passed")
+    flap_once(fake_client, clock)
+    flap_once(fake_client, clock)
+    clock.t += 4000  # both entries age out of the default 3600s window
+    set_verdict(fake_client, "failed")
+    sweep(fake_client, clock)
+    assert node_health_state(get_node(fake_client)) == DEGRADED, \
+        "stale flap history must not trip the damper"
+
+
+def test_relapse_after_recovery_counts_as_flap(fake_client, clock):
+    setup(fake_client, verdict="failed")
+    sweep(fake_client, clock, flapThreshold=2)  # degraded (flap entry 1)
+    set_verdict(fake_client, "passed")
+    clock.t += 30
+    sweep(fake_client, clock, flapThreshold=2)
+    clock.t += 30
+    sweep(fake_client, clock, flapThreshold=2)  # blip path -> healthy... but
+    # threshold=2 with the immediate relapse below must trip from RECOVERED
+    set_verdict(fake_client, "failed")
+    sweep(fake_client, clock, flapThreshold=2)
+    assert node_health_state(get_node(fake_client)) == QUARANTINED
+    assert events_with_reason(fake_client, "NodeHealthFlapping")
+
+
+# -- sticky-state escape hatches ----------------------------------------------
+
+def drive_to_failed(fake_client, clock):
+    set_verdict(fake_client, "failed")
+    for _ in range(3):
+        sweep(fake_client, clock)
+        clock.t += 30
+    for _ in range(3):
+        clock.t += 601
+        sweep(fake_client, clock)
+    assert node_health_state(get_node(fake_client)) == FAILED
+
+
+def test_template_change_clears_sticky_failed(fake_client, clock):
+    setup(fake_client)
+    drive_to_failed(fake_client, clock)
+    # roll the driver DS: new pod template supersedes the failure
+    fake_client.patch("apps/v1", "DaemonSet", "libtpu-driver", {
+        "spec": {"template": {"spec": {"containers": [
+            {"name": "i", "image": "img:NEW"}]}}}}, NS)
+    clock.t += 30
+    _, counts = sweep(fake_client, clock)
+    node = get_node(fake_client)
+    assert node_health_state(node) == HEALTHY
+    assert consts.HEALTH_FAILED_TEMPLATE_ANNOTATION not in node["metadata"]["annotations"]
+    assert events_with_reason(fake_client, "NodeHealthReset")
+
+
+def test_manual_label_clear_wipes_everything(fake_client, clock):
+    setup(fake_client)
+    drive_to_failed(fake_client, clock)
+    # admin escape hatch: remove the health label by hand
+    fake_client.patch("v1", "Node", "tpu-0", {"metadata": {
+        "labels": {consts.HEALTH_STATE_LABEL: None}}})
+    set_verdict(fake_client, "passed")
+    sweep(fake_client, clock)
+    anns = get_node(fake_client)["metadata"].get("annotations", {})
+    for key in (consts.HEALTH_STATE_SINCE_ANNOTATION,
+                consts.HEALTH_ATTEMPTS_ANNOTATION,
+                consts.HEALTH_FLAP_HISTORY_ANNOTATION,
+                consts.HEALTH_FLAP_STICKY_ANNOTATION,
+                consts.HEALTH_FAILED_TEMPLATE_ANNOTATION):
+        assert key not in anns, f"{key} must be wiped on manual clear"
+
+
+def test_template_change_lifts_flap_quarantine(fake_client, clock):
+    setup(fake_client, verdict="passed")
+    flap_once(fake_client, clock)
+    flap_once(fake_client, clock)
+    set_verdict(fake_client, "failed")
+    sweep(fake_client, clock)
+    assert node_health_state(get_node(fake_client)) == QUARANTINED
+    fake_client.patch("apps/v1", "DaemonSet", "libtpu-driver", {
+        "spec": {"template": {"spec": {"containers": [
+            {"name": "i", "image": "img:NEW"}]}}}}, NS)
+    clock.t += 30
+    sweep(fake_client, clock)
+    node = get_node(fake_client)
+    assert node_health_state(node) == HEALTHY
+    anns = node["metadata"].get("annotations", {})
+    assert consts.HEALTH_FLAP_HISTORY_ANNOTATION not in anns, \
+        "lifting the quarantine must reset the flap history too"
+
+
+# -- resume / crash tolerance -------------------------------------------------
+
+def test_resume_mid_remediation_after_operator_restart(fake_client, clock):
+    """A brand-new machine (operator restart) must continue the attempt
+    budget from the annotations, not restart it."""
+    setup(fake_client, verdict="failed")
+    for _ in range(3):
+        sweep(fake_client, clock)
+        clock.t += 30
+    clock.t += 601
+    sweep(fake_client, clock)  # attempt 2
+    node = get_node(fake_client)
+    assert node["metadata"]["annotations"][consts.HEALTH_ATTEMPTS_ANNOTATION] == "2"
+    # "restart": every sweep() already builds a fresh machine; jump the
+    # clock and verify the budget continues to 3 then sticky-fails
+    clock.t += 601
+    sweep(fake_client, clock)
+    assert get_node(fake_client)["metadata"]["annotations"][
+        consts.HEALTH_ATTEMPTS_ANNOTATION] == "3"
+    clock.t += 601
+    sweep(fake_client, clock)
+    assert node_health_state(get_node(fake_client)) == FAILED
+
+
+def test_corrupt_since_annotation_restamps(fake_client, clock):
+    setup(fake_client, verdict="failed")
+    for _ in range(3):
+        sweep(fake_client, clock)
+        clock.t += 30
+    fake_client.patch("v1", "Node", "tpu-0", {"metadata": {"annotations": {
+        consts.HEALTH_STATE_SINCE_ANNOTATION: "not-a-timestamp"}}})
+    clock.t += 5000
+    sm, _ = sweep(fake_client, clock)
+    # corrupt since = fresh budget, NOT instant escalation
+    assert sm.attempts_fired == 0
+    assert get_node(fake_client)["metadata"]["annotations"][
+        consts.HEALTH_ATTEMPTS_ANNOTATION] == "1"
+
+
+def test_unknown_state_label_routed_by_verdict(fake_client, clock):
+    setup(fake_client, verdict="passed")
+    fake_client.patch("v1", "Node", "tpu-0", {"metadata": {
+        "labels": {consts.HEALTH_STATE_LABEL: "bogus"}}})
+    sweep(fake_client, clock)
+    assert node_health_state(get_node(fake_client)) == HEALTHY
+
+
+# -- disable ------------------------------------------------------------------
+
+def test_clear_all_removes_machine_state(fake_client, clock):
+    setup(fake_client, verdict="failed")
+    for _ in range(3):
+        sweep(fake_client, clock, cordonOnQuarantine=True)
+        clock.t += 30
+    node = get_node(fake_client)
+    assert node_health_state(node) == REMEDIATING
+    sm = HealthStateMachine(fake_client, NS,
+                            HealthSpec.from_dict({"cordonOnQuarantine": True}),
+                            now=clock)
+    sm.clear_all(fake_client.list("v1", "Node"))
+    node = get_node(fake_client)
+    assert node_health_state(node) == HEALTHY
+    assert not node["spec"].get("unschedulable")
+    anns = node["metadata"].get("annotations", {})
+    assert consts.HEALTH_ATTEMPTS_ANNOTATION not in anns
+    assert consts.HEALTH_STATE_SINCE_ANNOTATION not in anns

@@ -1,0 +1,233 @@
+"""End-to-end chip-health remediation soak (ISSUE 5 acceptance).
+
+Full stack against a real MiniApiServer: operator app (informer-cached),
+kubelet simulator scheduling DS pods, and the node agents played inline —
+per-node status/handoff directories with the REAL feature-discovery and
+slice-partitioner passes running against them. Mid-steady-state, a chip on
+one node starts failing its workload barrier. With the SHIPPED DEFAULTS
+(health machine default-on) the cluster must, with zero manual
+intervention:
+
+  - publish the verdict and walk the node degraded -> quarantined ->
+    remediating (validator recycle observed as the remediation action)
+  - re-tile the node's slice layout around the gated chip (state=retiled)
+  - leave the OTHER node completely untouched
+  - survive an operator kill mid-remediation (fresh process resumes from
+    node labels/annotations alone)
+  - on recovery, return the node to healthy and restore the exact
+    configured layout
+"""
+
+import json
+import os
+import time
+
+import pytest
+import requests
+
+from tpu_operator import consts
+from tpu_operator.api.clusterpolicy import new_cluster_policy
+from tpu_operator.client.cache import CachedClient
+from tpu_operator.client.errors import ApiError
+from tpu_operator.client.rest import RestClient
+from tpu_operator.controllers.manager import OperatorApp
+from tpu_operator.health import QUARANTINED, REMEDIATING, node_health_state
+from tpu_operator.partitioner import sync_once
+from tpu_operator.partitioner.partitioner import read_handoff
+from tpu_operator.testing import MiniApiServer
+from tpu_operator.testing.kubelet import KubeletSimulator
+from tpu_operator.utils import deep_get
+from tpu_operator.validator.feature_discovery import sync_node_labels
+from tpu_operator.validator.status import StatusFiles
+
+TPU_LABELS = {
+    consts.GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice",
+    consts.GKE_TPU_TOPOLOGY_LABEL: "2x4",
+}
+
+PARTITIONS = "version: v1\npartitions:\n  single-chip:\n    - {chips: 1, topology: 1x1, count: all}\n"
+
+
+@pytest.fixture(autouse=True)
+def default_images(monkeypatch):
+    for env in ("DRIVER_IMAGE", "VALIDATOR_IMAGE", "FEATURE_DISCOVERY_IMAGE",
+                "TELEMETRY_EXPORTER_IMAGE", "SLICE_PARTITIONER_IMAGE",
+                "DEVICE_PLUGIN_IMAGE"):
+        monkeypatch.setenv(env, "gcr.io/tpu/x:0.1.0")
+
+
+def wait_for(predicate, timeout=60.0, interval=0.05, message="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            if predicate():
+                return
+        except (ApiError, requests.RequestException):
+            pass
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {message}")
+
+
+def barrier(passed, failed=None):
+    payload = {"passed": passed, "n_devices": 8,
+               "local_chips": list(range(8))}
+    if failed is not None:
+        payload["failed_local_chips"] = list(failed)
+    return payload
+
+
+def test_health_remediation_soak(tmp_path, monkeypatch):
+    devdir = tmp_path / "dev"
+    devdir.mkdir()
+    for i in range(8):
+        (devdir / f"accel{i}").write_text("")
+    monkeypatch.setenv("TPU_DEV_GLOBS", str(devdir / "accel*"))
+    config_path = tmp_path / "partitions.yaml"
+    config_path.write_text(PARTITIONS)
+
+    srv = MiniApiServer()
+    base = srv.start()
+    chaos = RestClient(base_url=base)
+    op_client = CachedClient(RestClient(base_url=base))
+    kubelet = KubeletSimulator(chaos, interval=0.05,
+                               create_pods=True).start()
+    app = OperatorApp(op_client)
+    apps = [app]
+    clients = [op_client]
+
+    agents = {}
+    for name in ("tpu-a", "tpu-b"):
+        node_dir = tmp_path / name
+        status = StatusFiles(str(node_dir / "status"))
+        status.write("workload", barrier(True))
+        agents[name] = {"status": status,
+                        "handoff": str(node_dir / "handoff")}
+        chaos.create({"apiVersion": "v1", "kind": "Node",
+                      "metadata": {"name": name,
+                                   "labels": dict(TPU_LABELS)},
+                      "status": {}})
+
+    def agent_pass():
+        """One node-agent sweep per node: real feature discovery (labels +
+        workload-health verdict) and real slice partitioner."""
+        for name, agent in agents.items():
+            monkeypatch.setenv("STATUS_DIR", agent["status"].directory)
+            sync_node_labels(chaos, name, use_jax=False)
+            sync_once(chaos, name, str(config_path), agent["handoff"],
+                      status_dir=agent["status"].directory)
+
+    def health_of(name):
+        return node_health_state(chaos.get("v1", "Node", name))
+
+    def slice_state(name):
+        return deep_get(chaos.get("v1", "Node", name), "metadata",
+                        "labels", consts.TPU_SLICE_STATE_LABEL)
+
+    def validator_uids(name):
+        return {p["metadata"]["uid"]
+                for p in chaos.list("v1", "Pod", "tpu-operator",
+                                    label_selector={
+                                        "app.kubernetes.io/component":
+                                        "tpu-operator-validator"},
+                                    field_selector={"spec.nodeName": name})}
+
+    try:
+        chaos.create(new_cluster_policy())  # shipped defaults: health ON
+        app.start()
+        wait_for(lambda: deep_get(
+            chaos.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy"),
+            "status", "state") == "ready", message="initial install ready")
+
+        # steady state: partitions applied, everything healthy
+        for name in agents:
+            chaos.patch("v1", "Node", name, {"metadata": {"labels": {
+                consts.TPU_SLICE_CONFIG_LABEL: "single-chip"}}})
+        agent_pass()
+        for name in agents:
+            assert slice_state(name) == "success"
+        original = read_handoff(agents["tpu-a"]["handoff"])["groups"]
+        assert len(original) == 8
+        wait_for(lambda: all(health_of(n) == "" for n in agents),
+                 message="all nodes healthy in steady state")
+        initial_validators = validator_uids("tpu-a")
+        assert initial_validators, "kubelet must have scheduled validators"
+
+        # -- inject mid-steady-state degradation on tpu-a, chip 2 ------------
+        agents["tpu-a"]["status"].write("workload", barrier(False, failed=[2]))
+        agent_pass()
+
+        # the partitioner re-tiles around the gated chip immediately
+        assert slice_state("tpu-a") == "retiled"
+        retiled = read_handoff(agents["tpu-a"]["handoff"])
+        assert retiled["blocked"] == [2]
+        assert len(retiled["groups"]) == 7
+        assert all(g["chips"] != [2] for g in retiled["groups"])
+
+        # the operator walks the machine without any help: degraded on one
+        # sweep, quarantined on the next, remediating right after (the
+        # verdict keeps failing) — remediation recycles the validator pods
+        wait_for(lambda: health_of("tpu-a") in (QUARANTINED, REMEDIATING),
+                 message="tpu-a quarantined")
+        wait_for(lambda: health_of("tpu-a") == REMEDIATING,
+                 message="tpu-a remediating")
+        wait_for(lambda: validator_uids("tpu-a")
+                 and not (validator_uids("tpu-a") & initial_validators),
+                 message="validator pods recycled (forced revalidation)")
+
+        # -- operator killed mid-remediation ---------------------------------
+        node = chaos.get("v1", "Node", "tpu-a")
+        attempts = deep_get(node, "metadata", "annotations",
+                            consts.HEALTH_ATTEMPTS_ANNOTATION)
+        assert attempts == "1"
+        app.stop()
+        op_client.stop()
+        op_client2 = CachedClient(RestClient(base_url=base))
+        app2 = OperatorApp(op_client2)
+        clients.append(op_client2)
+        apps.append(app2)
+        app2.start()
+
+        # the recycled validator "fixes" the chip: revalidation passes
+        agents["tpu-a"]["status"].write("workload", barrier(True))
+        agent_pass()
+
+        # fresh process resumes from cluster state: recovered -> healthy
+        wait_for(lambda: health_of("tpu-a") == "",
+                 message="tpu-a healthy again after restart")
+        node = chaos.get("v1", "Node", "tpu-a")
+        anns = deep_get(node, "metadata", "annotations", default={}) or {}
+        assert consts.HEALTH_ATTEMPTS_ANNOTATION not in anns
+
+        # configured layout restored exactly
+        agent_pass()
+        assert slice_state("tpu-a") == "success"
+        restored = read_handoff(agents["tpu-a"]["handoff"])
+        assert restored["groups"] == original
+        assert "blocked" not in restored
+
+        # the OTHER node was never touched by any of it
+        node_b = chaos.get("v1", "Node", "tpu-b")
+        assert node_health_state(node_b) == ""
+        assert not deep_get(node_b, "spec", "unschedulable")
+        assert slice_state("tpu-b") == "success"
+        assert len(read_handoff(agents["tpu-b"]["handoff"])["groups"]) == 8
+
+        # the incident is fully narrated in Events
+        reasons = {e.get("reason")
+                   for e in chaos.list("v1", "Event", "tpu-operator")}
+        for expected in ("NodeHealthDegraded", "NodeHealthQuarantined",
+                         "NodeHealthRemediating", "NodeHealthRecovered"):
+            assert expected in reasons, f"missing {expected} Event"
+        # ClusterPolicy condition cleared after recovery
+        policy = chaos.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy")
+        for cond in deep_get(policy, "status", "conditions",
+                             default=[]) or []:
+            if cond.get("type") == "NodeHealthDegraded":
+                assert cond.get("status") == "False"
+    finally:
+        for a in apps:
+            a.stop()
+        for c in clients:
+            c.stop()
+        kubelet.stop()
+        srv.stop()

@@ -315,3 +315,142 @@ def test_scheduling_budget_catches_missing_worker(fake_client):
     assert fake_client.list(
         "v1", "Pod", NS,
         label_selector={"app": "tpu-multihost-validation"}) == []
+
+
+# -- template -> runtime exec loop (harness kubelet as container runtime) ----
+
+def _pod_env(pod):
+    """Resolve a rendered pod's env the way the kubelet would (values +
+    the spec.nodeName downward-API fieldRef the template uses)."""
+    env = {}
+    for entry in pod["spec"]["containers"][0].get("env", []):
+        if "value" in entry:
+            env[entry["name"]] = entry["value"]
+        elif deep_get(entry, "valueFrom", "fieldRef",
+                      "fieldPath") == "spec.nodeName":
+            env[entry["name"]] = pod["spec"].get("nodeName", "")
+    return env
+
+
+def test_multihost_exec_loop_through_harness_kubelet(
+        fake_client, tmp_path, monkeypatch):
+    """Closed loop over the RENDERED template: the multihost pods the state
+    machine writes are executed by the harness kubelet through the real
+    ``tpu-validator`` CLI (command/args/env exactly as rendered), so a
+    drift between what multihost.py renders and what validator/main.py
+    parses fails here instead of on a real v5e-16."""
+    from tpu_operator.state.multihost import COORDINATOR_PORT
+    from tpu_operator.testing.kubelet import KubeletSimulator
+    from tpu_operator.validator import main as validator_main
+    from tpu_operator.validator import workload as workload_mod
+    from tpu_operator.validator.status import StatusFiles
+
+    for i in range(4):
+        fake_client.create(mk_node(f"vm-{i}", "v5e-16"))
+    state = MultihostValidationState(fake_client)
+    cat = catalog(fake_client)
+
+    rendezvous = []
+
+    def fake_run_multihost(coordinator, num_processes, process_id,
+                           matrix_dim=512, init_timeout=None):
+        rendezvous.append({"coordinator": coordinator,
+                           "num_processes": num_processes,
+                           "process_id": process_id,
+                           "init_timeout": init_timeout})
+        return workload_mod.IciCheckReport(
+            passed=True, n_devices=16, platform="tpu", elapsed_s=0.1,
+            compile_s=0.0, details={},
+            local_chips=[process_id * 4 + c for c in range(4)],
+            failed_local_chips=[])
+
+    monkeypatch.setattr(workload_mod, "run_multihost", fake_run_multihost)
+
+    def exec_pod(pod):
+        container = pod["spec"]["containers"][0]
+        assert container["command"] == ["tpu-validator"]
+        env = _pod_env(pod)
+        # each worker gets its own node-local status dir (hostPath analog)
+        env["STATUS_DIR"] = str(tmp_path / pod["spec"]["nodeName"])
+        for name, value in env.items():
+            monkeypatch.setenv(name, value)
+        return validator_main.run(list(container.get("args", [])))
+
+    kubelet = KubeletSimulator(fake_client, validation_exec=exec_pod)
+
+    assert state.sync(cat).status == SyncState.NOT_READY  # pods rendered
+    kubelet.tick()  # "runs" every rendered pod through the CLI
+
+    # the rendered env drove the real argparse/env plumbing end to end
+    assert len(rendezvous) == 4
+    assert {r["process_id"] for r in rendezvous} == {0, 1, 2, 3}
+    expected = (f"tpu-mh-validation-v5e-16-0.tpu-mh-validation-v5e-16"
+                f".{NS}.svc:{COORDINATOR_PORT}")
+    for r in rendezvous:
+        assert r["coordinator"] == expected
+        assert r["num_processes"] == 4
+        assert r["init_timeout"] == 600.0  # TPU_INIT_TIMEOUT from template
+
+    # each worker recorded its slice-wide barrier on its own node
+    for i in range(4):
+        report = StatusFiles(str(tmp_path / f"vm-{i}")).read("workload")
+        assert report["passed"] is True
+        assert report["local_chips"] == [i * 4 + c for c in range(4)]
+
+    # the kubelet observed exit 0 -> Succeeded -> state machine converges
+    cat[INFO_NODES] = fake_client.list("v1", "Node")
+    assert state.sync(cat).status == SyncState.READY
+    for i in range(4):
+        assert deep_get(fake_client.get("v1", "Node", f"vm-{i}"),
+                        "metadata", "annotations",
+                        consts.MULTIHOST_VALIDATED_ANNOTATION)
+
+
+def test_multihost_exec_loop_rendezvous_failure_fails_closed(
+        fake_client, tmp_path, monkeypatch):
+    """A worker whose rendezvous raises must exit nonzero -> Failed pod ->
+    attempt torn down for a clean retry, and NO barrier written (fail
+    closed: a missed rendezvous never marks the slice validated)."""
+    from tpu_operator.testing.kubelet import KubeletSimulator
+    from tpu_operator.validator import main as validator_main
+    from tpu_operator.validator import workload as workload_mod
+    from tpu_operator.validator.status import StatusFiles
+
+    for i in range(2):
+        fake_client.create(mk_node(f"vm-{i}", "s"))
+    state = MultihostValidationState(fake_client)
+    cat = catalog(fake_client)
+
+    def fake_run_multihost(coordinator, num_processes, process_id,
+                           matrix_dim=512, init_timeout=None):
+        if process_id == 1:
+            raise RuntimeError("barrier timed out waiting for worker")
+        return workload_mod.IciCheckReport(
+            passed=True, n_devices=8, platform="tpu", elapsed_s=0.1,
+            compile_s=0.0, details={}, local_chips=[0, 1, 2, 3],
+            failed_local_chips=[])
+
+    monkeypatch.setattr(workload_mod, "run_multihost", fake_run_multihost)
+
+    def exec_pod(pod):
+        env = _pod_env(pod)
+        env["STATUS_DIR"] = str(tmp_path / pod["spec"]["nodeName"])
+        for name, value in env.items():
+            monkeypatch.setenv(name, value)
+        return validator_main.run(
+            list(pod["spec"]["containers"][0].get("args", [])))
+
+    kubelet = KubeletSimulator(fake_client, validation_exec=exec_pod)
+    state.sync(cat)
+    kubelet.tick()
+    phases = {deep_get(p, "spec", "nodeName"): deep_get(p, "status", "phase")
+              for p in fake_client.list("v1", "Pod", NS)}
+    assert phases["vm-1"] == "Failed"
+    # the failed worker wrote no barrier (its CLI path fails closed)
+    assert StatusFiles(str(tmp_path / "vm-1")).read("workload") is None
+
+    # the state machine tears the attempt down and retries fresh
+    assert state.sync(cat).status == SyncState.NOT_READY
+    assert fake_client.list("v1", "Pod", NS) == []
+    state.sync(cat)
+    assert len(fake_client.list("v1", "Pod", NS)) == 2

@@ -220,6 +220,22 @@ def test_per_chip_health_parity(exporter_bin, tmp_path, monkeypatch):
     assert set(python().values()) == {0.0}
     assert set(native().values()) == {0.0}
 
+    # garbage WITHOUT a "passed": false substring: the native exporter's
+    # substring scan alone would read this as ready+healthy (fail OPEN) —
+    # the structural validity check must reject it like the Python
+    # json.load does
+    with open(os.path.join(str(d), "workload-ready"), "w") as f:
+        f.write('{"n_devices": 4, "garbage')
+    assert set(python().values()) == {0.0}
+    assert set(native().values()) == {0.0}
+
+    # valid JSON that is not an object (broken producer): both sides treat
+    # it exactly like unparsable bytes
+    with open(os.path.join(str(d), "workload-ready"), "w") as f:
+        f.write('[1, 2]')
+    assert set(python().values()) == {0.0}
+    assert set(native().values()) == {0.0}
+
     # LEGACY barrier (pre-r5 validator, no failed_local_chips array):
     # attribution derived from the nested details with the same pairing
     # rules — the version-skew window must not over-alert

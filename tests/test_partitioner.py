@@ -520,3 +520,119 @@ def test_shipped_default_partition_table_is_valid(fake_client, monkeypatch):
                                ("tpu-v5p-slice", 4), ("tpu-v3", 4)):
         singles = compute_partition(table["single-chip"], chips, accelerator)
         assert len(singles) == chips, (accelerator, chips)
+
+
+# -- health-aware re-tiling ---------------------------------------------------
+
+def write_barrier(status_dir, passed=True, failed_chips=None, n=8):
+    import json
+    import os
+
+    os.makedirs(status_dir, exist_ok=True)
+    payload = {"component": "workload", "passed": passed,
+               "n_devices": n, "local_chips": list(range(n))}
+    if failed_chips is not None:
+        payload["failed_local_chips"] = list(failed_chips)
+    with open(os.path.join(status_dir, "workload-ready"), "w") as f:
+        json.dump(payload, f)
+
+
+def test_tile_partition_around_blocked_chips():
+    """Blocked (health-gated) chips are occupied cells: every emitted group
+    is healthy-only and still an axis-aligned ICI box."""
+    groups = compute_partition([{"chips": 1, "topology": "1x1",
+                                 "count": "all"}], 8, V5E,
+                               blocked=frozenset({2}))
+    assert len(groups) == 7
+    assert all(g["chips"] != [2] for g in groups)
+    # a 2x2 still fits on the healthy half of the grid
+    groups = compute_partition([{"chips": 4, "topology": "2x2"}], 8, V5E,
+                               blocked=frozenset({2, 3}))
+    assert groups == [{"topology": "2x2", "chips": [0, 1, 4, 5]}]
+
+
+def test_tile_partition_blocked_makes_layout_impossible():
+    # both 2x2 placements need chip 2's column half
+    with pytest.raises(PartitionError, match="health-gated"):
+        compute_partition([{"chips": 4, "topology": "2x2"},
+                           {"chips": 4, "topology": "2x2"}], 8, V5E,
+                          blocked=frozenset({2}))
+    with pytest.raises(PartitionError, match="available"):
+        # fixed counts never scale down: 8 singles need 8 healthy chips
+        compute_partition([{"chips": 1, "count": 8}], 8, V5E,
+                          blocked=frozenset({0}))
+
+
+def test_tile_partition_blocked_out_of_range_rejected():
+    with pytest.raises(PartitionError, match="outside"):
+        compute_partition([{"chips": 1, "count": "all"}], 8, V5E,
+                          blocked=frozenset({9}))
+
+
+def test_sync_retiles_around_gated_chip_and_restores(fake_client, config_path,
+                                                     tmp_path):
+    handoff = str(tmp_path / "handoff")
+    status = str(tmp_path / "status")
+    mk_node(fake_client, config="single-chip")
+
+    # barrier fails, attributing chip 2: re-tile around it
+    write_barrier(status, passed=False, failed_chips=[2])
+    state = sync_once(fake_client, "n1", config_path, handoff,
+                      status_dir=status)
+    assert state == "retiled"
+    labels = fake_client.get("v1", "Node", "n1")["metadata"]["labels"]
+    assert labels[consts.TPU_SLICE_STATE_LABEL] == "retiled"
+    data = read_handoff(handoff)
+    assert data["blocked"] == [2]
+    assert len(data["groups"]) == 7
+    assert all(g["chips"] != [2] for g in data["groups"])
+    # idempotent while degraded
+    assert sync_once(fake_client, "n1", config_path, handoff,
+                     status_dir=status) == "retiled"
+
+    # recovery: barrier passes again -> configured layout restored
+    write_barrier(status, passed=True)
+    assert sync_once(fake_client, "n1", config_path, handoff,
+                     status_dir=status) == "success"
+    data = read_handoff(handoff)
+    assert "blocked" not in data
+    assert len(data["groups"]) == 8
+
+
+def test_sync_impossible_retile_defers_not_fails(fake_client, config_path,
+                                                 tmp_path):
+    """When no healthy-only placement exists the node DEFERS (pending):
+    the configured layout is still valid, the chips are merely gated —
+    failing would misreport a health incident as a config error."""
+    handoff = str(tmp_path / "handoff")
+    status = str(tmp_path / "status")
+    mk_node(fake_client, config="v5e-2x2-pair")
+    sync_once(fake_client, "n1", config_path, handoff, status_dir=status)
+    applied = read_handoff(handoff)
+
+    write_barrier(status, passed=False, failed_chips=[2])
+    assert sync_once(fake_client, "n1", config_path, handoff,
+                     status_dir=status) == "pending"
+    labels = fake_client.get("v1", "Node", "n1")["metadata"]["labels"]
+    assert labels[consts.TPU_SLICE_STATE_LABEL] == "pending"
+    assert read_handoff(handoff) == applied, \
+        "a deferred re-tile must not clobber the applied handoff"
+
+    write_barrier(status, passed=True)
+    assert sync_once(fake_client, "n1", config_path, handoff,
+                     status_dir=status) == "success"
+
+
+def test_sync_unattributed_failure_keeps_configured_layout(fake_client,
+                                                           config_path,
+                                                           tmp_path):
+    """passed:false with no chip attribution gates EVERY chip at the
+    device plugin — no re-tile can route around all of them, so the
+    configured layout stands and remediation handles the rest."""
+    handoff = str(tmp_path / "handoff")
+    status = str(tmp_path / "status")
+    mk_node(fake_client, config="single-chip")
+    write_barrier(status, passed=False)  # no failed_chips
+    assert sync_once(fake_client, "n1", config_path, handoff,
+                     status_dir=status) == "success"
+    assert len(read_handoff(handoff)["groups"]) == 8

@@ -231,6 +231,25 @@ def test_node_metrics_reflect_status_files(status, fake_devs):
     assert "tpu_operator_node_plugin_ready 0.0" in text
 
 
+def test_node_metrics_non_dict_barrier_is_corrupt(status, fake_devs):
+    """Valid-but-non-dict JSON in the workload barrier (a broken producer
+    writing a bare list) must hit the corrupt fail-safe branch — all chips
+    flagged, barrier not ready — instead of raising AttributeError on
+    .get()."""
+    os.makedirs(status.directory, exist_ok=True)
+    with open(status.path("workload"), "w") as f:
+        f.write('[1, 2]')
+    assert status.read("workload") is None  # reads as corrupt
+    assert not status.is_ready("workload")
+    m = NodeMetrics(status=status)
+    m.refresh()
+    text = m.scrape().decode()
+    assert "tpu_operator_node_workload_ready 0.0" in text
+    chip_lines = [l for l in text.splitlines()
+                  if l.startswith("tpu_operator_node_chip_healthy{")]
+    assert len(chip_lines) == 4 and all(l.endswith(" 0.0") for l in chip_lines)
+
+
 # -- CLI ----------------------------------------------------------------------
 
 def test_cli_driver_probe_exit_codes(tmp_path, fake_devs):
@@ -497,7 +516,9 @@ class TestPeriodicRevalidation:
 
     def test_template_wires_revalidation(self):
         """revalidateIntervalS plumbs env + device mounts into the sleep
-        container; off by default leaves the container unprivileged."""
+        container. The SHIPPED default (no CR override) is ON at 300 s —
+        continuous health needs a continuously refreshed barrier — and an
+        explicit 0 opts out, leaving the container unprivileged."""
         from tpu_operator.api.clusterpolicy import ClusterPolicy, new_cluster_policy
         from tpu_operator.state.operands import cluster_policy_states
 
@@ -509,19 +530,26 @@ class TestPeriodicRevalidation:
                   if o.get("kind") == "DaemonSet"][0]
             return ds["spec"]["template"]["spec"]["containers"][0]
 
+        # shipped-default path: a bare CR revalidates every 300 s
         base = {"validator": {"repository": "g", "image": "i", "version": "1"},
                 "driver": {"repository": "g", "image": "i", "version": "1"}}
         ctr = render(base)
-        assert not ctr.get("securityContext", {}).get("privileged")
-        assert "TPU_REVALIDATE_INTERVAL" not in [
-            e["name"] for e in ctr.get("env", [])]
+        env = {e["name"]: e.get("value") for e in ctr["env"]}
+        assert env["TPU_REVALIDATE_INTERVAL"] == "300"
+        assert ctr["securityContext"]["privileged"] is True
+        assert any(m["mountPath"] == "/dev" for m in ctr["volumeMounts"])
 
         base["validator"]["revalidateIntervalS"] = 600
         ctr = render(base)
         env = {e["name"]: e.get("value") for e in ctr["env"]}
         assert env["TPU_REVALIDATE_INTERVAL"] == "600"
-        assert ctr["securityContext"]["privileged"] is True
-        assert any(m["mountPath"] == "/dev" for m in ctr["volumeMounts"])
+
+        # explicit opt-out: no env, unprivileged, no /dev mount
+        base["validator"]["revalidateIntervalS"] = 0
+        ctr = render(base)
+        assert not ctr.get("securityContext", {}).get("privileged")
+        assert "TPU_REVALIDATE_INTERVAL" not in [
+            e["name"] for e in ctr.get("env", [])]
 
     def test_log_noise_json_line_is_skipped(self, tmp_path, monkeypatch):
         """A '{'-prefixed runtime log line that is not valid JSON must be

@@ -190,13 +190,17 @@ class ValidatorSpec(ComponentSpec):
     workload: ValidatorComponentEnv = spec_field(ValidatorComponentEnv)
     #: sleep-mode periodic re-run of the LOCAL ICI sweep, refreshing the
     #: workload barrier (and with it the device plugin's health gate) for
-    #: chips that degrade after their first pass. 0 = off. Busy chips
-    #: (held by a workload) skip the cycle without touching the barrier.
+    #: chips that degrade after their first pass. Default ON (300 s) —
+    #: the reference stack never stops watching hardware (DCGM +
+    #: node-status exporter re-check continuously), and a barrier written
+    #: once at node join turns every health consumer into monitoring
+    #: theater. 0 = off. Busy chips (held by a workload) skip the cycle
+    #: without touching the barrier.
     revalidate_interval_s: int = spec_field(
-        0, doc="Re-run the local ICI sweep every N seconds in the "
-               "validator's sleep container, refreshing the workload "
-               "barrier (0 = off). Chips held by a workload skip the "
-               "cycle.",
+        300, doc="Re-run the local ICI sweep every N seconds in the "
+                 "validator's sleep container, refreshing the workload "
+                 "barrier (0 = off; default 300). Chips held by a "
+                 "workload skip the cycle.",
         minimum=0, maximum=86400)
 
 
@@ -213,6 +217,44 @@ class SlicePartitionerSpec(ComponentSpec):
     def is_enabled(self, default: bool = False) -> bool:
         # opt-in, like MIG in the reference
         return default if self.enabled is None else bool(self.enabled)
+
+
+@dataclasses.dataclass
+class HealthSpec(SpecBase):
+    """Continuous chip-health remediation: the per-node degraded-state
+    machine (``tpu_operator/health``) driven from the ClusterPolicy
+    reconcile sweep. On a failed/regressed workload barrier a node walks
+    ``healthy -> degraded -> quarantined -> remediating -> recovered |
+    failed`` with bounded remediation attempts and flap damping, persisted
+    in node labels/annotations so operator restarts resume
+    mid-remediation."""
+
+    enabled: bool = spec_field(
+        True, doc="Drive the per-node chip-health state machine from the "
+                  "reconcile sweep (degrade/quarantine/remediate nodes "
+                  "whose workload barrier regresses).")
+    cordon_on_quarantine: bool = spec_field(
+        False, doc="Also cordon (mark unschedulable) a node while it is "
+                   "quarantined or remediating; uncordoned on recovery.")
+    max_remediation_attempts: int = spec_field(
+        3, doc="Remediation attempts (validator-pod recycle, then driver-"
+               "pod restart) before a node goes sticky failed.",
+        minimum=1, maximum=10)
+    remediation_wait_s: int = spec_field(
+        600, doc="Budget for one remediation attempt to produce a fresh "
+                 "verdict before the next attempt (or sticky failed) "
+                 "fires.",
+        minimum=30, maximum=86400)
+    flap_window_s: int = spec_field(
+        3600, doc="Flap-damping window: flapThreshold healthy->degraded "
+                  "transitions inside this window trip sticky quarantine.",
+        minimum=60, maximum=604800)
+    flap_threshold: int = spec_field(
+        3, doc="healthy->degraded transitions inside flapWindowS that "
+               "trip sticky quarantine (cleared by template change or "
+               "manual label clear).",
+        minimum=2, maximum=100)
+    extra: Dict[str, Any] = spec_field(dict)
 
 
 @dataclasses.dataclass
@@ -312,6 +354,7 @@ class ClusterPolicySpec(SpecBase):
     cdi: CDISpec = spec_field(CDISpec)
     host_paths: HostPathsSpec = spec_field(HostPathsSpec)
     psa: PSASpec = spec_field(PSASpec)
+    health: HealthSpec = spec_field(HealthSpec)
     extra: Dict[str, Any] = spec_field(dict)
 
     def libtpu_dir(self) -> str:

@@ -397,8 +397,8 @@ def _status(client, namespace, out) -> int:
               f"pools={pools}", file=out)
 
     # TPU nodes only — presence is the row filter, so no column for it
-    print("\nNODE            CAPACITY  HEALTHY  UPGRADE-STATE    "
-          "SLICE-PARTITION", file=out)
+    print("\nNODE            CAPACITY  HEALTHY  HEALTH-STATE     "
+          "UPGRADE-STATE    SLICE-PARTITION", file=out)
     for node in client.list("v1", "Node"):
         labels = node.get("metadata", {}).get("labels", {}) or {}
         if labels.get(consts.TPU_PRESENT_LABEL) != "true":
@@ -416,6 +416,11 @@ def _status(client, namespace, out) -> int:
             healthy = str(capacity)
         else:
             healthy = f"{allocatable}!"  # units withdrawn by the health gate
+        health_state = labels.get(consts.HEALTH_STATE_LABEL, "-")
+        attempts = deep_get(node, "metadata", "annotations",
+                            consts.HEALTH_ATTEMPTS_ANNOTATION)
+        if attempts and health_state == "remediating":
+            health_state = f"remediating#{attempts}"
         upgrade = labels.get(consts.UPGRADE_STATE_LABEL, "-")
         slice_cfg = labels.get(consts.TPU_SLICE_CONFIG_LABEL)
         slice_state = labels.get(consts.TPU_SLICE_STATE_LABEL)
@@ -426,8 +431,8 @@ def _status(client, namespace, out) -> int:
             partition = f"{slice_cfg or '<none>'}={slice_state or '?'}"
         else:
             partition = "-"
-        print(f"{name:<15} {capacity:<9} {healthy:<8} {upgrade:<16} "
-              f"{partition}", file=out)
+        print(f"{name:<15} {capacity:<9} {healthy:<8} {health_state:<16} "
+              f"{upgrade:<16} {partition}", file=out)
 
     print("\nDAEMONSET                 DESIRED  AVAILABLE  UPDATED", file=out)
     for ds in client.list("apps/v1", "DaemonSet", namespace):

@@ -32,6 +32,12 @@ REASON_SLICE_PARTITION_FAILED = "SlicePartitionFailed"
 #: labels (MIG analog: mig.config.state=failed)
 SLICE_PARTITION_FAILED = "SlicePartitionFailed"
 
+#: auxiliary condition type: one or more nodes are somewhere in the
+#: chip-health machine (degraded/quarantined/remediating/failed) — the
+#: cluster-level rollup of the per-node tpu.ai/health-state labels
+NODE_HEALTH_DEGRADED = "NodeHealthDegraded"
+REASON_NODE_HEALTH_DEGRADED = "NodeHealthDegraded"
+
 
 def make_condition(type_: str, status: str, reason: str, message: str = "") -> dict:
     return {

@@ -70,6 +70,32 @@ UPGRADE_FORCE_ATTEMPTED_ANNOTATION = "tpu.ai/tpu-driver-upgrade-force-attempted"
 #: rubber-stamp pods whose init-chain validations predate it
 UPGRADE_REVALIDATED_ANNOTATION = "tpu.ai/tpu-driver-upgrade-revalidated-for"
 
+# -- continuous chip-health remediation ---------------------------------------
+#: per-node chip-health state machine label (healthy -> degraded ->
+#: quarantined -> remediating -> recovered | failed), persisted like the
+#: upgrade label so operator restarts resume mid-remediation
+HEALTH_STATE_LABEL = "tpu.ai/health-state"
+#: when the node entered its current health state (RFC3339); drives the
+#: degraded-confirmation and remediation-wait budgets across restarts
+HEALTH_STATE_SINCE_ANNOTATION = "tpu.ai/health-state-since"
+#: bounded remediation: attempts already spent on the current episode
+HEALTH_ATTEMPTS_ANNOTATION = "tpu.ai/health-remediation-attempts"
+#: flap damper: comma-joined epoch seconds of recent healthy->degraded
+#: transitions; N entries inside the window trips sticky quarantine
+HEALTH_FLAP_HISTORY_ANNOTATION = "tpu.ai/health-flap-history"
+#: set when flap damping tripped: the machine stops transitioning (and
+#: writing) until an admin clears the health label or the driver template
+#: changes
+HEALTH_FLAP_STICKY_ANNOTATION = "tpu.ai/health-flap-sticky"
+#: driver-DS template fingerprint recorded when remediation exhausts:
+#: sticky failed clears only when the template actually changes (or the
+#: admin clears the health label)
+HEALTH_FAILED_TEMPLATE_ANNOTATION = "tpu.ai/health-failed-template"
+#: the node's workload-barrier verdict, published by feature discovery from
+#: the node-local barrier file so the operator's health sweep can read it:
+#: "passed" | "failed" | "failed:<chip,chip>" | "corrupt"
+WORKLOAD_HEALTH_ANNOTATION = "tpu.ai/workload-health"
+
 # -- labels read from the platform (GKE / device discovery) -------------------
 GKE_TPU_ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"
 GKE_TPU_TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"

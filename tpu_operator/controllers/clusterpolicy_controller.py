@@ -20,6 +20,8 @@ from ..api.clusterpolicy import ClusterPolicy, State
 from ..client.errors import ConflictError, NotFoundError
 from ..client.interface import Client, WatchEvent
 from ..conditions import (
+    NODE_HEALTH_DEGRADED,
+    REASON_NODE_HEALTH_DEGRADED,
     REASON_OPERAND_NOT_READY,
     REASON_READY,
     REASON_RECONCILE_FAILED,
@@ -32,6 +34,7 @@ from ..conditions import (
     mark_ready,
     set_condition,
 )
+from ..health import HealthCounts, HealthStateMachine
 from ..nodeinfo import label_tpu_nodes
 from ..state.manager import (
     INFO_CLUSTER_INFO,
@@ -65,6 +68,19 @@ class ClusterPolicyReconciler(Reconciler):
         self.cluster_info = cluster_info
         self.requeue_after = requeue_after
         self.state_manager = Manager(cluster_policy_states(client))
+        #: last-seen tpu.ai/slice.config.state per node, for counting
+        #: transitions INTO "retiled" (the counter must tick once per
+        #: re-tile event, not once per sweep that observes the state)
+        self._last_slice_state: dict = {}
+        #: last sweep's health rollup, surfaced on /debug/queue
+        self._last_health_counts: dict = {}
+
+    def debug_state(self) -> dict:
+        return {
+            "node_health": dict(self._last_health_counts),
+            "slice_states": {n: s for n, s in
+                             sorted(self._last_slice_state.items()) if s},
+        }
 
     # -- singleton guard (reference clusterpolicy_controller.go:121-126) ------
     def _resolve_singleton(self, request: Request) -> Optional[ClusterPolicy]:
@@ -155,6 +171,60 @@ class ClusterPolicyReconciler(Reconciler):
             set_condition(conditions, make_condition(
                 SLICE_PARTITION_FAILED, "False", REASON_READY, ""))
 
+    def _sweep_health(self, policy: ClusterPolicy,
+                      nodes: List[dict]) -> None:
+        """Drive the per-node chip-health machine and publish its rollup:
+        per-state gauges, the remediation-attempts counter, the retile
+        counter (transitions into tpu.ai/slice.config.state=retiled), and
+        a cluster-level NodeHealthDegraded condition + transition-gated
+        Event. Driven from THIS sweep (not a separate controller) so the
+        machine resumes mid-remediation on the same cadence that re-renders
+        the operands it recycles."""
+        # retile transitions are counted regardless of health.enabled: the
+        # partitioner re-tiles from the barrier on its own
+        for node in nodes:
+            name = node["metadata"]["name"]
+            state = deep_get(node, "metadata", "labels",
+                             consts.TPU_SLICE_STATE_LABEL)
+            if state == "retiled" and self._last_slice_state.get(name) != "retiled":
+                self.metrics.partition_retile_total.inc()
+            self._last_slice_state[name] = state
+
+        machine = HealthStateMachine(self.client, self.namespace,
+                                     policy.spec.health)
+        if not policy.spec.health.enabled:
+            machine.clear_all(nodes)
+            counts = HealthCounts(healthy=len(nodes))
+        else:
+            with tracing.phase_span("health-sweep") as sp:
+                counts = machine.process(nodes)
+                sp.set_attributes(**counts.as_dict())
+        self._last_health_counts = counts.as_dict()
+        for state, value in counts.as_dict().items():
+            self.metrics.node_health_state.labels(state=state).set(value)
+        if machine.attempts_fired:
+            self.metrics.remediation_attempts.inc(machine.attempts_fired)
+
+        unhealthy = {s: v for s, v in counts.as_dict().items()
+                     if s not in ("healthy", "recovered") and v}
+        conditions = policy.obj.setdefault("status", {}).setdefault(
+            "conditions", [])
+        current = get_condition(policy.obj, NODE_HEALTH_DEGRADED)
+        if unhealthy:
+            message = ("node chip-health: "
+                       + ", ".join(f"{v} {s}" for s, v in sorted(unhealthy.items())))
+            if (current is None or current.get("status") != "True"
+                    or current.get("message") != message):
+                events.record(self.client, self.namespace, policy.obj,
+                              events.WARNING, REASON_NODE_HEALTH_DEGRADED,
+                              message)
+            set_condition(conditions, make_condition(
+                NODE_HEALTH_DEGRADED, "True",
+                REASON_NODE_HEALTH_DEGRADED, message))
+        elif current is not None and current.get("status") == "True":
+            set_condition(conditions, make_condition(
+                NODE_HEALTH_DEGRADED, "False", REASON_READY, ""))
+
     def _reconcile(self, request: Request) -> Result:
         start = time.monotonic()
         try:
@@ -185,6 +255,7 @@ class ClusterPolicyReconciler(Reconciler):
         # writes: an exception between the Warning Event and the condition
         # landing on the CR would re-emit the event every backoff retry
         self._surface_slice_failures(policy, label_result.nodes)
+        self._sweep_health(policy, label_result.nodes)
         previous_state = deep_get(policy.obj, "status", "state")
 
         if results.ready:

@@ -60,6 +60,19 @@ class OperatorMetrics:
             "tpu_operator_slice_partition_failed_nodes",
             "Nodes whose slice partitioner rejected the desired partition "
             "(tpu.ai/slice.config.state=failed)", registry=self.registry)
+        self.node_health_state = Gauge(
+            "tpu_operator_node_health_state",
+            "Nodes in each chip-health state (tpu.ai/health-state label: "
+            "healthy/degraded/quarantined/remediating/recovered/failed)",
+            ["state"], registry=self.registry)
+        self.remediation_attempts = Counter(
+            "tpu_operator_remediation_attempts_total",
+            "Chip-health remediation actions fired (validator recycle, "
+            "escalating to driver restart)", registry=self.registry)
+        self.partition_retile_total = Counter(
+            "tpu_operator_partition_retile_total",
+            "Node transitions into a health-aware re-tiled slice layout "
+            "(tpu.ai/slice.config.state=retiled)", registry=self.registry)
 
         # controller-runtime/client-go equivalents (workqueue + rest client)
         self.workqueue_depth = Gauge(

@@ -347,6 +347,10 @@ class Controller:
             "worker_alive": self._thread.is_alive() if self._thread else False,
         }
         state.update(self.queue.debug_state())
+        # reconciler-specific introspection (e.g. the clusterpolicy
+        # reconciler's node-health rollup) rides the same page
+        if hasattr(self.reconciler, "debug_state"):
+            state.update(self.reconciler.debug_state())
         return state
 
     def stop(self) -> None:

@@ -190,6 +190,11 @@ class TPUDevicePlugin:
             info = None  # absent — grace path below, never "unreadable"
         except (OSError, ValueError):
             return UNHEALTHY, None  # present but unreadable/corrupt: fail safe
+        if not isinstance(info, dict) and info is not None:
+            # valid JSON that is not an object (bare list/number) is just
+            # as corrupt as truncated bytes — fail safe, don't crash on
+            # info.get below
+            return UNHEALTHY, None
         if info is not None:
             self._workload_gone_at = None
             if info.get("passed") is False:

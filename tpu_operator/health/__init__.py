@@ -1,0 +1,15 @@
+"""Continuous chip-health remediation (per-node degraded-state machine)."""
+
+from .machine import (  # noqa: F401
+    DEGRADED,
+    FAILED,
+    HEALTHY,
+    HealthCounts,
+    HealthStateMachine,
+    QUARANTINED,
+    RECOVERED,
+    REMEDIATING,
+    STATES,
+    node_health_state,
+    parse_workload_health,
+)

@@ -1,0 +1,492 @@
+"""Per-node chip-health degraded-state machine.
+
+Closes the detect -> degrade -> remediate -> recover loop for chips that go
+bad AFTER node join: the default-on revalidation sweep keeps the node-local
+workload barrier fresh, feature discovery publishes its verdict as the
+``tpu.ai/workload-health`` node annotation, and this machine — driven from
+the ClusterPolicy reconcile sweep exactly like the upgrade machine
+(``upgrade/machine.py``) — walks the node through
+
+    healthy -> degraded -> quarantined -> remediating -> recovered | failed
+
+persisting every step in the ``tpu.ai/health-state`` node label and
+``-since``/attempt/flap annotations, so an operator crash at any point
+resumes mid-remediation from cluster state alone.
+
+Design decisions mirrored from the upgrade machine:
+
+- state label + RFC3339 ``-since`` annotation written in ONE patch; the
+  since value drives wait budgets across operator restarts
+- bounded remediation: attempt 1 recycles the node's validator pods (the
+  init-chain re-runs every validation against the live chips); attempts
+  >= 2 also restart the driver pods (libtpu reinstall). Attempts are
+  persisted in an annotation so a crash never resets the budget.
+- sticky ``failed`` records the driver-DS template fingerprint — it clears
+  only when the template actually changes (new driver supersedes the
+  failure) or an admin removes the health label
+- flap damping: N healthy->degraded transitions inside a window trip a
+  STICKY quarantine with exactly one Event, then the machine stops writing
+  for that node (bounded label/API writes under flapping, the drift-heal
+  damper's pattern)
+"""
+
+from __future__ import annotations
+
+import calendar
+import dataclasses
+import logging
+import time
+from typing import Dict, List, Optional
+
+from .. import consts, events
+from ..client.errors import ApiError, NotFoundError
+from ..client.interface import Client
+from ..utils import deep_get
+
+log = logging.getLogger(__name__)
+
+#: no label = healthy (the steady state writes nothing, like upgrade UNKNOWN)
+HEALTHY = ""
+DEGRADED = "degraded"
+QUARANTINED = "quarantined"
+REMEDIATING = "remediating"
+RECOVERED = "recovered"
+FAILED = "failed"
+
+STATES = (DEGRADED, QUARANTINED, REMEDIATING, RECOVERED, FAILED)
+
+#: component labels of the pods remediation recycles (stamped by our
+#: manifests; same values the upgrade machine targets)
+VALIDATOR_COMPONENT = "tpu-operator-validator"
+DRIVER_COMPONENT = "tpu-driver"
+
+
+def node_health_state(node: dict) -> str:
+    return deep_get(node, "metadata", "labels", consts.HEALTH_STATE_LABEL,
+                    default=HEALTHY)
+
+
+def parse_workload_health(node: dict) -> Optional[bool]:
+    """The node's published barrier verdict: True = passing, False =
+    failing or corrupt, None = no information (feature discovery has not
+    published yet / node predates the annotation) — absence must never be
+    treated as failure, or every fresh node would start degraded."""
+    raw = deep_get(node, "metadata", "annotations",
+                   consts.WORKLOAD_HEALTH_ANNOTATION)
+    if not raw:
+        return None
+    return raw == "passed"
+
+
+def failed_chips_from_annotation(node: dict) -> Optional[List[int]]:
+    """Chip ids carried by a ``failed:<csv>`` verdict (None when the
+    failure is unattributed or the verdict is not a failure)."""
+    raw = deep_get(node, "metadata", "annotations",
+                   consts.WORKLOAD_HEALTH_ANNOTATION) or ""
+    if not raw.startswith("failed:"):
+        return None
+    try:
+        return sorted(int(c) for c in raw[len("failed:"):].split(",") if c)
+    except ValueError:
+        return None
+
+
+@dataclasses.dataclass
+class HealthCounts:
+    healthy: int = 0
+    degraded: int = 0
+    quarantined: int = 0
+    remediating: int = 0
+    recovered: int = 0
+    failed: int = 0
+
+    def as_dict(self) -> Dict[str, int]:
+        return dataclasses.asdict(self)
+
+    def merged(self, other: "HealthCounts") -> "HealthCounts":
+        return HealthCounts(**{
+            field.name: getattr(self, field.name) + getattr(other, field.name)
+            for field in dataclasses.fields(self)})
+
+
+class HealthStateMachine:
+    def __init__(self, client: Client, namespace: str, policy=None,
+                 now=time.time):
+        from ..api.clusterpolicy import HealthSpec
+
+        self.client = client
+        self.namespace = namespace
+        self.policy = policy or HealthSpec()
+        self._now = now  # injectable clock for budget/flap tests
+        #: remediation actions fired THIS sweep — the reconciler adds this
+        #: to the tpu_operator_remediation_attempts_total counter
+        self.attempts_fired = 0
+
+    # -- cluster inspection ---------------------------------------------------
+    def _pods_on(self, node_name: str, component: str) -> List[dict]:
+        return self.client.list(
+            "v1", "Pod", self.namespace,
+            label_selector={"app.kubernetes.io/component": component},
+            field_selector={"spec.nodeName": node_name})
+
+    def _delete_pod(self, pod: dict) -> None:
+        try:
+            self.client.delete("v1", "Pod", pod["metadata"]["name"],
+                               pod["metadata"].get("namespace"))
+        except NotFoundError:
+            pass
+
+    def _driver_ds_for(self, node: dict) -> Optional[dict]:
+        from ..state.skel import node_matches_selector
+
+        for ds in self.client.list("apps/v1", "DaemonSet", self.namespace):
+            component = deep_get(ds, "spec", "template", "metadata", "labels",
+                                 "app.kubernetes.io/component")
+            if component != DRIVER_COMPONENT:
+                continue
+            selector = deep_get(ds, "spec", "template", "spec",
+                                "nodeSelector", default={})
+            if node_matches_selector(node, selector):
+                return ds
+        return None
+
+    @staticmethod
+    def _template_fingerprint(ds: Optional[dict]) -> str:
+        """Driver-DS pod-template fingerprint (same value the upgrade
+        machine records): sticky failed/flap states clear when it changes,
+        because a rolled driver supersedes the failed remediation."""
+        from ..utils.hash import template_fingerprint
+
+        tpl = deep_get(ds or {}, "spec", "template", default={})
+        return deep_get(tpl, "metadata", "labels",
+                        consts.TEMPLATE_HASH_LABEL) or template_fingerprint(tpl)
+
+    # -- node writes ----------------------------------------------------------
+    def _set_state(self, node: dict, state: str,
+                   extra_annotations: Optional[Dict[str, Optional[str]]] = None
+                   ) -> None:
+        """Label + since-annotation in one patch, mirrored locally (the
+        sweep keeps working against its snapshot)."""
+        name = node["metadata"]["name"]
+        log.info("health: node %s -> %s", name, state or "healthy")
+        since = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                              time.gmtime(self._now())) if state else None
+        ann_patch: Dict[str, Optional[str]] = {
+            consts.HEALTH_STATE_SINCE_ANNOTATION: since}
+        if not state:
+            # back to healthy: drop episode bookkeeping. The flap history
+            # deliberately SURVIVES (flap damping must see recoveries that
+            # immediately re-degrade); it is pruned by its window.
+            ann_patch[consts.HEALTH_ATTEMPTS_ANNOTATION] = None
+            ann_patch[consts.HEALTH_FAILED_TEMPLATE_ANNOTATION] = None
+            ann_patch[consts.HEALTH_FLAP_STICKY_ANNOTATION] = None
+        ann_patch.update(extra_annotations or {})
+        self.client.patch("v1", "Node", name, {"metadata": {
+            "labels": {consts.HEALTH_STATE_LABEL: state or None},
+            "annotations": ann_patch,
+        }})
+        meta = node.setdefault("metadata", {})
+        labels = meta.setdefault("labels", {})
+        if state:
+            labels[consts.HEALTH_STATE_LABEL] = state
+        else:
+            labels.pop(consts.HEALTH_STATE_LABEL, None)
+        anns = meta.setdefault("annotations", {})
+        for key, value in ann_patch.items():
+            if value is None:
+                anns.pop(key, None)
+            else:
+                anns[key] = value
+
+    def _annotate(self, node: dict, key: str, value: Optional[str]) -> None:
+        current = deep_get(node, "metadata", "annotations", key)
+        if current == value:
+            return
+        self.client.patch("v1", "Node", node["metadata"]["name"],
+                          {"metadata": {"annotations": {key: value}}})
+        annotations = node.setdefault("metadata", {}).setdefault("annotations", {})
+        if value is None:
+            annotations.pop(key, None)
+        else:
+            annotations[key] = value
+
+    def _cordon(self, node: dict, unschedulable: bool) -> None:
+        self.client.patch("v1", "Node", node["metadata"]["name"],
+                          {"spec": {"unschedulable": unschedulable or None}})
+        node.setdefault("spec", {})["unschedulable"] = unschedulable or None
+
+    def _state_age(self, node: dict) -> float:
+        """Seconds in the current state; absent/corrupt stamps now and
+        returns 0 (fresh budget beats instant escalation — same rule as
+        the upgrade machine)."""
+        raw = deep_get(node, "metadata", "annotations",
+                       consts.HEALTH_STATE_SINCE_ANNOTATION)
+        if raw:
+            try:
+                since = calendar.timegm(time.strptime(raw, "%Y-%m-%dT%H:%M:%SZ"))
+                return max(0.0, self._now() - since)
+            except ValueError:
+                pass
+        self._set_state(node, node_health_state(node))
+        return 0.0
+
+    def _event(self, node: dict, type_: str, reason: str, message: str) -> None:
+        events.record(self.client, self.namespace, node, type_, reason, message)
+
+    # -- flap damping ---------------------------------------------------------
+    def _flap_history(self, node: dict) -> List[int]:
+        raw = deep_get(node, "metadata", "annotations",
+                       consts.HEALTH_FLAP_HISTORY_ANNOTATION) or ""
+        out = []
+        for part in raw.split(","):
+            try:
+                out.append(int(part))
+            except ValueError:
+                continue
+        cutoff = self._now() - self.policy.flap_window_s
+        return [t for t in out if t >= cutoff]
+
+    def _record_degraded_entry(self, node: dict) -> bool:
+        """Append a healthy->degraded transition to the flap history.
+        Returns True when the damper tripped (threshold entries inside the
+        window) — the caller then goes sticky-quarantined instead of
+        degraded."""
+        history = self._flap_history(node) + [int(self._now())]
+        self._annotate(node, consts.HEALTH_FLAP_HISTORY_ANNOTATION,
+                       ",".join(str(t) for t in history))
+        return len(history) >= self.policy.flap_threshold
+
+    # -- remediation ----------------------------------------------------------
+    def _remediate(self, node: dict, attempt: int) -> None:
+        """One bounded remediation attempt. Attempt 1: recycle the node's
+        validator pods — the DS controller recreates them and the init
+        chain re-runs the full validation sweep against the live chips
+        (the forced local revalidation). Attempts >= 2 escalate: also
+        restart the driver pods (libtpu reinstall) before revalidating."""
+        name = node["metadata"]["name"]
+        self.attempts_fired += 1
+        if attempt >= 2:
+            for pod in self._pods_on(name, DRIVER_COMPONENT):
+                self._delete_pod(pod)
+        for pod in self._pods_on(name, VALIDATOR_COMPONENT):
+            self._delete_pod(pod)
+
+    # -- the sweep ------------------------------------------------------------
+    def process(self, nodes: List[dict]) -> HealthCounts:
+        counts = HealthCounts()
+        for node in nodes:
+            try:
+                state = self._process_node(node)
+            except ApiError as e:
+                log.warning("health: node %s sweep error: %s",
+                            node["metadata"]["name"], e)
+                state = node_health_state(node)
+            if state == HEALTHY:
+                counts.healthy += 1
+            else:
+                setattr(counts, state, getattr(counts, state) + 1)
+        return counts
+
+    def _process_node(self, node: dict) -> str:
+        name = node["metadata"]["name"]
+        state = node_health_state(node)
+        verdict = parse_workload_health(node)
+        anns = deep_get(node, "metadata", "annotations", default={}) or {}
+
+        if state == HEALTHY:
+            # manual label clear is the admin escape hatch out of BOTH
+            # sticky states: wipe every health annotation (including the
+            # flap history — without this the next degraded would re-trip
+            # sticky quarantine instantly) and start fresh
+            leftovers = [k for k in (consts.HEALTH_STATE_SINCE_ANNOTATION,
+                                     consts.HEALTH_ATTEMPTS_ANNOTATION,
+                                     consts.HEALTH_FLAP_STICKY_ANNOTATION,
+                                     consts.HEALTH_FAILED_TEMPLATE_ANNOTATION,
+                                     consts.HEALTH_FLAP_HISTORY_ANNOTATION)
+                         if k in anns]
+            if leftovers and (consts.HEALTH_FLAP_STICKY_ANNOTATION in anns
+                              or consts.HEALTH_FAILED_TEMPLATE_ANNOTATION in anns):
+                self.client.patch("v1", "Node", name, {"metadata": {
+                    "annotations": {k: None for k in leftovers}}})
+                for k in leftovers:
+                    anns.pop(k, None)
+            if verdict is False:
+                if self._record_degraded_entry(node):
+                    self._set_state(node, QUARANTINED, extra_annotations={
+                        consts.HEALTH_FLAP_STICKY_ANNOTATION:
+                            self._template_fingerprint(self._driver_ds_for(node))})
+                    if self.policy.cordon_on_quarantine:
+                        self._cordon(node, True)
+                    # exactly ONE Event: the sticky branch below never
+                    # writes again until template change or manual clear
+                    self._event(node, events.WARNING, "NodeHealthFlapping",
+                                f"{name}: {self.policy.flap_threshold} "
+                                f"health flaps within "
+                                f"{self.policy.flap_window_s}s; sticky "
+                                f"quarantine until driver template changes "
+                                f"or the {consts.HEALTH_STATE_LABEL} label "
+                                f"is cleared")
+                    return QUARANTINED
+                self._set_state(node, DEGRADED)
+                self._event(node, events.WARNING, "NodeHealthDegraded",
+                            f"{name}: workload barrier regressed "
+                            f"({anns.get(consts.WORKLOAD_HEALTH_ANNOTATION)})")
+                return DEGRADED
+            return HEALTHY
+
+        if state == FAILED:
+            # sticky: clears only on template change (rolled driver
+            # supersedes the failure) — manual label clear is handled by
+            # the HEALTHY branch above once the admin removes the label
+            recorded = anns.get(consts.HEALTH_FAILED_TEMPLATE_ANNOTATION)
+            fingerprint = self._template_fingerprint(self._driver_ds_for(node))
+            if recorded is not None and recorded != fingerprint:
+                if self.policy.cordon_on_quarantine:
+                    self._cordon(node, False)
+                self._set_state(node, HEALTHY)
+                self._event(node, events.NORMAL, "NodeHealthReset",
+                            f"{name}: driver template changed; retrying "
+                            f"health remediation from scratch")
+                return HEALTHY
+            return FAILED
+
+        if state == QUARANTINED and consts.HEALTH_FLAP_STICKY_ANNOTATION in anns:
+            # flap-damped: NO writes until the template rolls or an admin
+            # clears the label (bounded API writes under flapping)
+            recorded = anns[consts.HEALTH_FLAP_STICKY_ANNOTATION]
+            fingerprint = self._template_fingerprint(self._driver_ds_for(node))
+            if recorded and recorded != fingerprint:
+                if self.policy.cordon_on_quarantine:
+                    self._cordon(node, False)
+                self._set_state(node, HEALTHY, extra_annotations={
+                    consts.HEALTH_FLAP_HISTORY_ANNOTATION: None})
+                self._event(node, events.NORMAL, "NodeHealthReset",
+                            f"{name}: driver template changed; flap "
+                            f"quarantine lifted")
+                return HEALTHY
+            return QUARANTINED
+
+        if state == DEGRADED:
+            if verdict is not False:
+                # one-sweep blip (or verdict withdrawn): back to healthy
+                # without the full recovery ceremony
+                self._set_state(node, HEALTHY)
+                self._event(node, events.NORMAL, "NodeHealthRecovered",
+                            f"{name}: workload barrier recovered before "
+                            f"quarantine")
+                return HEALTHY
+            # still failing on a later sweep: confirmed, quarantine
+            self._set_state(node, QUARANTINED)
+            if self.policy.cordon_on_quarantine:
+                self._cordon(node, True)
+            self._event(node, events.WARNING, "NodeHealthQuarantined",
+                        f"{name}: chip failure confirmed; unit(s) "
+                        f"quarantined"
+                        + (f" (chips {failed_chips_from_annotation(node)})"
+                           if failed_chips_from_annotation(node) else ""))
+            return QUARANTINED
+
+        if state == QUARANTINED:
+            if verdict is True:
+                return self._recover(node)
+            self._set_state(node, REMEDIATING, extra_annotations={
+                consts.HEALTH_ATTEMPTS_ANNOTATION: "1"})
+            self._remediate(node, 1)
+            self._event(node, events.NORMAL, "NodeHealthRemediating",
+                        f"{name}: remediation attempt 1/"
+                        f"{self.policy.max_remediation_attempts} "
+                        f"(validator recycle, forced revalidation)")
+            return REMEDIATING
+
+        if state == REMEDIATING:
+            if verdict is True:
+                return self._recover(node)
+            attempts = 1
+            try:
+                attempts = int(anns.get(consts.HEALTH_ATTEMPTS_ANNOTATION, "1"))
+            except ValueError:
+                pass
+            if self._state_age(node) < self.policy.remediation_wait_s:
+                return REMEDIATING  # give the attempt time to produce a verdict
+            if attempts >= self.policy.max_remediation_attempts:
+                ds = self._driver_ds_for(node)
+                self._set_state(node, FAILED, extra_annotations={
+                    consts.HEALTH_FAILED_TEMPLATE_ANNOTATION:
+                        self._template_fingerprint(ds)})
+                self._event(node, events.WARNING, "NodeHealthFailed",
+                            f"{name}: {attempts} remediation attempt(s) "
+                            f"exhausted; sticky failed until the driver "
+                            f"template changes or the "
+                            f"{consts.HEALTH_STATE_LABEL} label is cleared")
+                return FAILED
+            attempts += 1
+            # restamp since (fresh budget) + bump attempts in one patch
+            self._set_state(node, REMEDIATING, extra_annotations={
+                consts.HEALTH_ATTEMPTS_ANNOTATION: str(attempts)})
+            self._remediate(node, attempts)
+            self._event(node, events.NORMAL, "NodeHealthRemediating",
+                        f"{name}: remediation attempt {attempts}/"
+                        f"{self.policy.max_remediation_attempts}"
+                        + (" (driver restart + revalidation)"
+                           if attempts >= 2 else ""))
+            return REMEDIATING
+
+        if state == RECOVERED:
+            if verdict is False:
+                # relapse: straight back to degraded (flap history records
+                # it via the next healthy->degraded entry... but this IS a
+                # flap — record it here so recover/relapse cycles trip the
+                # damper even though the label never touched healthy)
+                if self._record_degraded_entry(node):
+                    self._set_state(node, QUARANTINED, extra_annotations={
+                        consts.HEALTH_FLAP_STICKY_ANNOTATION:
+                            self._template_fingerprint(self._driver_ds_for(node))})
+                    if self.policy.cordon_on_quarantine:
+                        self._cordon(node, True)
+                    self._event(node, events.WARNING, "NodeHealthFlapping",
+                                f"{name}: relapse after recovery tripped "
+                                f"flap damping; sticky quarantine")
+                    return QUARANTINED
+                self._set_state(node, DEGRADED)
+                self._event(node, events.WARNING, "NodeHealthDegraded",
+                            f"{name}: relapsed after recovery")
+                return DEGRADED
+            # settled: leave the machine (label cleared, flap history kept)
+            self._set_state(node, HEALTHY)
+            return HEALTHY
+
+        # unknown label value (manual edit): treat as degraded-equivalent
+        # input and let the verdict route it
+        log.warning("health: node %s has unknown state %r", name, state)
+        self._set_state(node, DEGRADED if verdict is False else HEALTHY)
+        return node_health_state(node)
+
+    def _recover(self, node: dict) -> str:
+        name = node["metadata"]["name"]
+        if self.policy.cordon_on_quarantine:
+            self._cordon(node, False)
+        self._set_state(node, RECOVERED, extra_annotations={
+            consts.HEALTH_ATTEMPTS_ANNOTATION: None})
+        self._event(node, events.NORMAL, "NodeHealthRecovered",
+                    f"{name}: workload barrier passing again; restoring "
+                    f"configured layout")
+        return RECOVERED
+
+    def clear_all(self, nodes: List[dict]) -> None:
+        """health.enabled=false: remove our labels/annotations (but keep
+        sticky-failed visible? No — disabled means disabled; an admin
+        turning the machine off gets their nodes back untouched)."""
+        for node in nodes:
+            anns = deep_get(node, "metadata", "annotations", default={}) or {}
+            has_ann = any(k in anns for k in (
+                consts.HEALTH_STATE_SINCE_ANNOTATION,
+                consts.HEALTH_ATTEMPTS_ANNOTATION,
+                consts.HEALTH_FLAP_HISTORY_ANNOTATION,
+                consts.HEALTH_FLAP_STICKY_ANNOTATION,
+                consts.HEALTH_FAILED_TEMPLATE_ANNOTATION))
+            if node_health_state(node) == HEALTHY and not has_ann:
+                continue
+            if self.policy.cordon_on_quarantine:
+                self._cordon(node, False)
+            self._set_state(node, HEALTHY, extra_annotations={
+                consts.HEALTH_FLAP_HISTORY_ANNOTATION: None})

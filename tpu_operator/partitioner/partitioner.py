@@ -39,6 +39,10 @@ HANDOFF_FILE = "partition.json"
 STATE_PENDING = "pending"
 STATE_SUCCESS = "success"
 STATE_FAILED = "failed"
+#: the configured layout is applied MINUS health-gated chips: the tiler
+#: re-placed every group on the healthy subset of the grid. Restored to
+#: ``success`` automatically when the workload barrier passes again.
+STATE_RETILED = "retiled"
 
 
 class PartitionError(ValueError):
@@ -60,15 +64,18 @@ def load_config(path: str) -> Dict[str, List[dict]]:
 
 
 def compute_partition(layout: List[dict], total_chips: int,
-                      accelerator: str) -> List[dict]:
+                      accelerator: str,
+                      blocked: Optional[frozenset] = None) -> List[dict]:
     """Expand a named layout into explicit chip-id groups, validated
     against the generation's physical ICI grid: every group is an
     axis-aligned box on the host grid (provably adjacent) and its topology
     string is DERIVED from the placed shape, never copied from config
     (reference: only vendor-validated MIG profiles apply,
-    object_controls.go:2410-2422). See topology.tile_partition."""
+    object_controls.go:2410-2422). ``blocked`` chips (health-gated) are
+    excluded from placement. See topology.tile_partition."""
     try:
-        return topology.tile_partition(accelerator, total_chips, layout)
+        return topology.tile_partition(accelerator, total_chips, layout,
+                                       blocked=blocked)
     except topology.TopologyError as e:
         # config nonsense (typed chips/count/topology/shape problems) is a
         # partition failure with an entry-naming reason; anything ELSE
@@ -78,7 +85,8 @@ def compute_partition(layout: List[dict], total_chips: int,
 
 def write_handoff(groups: List[dict], name: str,
                   handoff_dir: str = DEFAULT_HANDOFF_DIR,
-                  grid: Optional[tuple] = None) -> str:
+                  grid: Optional[tuple] = None,
+                  blocked: Optional[List[int]] = None) -> str:
     os.makedirs(handoff_dir, exist_ok=True)
     path = os.path.join(handoff_dir, HANDOFF_FILE)
     tmp = path + ".tmp"
@@ -87,6 +95,11 @@ def write_handoff(groups: List[dict], name: str,
         # the device plugin's GetPreferredAllocation compactness metric
         # reads the real host grid instead of guessing from chip count
         payload["grid"] = list(grid)
+    if blocked:
+        # health-gated chips this layout was re-tiled around: part of the
+        # handoff identity, so recovery (blocked -> empty) is a content
+        # change that restores the configured layout
+        payload["blocked"] = list(blocked)
     with open(tmp, "w") as f:
         json.dump(payload, f)
     os.replace(tmp, path)  # the device plugin must never read a torn file
@@ -131,10 +144,30 @@ def _consumers_or_none(client, node_name: str) -> Optional[int]:
         return None
 
 
+def health_gated_chips(status_dir: Optional[str],
+                       total_chips: int) -> frozenset:
+    """Chips the node-local workload barrier currently implicates — the set
+    the health-aware re-tile places around. Empty when the barrier passes,
+    has not been written, or records a failure that cannot be attributed to
+    specific chips (an unattributed failure gates EVERY chip at the device
+    plugin; no re-tile can route around all of them)."""
+    from ..validator.status import StatusFiles, failed_local_chips
+
+    status = StatusFiles(status_dir) if status_dir else StatusFiles()
+    info = status.read("workload")
+    if info is None or info.get("passed") is not False:
+        return frozenset()
+    return failed_local_chips(info, total_chips) or frozenset()
+
+
 def sync_once(client, node_name: str, config_path: str,
               handoff_dir: str = DEFAULT_HANDOFF_DIR,
-              total_chips: Optional[int] = None) -> Optional[str]:
+              total_chips: Optional[int] = None,
+              status_dir: Optional[str] = None) -> Optional[str]:
     """One reconcile pass; returns the state written (None = nothing to do)."""
+    if status_dir is None:
+        status_dir = os.environ.get("STATUS_DIR",
+                                    consts.VALIDATION_STATUS_DIR)
     node = client.get("v1", "Node", node_name)
     labels = deep_get(node, "metadata", "labels", default={}) or {}
     desired = labels.get(consts.TPU_SLICE_CONFIG_LABEL)
@@ -187,11 +220,33 @@ def sync_once(client, node_name: str, config_path: str,
             log.info("partition %s on %s: generation label not yet "
                      "present; pending", desired, node_name)
             return STATE_PENDING
-        groups = compute_partition(table[desired], total_chips, accelerator)
+        blocked = sorted(health_gated_chips(status_dir, total_chips))
+        target_state = STATE_SUCCESS
+        if blocked:
+            try:
+                groups = compute_partition(table[desired], total_chips,
+                                           accelerator,
+                                           blocked=frozenset(blocked))
+                target_state = STATE_RETILED
+            except PartitionError as e:
+                # the re-tile is impossible (not enough healthy chips /
+                # no adjacent placement): DEFER, don't fail — the
+                # configured layout itself is still valid, the chips are
+                # merely gated; remediation or recovery resolves it
+                if state != STATE_PENDING:
+                    set_state(STATE_PENDING)
+                log.warning("partition %s on %s: re-tile around gated "
+                            "chip(s) %s impossible (%s); deferred until "
+                            "recovery", desired, node_name, blocked, e)
+                return STATE_PENDING
+        else:
+            groups = compute_partition(table[desired], total_chips,
+                                       accelerator)
         grid = list(topology.host_grid(accelerator, total_chips))
         if (current and current.get("partition") == desired
                 and current.get("groups") == groups
-                and current.get("grid") == grid):
+                and current.get("grid") == grid
+                and current.get("blocked", []) == blocked):
             # already applied — verified by CONTENT, not just the partition
             # name: a handoff written by an older partitioner version
             # (sequential chip groups, no grid) must be recomputed on
@@ -201,9 +256,9 @@ def sync_once(client, node_name: str, config_path: str,
             # scheduled against that very layout must not block the
             # label from healing to success (the in-use guard below only
             # applies to actual content changes)
-            if state != STATE_SUCCESS:
-                set_state(STATE_SUCCESS)
-            return STATE_SUCCESS
+            if state != target_state:
+                set_state(target_state)
+            return target_state
         busy = _consumers_or_none(client, node_name)
         if busy != 0:
             # changing the layout re-IDs every schedulable unit; never
@@ -221,10 +276,17 @@ def sync_once(client, node_name: str, config_path: str,
                         else f"{busy} TPU-consuming pod(s) running")
             return STATE_PENDING
         set_state(STATE_PENDING)
-        write_handoff(groups, desired, handoff_dir, grid=grid)
-        set_state(STATE_SUCCESS)
-        log.info("partition %s applied on %s: %d group(s)", desired, node_name, len(groups))
-        return STATE_SUCCESS
+        write_handoff(groups, desired, handoff_dir, grid=grid,
+                      blocked=blocked)
+        set_state(target_state)
+        if blocked:
+            log.info("partition %s RE-TILED on %s around gated chip(s) "
+                     "%s: %d group(s)", desired, node_name, blocked,
+                     len(groups))
+        else:
+            log.info("partition %s applied on %s: %d group(s)",
+                     desired, node_name, len(groups))
+        return target_state
     except (PartitionError, OSError, ValueError) as e:
         log.error("partition %s failed on %s: %s", desired, node_name, e)
         set_state(STATE_FAILED)

@@ -139,6 +139,15 @@ def _chip_id(coord: Tuple[int, ...], grid: Tuple[int, ...]) -> int:
     return chip
 
 
+def _chip_coord(chip: int, grid: Tuple[int, ...]) -> Tuple[int, ...]:
+    """Inverse of :func:`_chip_id` (row-major, last axis fastest)."""
+    coord = []
+    for g in reversed(grid):
+        coord.append(chip % g)
+        chip //= g
+    return tuple(reversed(coord))
+
+
 def _anchors(shape: Tuple[int, ...], grid: Tuple[int, ...],
              occupied: set):
     """All feasible placements of the box, as cell lists, in row-major
@@ -171,17 +180,32 @@ def _tile(shapes: List[Tuple[int, ...]], grid: Tuple[int, ...],
 
 
 def tile_partition(accelerator: str, total_chips: int,
-                   layout: List[dict]) -> List[dict]:
+                   layout: List[dict],
+                   blocked: Optional[Sequence[int]] = None) -> List[dict]:
     """Expand a named layout into chip groups that are PROVABLY
     ICI-adjacent: each group is an axis-aligned box placed on the host's
     physical grid, with the topology string derived from the placed shape
     rather than copied from config.
 
+    ``blocked`` chips (health-gated by a failed workload barrier) are
+    seeded as occupied grid cells before placement: every group the tiler
+    returns is made of healthy chips only, still box-adjacent — the
+    health-aware re-tile. ``count: "all"`` entries scale down to the
+    remaining healthy chips instead of demanding the blocked ones back.
+
     Raises TopologyError for impossible splits: unknown generation, a shape
     that doesn't exist on this host, a declared topology whose area
-    contradicts the chip count, or boxes that cannot tile the grid.
+    contradicts the chip count, boxes that cannot tile the grid, or a
+    blocked chip id outside the host's chip range.
     """
     grid = host_grid(accelerator, total_chips)
+    occupied: set = set()
+    for chip in sorted(set(blocked or [])):
+        if not 0 <= int(chip) < total_chips:
+            raise TopologyError(
+                f"blocked chip {chip} outside this host's 0..{total_chips - 1}")
+        occupied.add(_chip_coord(int(chip), grid))
+    available = total_chips - len(occupied)
     shapes: List[Tuple[int, ...]] = []
     used = 0
     for entry in layout or []:
@@ -200,7 +224,7 @@ def tile_partition(accelerator: str, total_chips: int,
         # clamp: an "all" entry after an overflowing fixed-count one must
         # not decrement `used` and mask the explicit overflow diagnostic
         if count == "all":
-            n = max((total_chips - used) // chips, 0)
+            n = max((available - used) // chips, 0)
         else:
             try:
                 n = int(count)
@@ -210,14 +234,19 @@ def tile_partition(accelerator: str, total_chips: int,
                     f"'all'") from None
         shapes.extend([shape] * n)
         used += chips * n
-    if used > total_chips:
+    if used > available:
         raise TopologyError(
-            f"layout requests {used} chip(s) but the host has {total_chips}")
-    placed = _tile(shapes, grid, set())
+            f"layout requests {used} chip(s) but the host has {available} "
+            f"available" + (f" ({total_chips} total, "
+                            f"{total_chips - available} health-gated)"
+                            if available != total_chips else ""))
+    placed = _tile(shapes, grid, occupied)
     if placed is None:
         raise TopologyError(
             f"cannot place {[format_topology(s) for s in shapes]} "
-            f"sub-slice(s) on the {format_topology(grid)} grid")
+            f"sub-slice(s) on the {format_topology(grid)} grid"
+            + (f" with chip(s) {sorted(_chip_id(c, grid) for c in occupied)} "
+               f"health-gated" if occupied else ""))
     return [{
         "topology": format_topology(shape),
         "chips": sorted(_chip_id(c, grid) for c in cells),

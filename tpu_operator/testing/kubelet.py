@@ -16,7 +16,7 @@ from __future__ import annotations
 import copy
 import logging
 import threading
-from typing import Optional
+from typing import Callable, Optional
 
 import requests
 
@@ -32,7 +32,8 @@ log = logging.getLogger(__name__)
 class KubeletSimulator:
     def __init__(self, client: Client, namespace: str = consts.DEFAULT_NAMESPACE,
                  chips_per_node: int = 4, interval: float = 0.05,
-                 rollout_ticks: int = 0, create_pods: bool = False):
+                 rollout_ticks: int = 0, create_pods: bool = False,
+                 validation_exec: Optional[Callable[[dict], int]] = None):
         self.client = client
         self.namespace = namespace
         self.chips_per_node = chips_per_node
@@ -42,6 +43,11 @@ class KubeletSimulator:
         #: RollingUpdate replaces outdated pods automatically, OnDelete only
         #: recreates after someone (e.g. the upgrade machine) deletes them
         self.create_pods = create_pods
+        #: optional "container runtime" for validation pods: called with the
+        #: pod object, returns the exit code; 0 -> Succeeded, else Failed.
+        #: Lets tests execute the RENDERED command/args/env through the real
+        #: validator CLI instead of teleporting pods to Succeeded.
+        self.validation_exec = validation_exec
         self._seen: dict = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -177,14 +183,26 @@ class KubeletSimulator:
 
     def _complete_validation_pods(self) -> None:
         """Pinned validation pods (workload + multihost rendezvous) run to
-        completion instantly in the simulator."""
+        completion instantly in the simulator — through ``validation_exec``
+        when the test supplied a runtime, else teleported to Succeeded."""
         for pod in self.client.list("v1", "Pod", self.namespace):
             app = deep_get(pod, "metadata", "labels", "app", default="")
             if app not in ("tpu-multihost-validation", "tpu-workload-validation"):
                 continue
-            if deep_get(pod, "status", "phase") != "Succeeded":
-                pod["status"] = {"phase": "Succeeded"}
-                self.client.update_status(pod)
+            if deep_get(pod, "status", "phase") in ("Succeeded", "Failed"):
+                continue  # terminal, restartPolicy: Never
+            if self.validation_exec is not None:
+                try:
+                    rc = self.validation_exec(pod)
+                except Exception:  # a crashed container is a Failed pod
+                    log.exception("validation_exec crashed for pod %s",
+                                  pod["metadata"]["name"])
+                    rc = 1
+                phase = "Succeeded" if rc == 0 else "Failed"
+            else:
+                phase = "Succeeded"
+            pod["status"] = {"phase": phase}
+            self.client.update_status(pod)
 
     @staticmethod
     def _is_device_plugin(ds: dict) -> bool:

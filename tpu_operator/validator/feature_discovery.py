@@ -92,6 +92,29 @@ def _libtpu_version() -> str:
     return version if version and version != "bundled" else ""
 
 
+def workload_health_verdict() -> Optional[str]:
+    """The node's workload-barrier verdict for the operator's health sweep:
+    ``"passed"`` | ``"failed"`` | ``"failed:<chip,chip>"`` | ``"corrupt"``;
+    None when the barrier has not been written yet (fresh node — absence is
+    no-information, not failure). Chip attribution travels in the value
+    (an annotation, not a label: label values cannot hold commas)."""
+    from .status import StatusFiles, failed_local_chips
+
+    status_dir = os.environ.get("STATUS_DIR", consts.VALIDATION_STATUS_DIR)
+    status = StatusFiles(status_dir)
+    info = status.read("workload")
+    if info is None:
+        if os.path.exists(status.path("workload")):
+            return "corrupt"  # present but unparsable/non-dict: fail safe
+        return None
+    if info.get("passed") is not False:
+        return "passed"
+    failed = failed_local_chips(info, len(discover_devices()))
+    if failed:
+        return "failed:" + ",".join(str(c) for c in sorted(failed))
+    return "failed"
+
+
 def sync_node_labels(client, node_name: str, use_jax: bool = True) -> Dict[str, str]:
     """One discovery pass: compute labels, mirror GKE labels, patch if drifted."""
     node = client.get("v1", "Node", node_name)
@@ -106,6 +129,17 @@ def sync_node_labels(client, node_name: str, use_jax: bool = True) -> Dict[str, 
     if patch:
         client.patch("v1", "Node", node_name, {"metadata": {"labels": patch}})
         log.info("feature discovery: %s labels %s", node_name, patch)
+    # publish the barrier verdict the operator's health machine consumes —
+    # FD already mounts the status dir read-only and holds node patch
+    # rights, making it the natural node-agent for the health signal
+    verdict = workload_health_verdict()
+    current_ann = deep_get(node, "metadata", "annotations",
+                           consts.WORKLOAD_HEALTH_ANNOTATION)
+    if verdict is not None and verdict != current_ann:
+        client.patch("v1", "Node", node_name, {"metadata": {
+            "annotations": {consts.WORKLOAD_HEALTH_ANNOTATION: verdict}}})
+        log.info("feature discovery: %s workload health -> %s",
+                 node_name, verdict)
     return desired
 
 

@@ -59,10 +59,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--port", type=int, default=8000)
     p.add_argument("--sleep-interval", type=float, default=60.0)
     p.add_argument("--revalidate-interval", type=float,
-                   default=float(os.environ.get("TPU_REVALIDATE_INTERVAL", "0")),
+                   default=float(os.environ.get("TPU_REVALIDATE_INTERVAL", "300")),
                    help="sleep mode: re-run the local ICI sweep every N "
                         "seconds and refresh the workload barrier "
-                        "(0 = off). Busy chips (held by a workload) skip "
+                        "(0 = off; default on at 300 to match the CRD "
+                        "default). Busy chips (held by a workload) skip "
                         "the cycle without touching the barrier.")
     p.add_argument("--matrix-dim", type=int, default=512)
     p.add_argument("--metrics-config",

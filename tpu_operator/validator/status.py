@@ -65,9 +65,14 @@ class StatusFiles:
     def read(self, component: str) -> Optional[dict]:
         try:
             with open(self.path(component)) as f:
-                return json.load(f)
+                info = json.load(f)
         except (FileNotFoundError, json.JSONDecodeError):
             return None
+        # valid-but-non-dict JSON (a bare list/number written by a broken
+        # producer) is as corrupt as unparsable bytes: every consumer
+        # treats None-with-file-present as the fail-safe corrupt branch,
+        # and handing them a list would be an AttributeError instead
+        return info if isinstance(info, dict) else None
 
     def ready_components(self) -> List[str]:
         if not os.path.isdir(self.directory):
