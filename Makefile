@@ -34,6 +34,12 @@ chaos:  ## seeded fault-injection/soak suite: convergence under 30% API failure 
 bench:
 	$(PYTHON) bench.py
 
+SERVING_TRAFFIC_SEED ?= 20260805
+
+.PHONY: serving-bench
+serving-bench:  ## serving SLO probe (healthy + quarantined fail-closed) + seeded multi-tenant traffic scenario
+	SERVING_TRAFFIC_SEED=$(SERVING_TRAFFIC_SEED) $(PYTHON) bench.py --serving-only
+
 .PHONY: generate
 generate:  ## regenerate CRDs into all install channels (reference: make manifests)
 	$(PYTHON) hack/gen-crds.py

@@ -250,6 +250,76 @@ def bench_compile_cache(timeout: float = 240.0) -> dict:
     }
 
 
+def bench_serving_probe(timeout: float = 240.0) -> dict:
+    """Per-node serving SLO result (the validator ``-c serving`` core) on
+    whatever accelerator this host has, PLUS proof the health gate fails
+    closed: the same probe re-run under ``TPU_HEALTH_STATE=quarantined``
+    must produce ``passed: false`` with a ``skipped_reason`` instead of
+    latency numbers. Numbers from a non-TPU platform are labeled
+    simulated — the block exists to certify the probe path end to end."""
+    import tempfile
+
+    script = (
+        "import json, os\n"
+        "from tpu_operator.validator.serving import run_serving\n"
+        "from tpu_operator.validator.status import StatusFiles\n"
+        "from tpu_operator.validator.workload import enable_compilation_cache\n"
+        "enable_compilation_cache()\n"
+        "run_serving(StatusFiles(os.environ['STATUS_DIR']),\n"
+        "            batch_sizes=(1, 4, 8), steps_per_batch=16)\n")
+    out: dict = {}
+    with tempfile.TemporaryDirectory(prefix="tpu-serving-bench-") as status_dir:
+        env = dict(os.environ)
+        env["STATUS_DIR"] = status_dir
+        env.pop("TPU_HEALTH_STATE", None)
+        try:
+            out["probe"] = _run_json_subprocess(script, timeout, env=env)
+        except (RuntimeError, json.JSONDecodeError) as e:
+            out["probe"] = {"passed": False, "error": str(e)[:300]}
+        env["TPU_HEALTH_STATE"] = "quarantined"
+        try:
+            gate = _run_json_subprocess(script, timeout, env=env)
+        except (RuntimeError, json.JSONDecodeError) as e:
+            gate = {"error": str(e)[:300]}
+    out["health_gate"] = {
+        "health_state": "quarantined",
+        "passed": gate.get("passed"),
+        "skipped_reason": gate.get("skipped_reason"),
+        # the acceptance check: quarantined -> no numbers, fail closed
+        "failed_closed": (gate.get("passed") is False
+                          and bool(gate.get("skipped_reason"))),
+    }
+    out["simulated"] = out["probe"].get("platform") != "tpu"
+    return out
+
+
+#: seed for the published traffic scenario (and `make serving-bench`):
+#: pinned so the scenario block is bit-for-bit reproducible run-to-run
+SERVING_TRAFFIC_SEED = 20260805
+
+
+def bench_serving_traffic(seed: int = SERVING_TRAFFIC_SEED) -> dict:
+    """Seeded multi-tenant traffic scenario over a partitioned slice
+    layout with a health-driven re-tile injected mid-run: slice 1 goes
+    unhealthy at t=60s, its tenants drain and must re-place onto the
+    remaining capacity within the 10 s drain window. Pure simulation
+    (labeled as such) — the published numbers are SLO attainment, latency
+    percentiles, preemptions, placement churn, and the re-place record."""
+    from tpu_operator.serving.traffic import run_scenario
+
+    groups = [{"topology": "2x2", "chips": [0, 1, 2, 3]},
+              {"topology": "2x2", "chips": [4, 5, 6, 7]},
+              {"topology": "1x4", "chips": [8, 9, 10, 11]}]
+    # per_token_ms=25 puts the 12-chip layout around 75% utilization:
+    # busy enough that whale tenants are mid-decode at the re-tile (so the
+    # drain path actually exercises) and interactive traffic preempts
+    # batch, without collapsing into an unbounded queue
+    return run_scenario(
+        groups, seed=seed, duration_s=120.0, arrival_rate_per_s=3.0,
+        per_token_ms=25.0, queue_slo_s=1.0,
+        retile={"at": 60.0, "blocked": [1], "drain_window_s": 10.0})
+
+
 def _run_json_subprocess(script: str, timeout: float, env=None) -> dict:
     """Run a python snippet in a subprocess with a hard timeout (a wedged
     accelerator tunnel must produce a failed result, not a hang) and parse
@@ -297,7 +367,11 @@ def perf_summary(perf: dict) -> dict:
     return {
         "mxu_tflops": perf.get("mxu_tflops", 0.0),
         "hbm_gbps": perf.get("hbm_gbps", 0.0),
-        "ici_allreduce_gbps": perf.get("ici_allreduce_gbps", 0.0),
+        # null (not 0.0) when the sweep skipped ICI — a single-chip host
+        # has no fabric to measure and 0.0 would read as a dead one; the
+        # explicit marker travels with it so consumers need not guess
+        "ici_allreduce_gbps": perf.get("ici_allreduce_gbps"),
+        "ici_skipped": bool(perf.get("ici_skipped")),
         "device_kind": perf.get("device_kind", "unknown"),
         "chip": perf.get("chip", ""),
         "mxu_peak_fraction": perf.get("mxu_peak_fraction"),
@@ -441,6 +515,10 @@ def main() -> int:
     # host has (the validator hostPath cache model) — a perf claim with a
     # published number instead of a PARITY footnote
     line["compile_cache"] = bench_compile_cache()
+    # serving subsystem: per-node health-gated SLO probe result + the
+    # seeded multi-tenant traffic scenario (with mid-run re-tile)
+    line["serving_slo"] = bench_serving_probe()
+    line["serving_traffic_scenario"] = bench_serving_traffic()
     with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "BENCH_CPU_MESH.json"), "w") as f:
         json.dump(mesh, f, indent=1)
@@ -448,5 +526,22 @@ def main() -> int:
     return 0 if validation["passed"] and not cp_timed_out else 1
 
 
+def serving_main() -> int:
+    """`make serving-bench`: just the serving subsystem blocks (seed-pinned
+    traffic scenario + health-gated probe), one JSON line, exit 0 iff the
+    scenario ran clean (no unhandled errors, drained tenants re-placed)."""
+    scenario = bench_serving_traffic()
+    line = {
+        "metric": "serving_traffic_scenario",
+        "serving_traffic_scenario": scenario,
+        "serving_slo": bench_serving_probe(),
+    }
+    print(json.dumps(line))
+    ok = (scenario["unhandled_errors"] == 0
+          and scenario.get("retile", {}).get("all_replaced_within_window",
+                                             True))
+    return 0 if ok else 1
+
+
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(serving_main() if "--serving-only" in sys.argv[1:] else main())

@@ -121,7 +121,7 @@ bool JsonIntArray(const std::string& json, const char* key,
 }
 
 void Gauge(std::string* out, const char* name, const char* help, double value) {
-  char line[256];
+  char line[512];  // HELP text + two name repeats can exceed 256
   snprintf(line, sizeof(line), "# HELP %s %s\n# TYPE %s gauge\n%s %.17g\n",
            name, help, name, name, value);
   out->append(line);
@@ -320,21 +320,28 @@ std::string RenderMetrics(const std::string& status_dir) {
     }
   }
 
-  // measured throughput from the perf validation barrier; 0 until perf has
-  // run — always emitted so the series set matches the Python exporter
+  // measured throughput from the perf validation barrier; mxu/hbm read 0
+  // until perf has run. ICI is different: a single-chip host never
+  // measures it (the validator records null + "ici_skipped") and a 0.0
+  // gauge would read as a dead fabric — the series is emitted ONLY when
+  // the barrier holds a real number, matching metrics.py's lazy gauge.
   const std::string perf = ReadFile(status_dir + "/perf-ready");
-  const struct { const char* key; const char* metric; const char* help; } kPerf[] = {
+  const struct { const char* key; const char* metric; const char* help;
+                 bool optional; } kPerf[] = {
       {"mxu_tflops", "tpu_operator_node_mxu_tflops",
-       "Measured MXU throughput (bf16 TFLOP/s) from perf validation"},
+       "Measured MXU throughput (bf16 TFLOP/s) from perf validation", false},
       {"hbm_gbps", "tpu_operator_node_hbm_gbps",
-       "Measured HBM bandwidth (GB/s) from perf validation"},
+       "Measured HBM bandwidth (GB/s) from perf validation", false},
       {"ici_allreduce_gbps", "tpu_operator_node_ici_allreduce_gbps",
-       "Measured ICI allreduce bus bandwidth (GB/s) from perf validation"},
+       "Measured ICI allreduce bus bandwidth (GB/s) from perf validation; "
+       "series absent when the sweep skipped the measurement (single chip)",
+       true},
   };
   for (const auto& entry : kPerf) {
     double value = 0;
-    if (!perf.empty()) JsonNumber(perf, entry.key, &value);
-    Gauge(&out, entry.metric, entry.help, value);
+    const bool measured = !perf.empty() && JsonNumber(perf, entry.key, &value);
+    if (entry.optional && !measured) continue;
+    Gauge(&out, entry.metric, entry.help, measured ? value : 0);
   }
   Gauge(&out, "tpu_operator_node_metrics_last_refresh_ts_seconds",
         "Timestamp of the last metrics refresh",

@@ -58,6 +58,20 @@ def test_clean_report_is_valid():
     assert out["mxu_cross_check_ratio"] == 1.01
 
 
+def test_ici_skip_publishes_null_with_marker():
+    """A skipped ICI sweep (single chip) must publish null plus an explicit
+    marker, never 0.0 — every historical bench record carried
+    ici_allreduce_gbps: 0.0 with no way to tell 'no fabric' from 'dead
+    fabric'."""
+    out = perf_summary(_report(ici_allreduce_gbps=None, ici_skipped=True))
+    assert out["ici_allreduce_gbps"] is None
+    assert out["ici_skipped"] is True
+    # a measured value passes through untouched, marker stays false
+    out = perf_summary(_report(ici_allreduce_gbps=43.2))
+    assert out["ici_allreduce_gbps"] == 43.2
+    assert out["ici_skipped"] is False
+
+
 def test_perf_not_run_is_none_not_false():
     """No perf sweep (CPU platform) is 'not measured', distinct from
     'measured and untrustworthy'."""

@@ -158,7 +158,12 @@ def test_status_against_live_harness(capsys):
                            consts.TPU_PRESENT_LABEL: "true",
                            consts.UPGRADE_STATE_LABEL: "upgrade-done",
                            consts.TPU_SLICE_CONFIG_LABEL: "split-2x2",
-                           consts.TPU_SLICE_STATE_LABEL: "failed"}},
+                           consts.TPU_SLICE_STATE_LABEL: "failed",
+                           consts.SERVING_SLO_LABEL: "passed"},
+                           "annotations": {
+                               consts.SERVING_SLO_ANNOTATION:
+                                   "p99_ms=3.2,tokens_per_s=1234.5,"
+                                   "attainment=1.0"}},
                        "status": {"capacity": {consts.TPU_RESOURCE_NAME: "4"}}})
         client.create({"apiVersion": "apps/v1", "kind": "DaemonSet",
                        "metadata": {"name": "libtpu-driver",
@@ -175,6 +180,9 @@ def test_status_against_live_harness(capsys):
         assert "tpu-0" in out and "upgrade-done" in out
         # the slice-partition column shows the failed rollout at a glance
         assert "split-2x2=failed" in out
+        # the serving column shows the SLO verdict plus the measured p99
+        assert "SERVING" in out
+        assert "passed p99=3.2ms" in out
         assert "libtpu-driver" in out
         assert "HEALTHY" in out  # allocatable-vs-capacity health column
 

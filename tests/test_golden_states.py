@@ -32,6 +32,8 @@ SPEC = {
                   "version": "0.1.0"},
     "slicePartitioner": {"enabled": True, "repository": "gcr.io/tpu",
                          "image": "tpu-validator", "version": "0.1.0"},
+    "serving": {"enabled": True, "repository": "gcr.io/tpu",
+                "image": "tpu-validator", "version": "0.1.0"},
 }
 
 

@@ -35,6 +35,8 @@ OVERRIDES = {
                   "version": "0.1.0"},
     "slicePartitioner": {"enabled": True, "repository": "gcr.io/tpu",
                          "image": "tpu-validator", "version": "0.1.0"},
+    "serving": {"repository": "gcr.io/tpu", "image": "tpu-validator",
+                "version": "0.1.0"},
 }
 
 

@@ -58,6 +58,7 @@ def test_all_states_render(fake_client):
         "state-operator-validation", "state-device-plugin",
         "state-feature-discovery", "state-telemetry",
         "state-node-status-exporter", "state-slice-partitioner",
+        "state-operator-serving",
     }
     for name, objs in rendered.items():
         assert objs, f"{name} rendered nothing"

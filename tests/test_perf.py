@@ -189,3 +189,99 @@ def test_hbm_pallas_probe_absent_off_tpu(monkeypatch):
     if report.platform != "tpu":
         assert report.hbm_pallas_gbps == 0.0
         assert report.hbm_streaming_cross_check_ratio is None
+
+
+# -- ici "not measured" vs "measured 0" ---------------------------------------
+
+def test_ici_single_chip_reports_null_not_zero(monkeypatch):
+    """A single-chip host has no fabric to measure: the sweep must report
+    null + an explicit skipped marker, never 0.0 (which reads as a dead
+    fabric to every alert/consumer downstream)."""
+    from tpu_operator.validator import perf
+
+    monkeypatch.setattr(perf, "measure_ici_allreduce_gbps",
+                        lambda *a, **k: (None, True))
+    report = perf.run_perf(**TINY)
+    assert report.passed, report.failures
+    assert report.ici_allreduce_gbps is None
+    assert report.ici_skipped is True
+    d = report.to_dict()
+    assert d["ici_allreduce_gbps"] is None  # JSON null, not 0.0
+    assert d["ici_skipped"] is True
+
+
+def test_ici_floor_with_skip_fails_explicitly(monkeypatch):
+    """A configured ICI floor demands a measurement: 'skipped' cannot
+    satisfy it, and the failure says so instead of comparing against a
+    fabricated 0.0."""
+    from tpu_operator.validator import perf
+
+    monkeypatch.setattr(perf, "measure_ici_allreduce_gbps",
+                        lambda *a, **k: (None, True))
+    report = perf.run_perf(thresholds={"ici_allreduce_gbps": 1.0}, **TINY)
+    assert not report.passed
+    assert any("skipped" in f for f in report.failures)
+
+
+def test_ici_measured_on_mesh_is_not_skipped(monkeypatch):
+    """With a real multi-device measurement in the sweep, the report must
+    carry the number and a clear marker. MXU/HBM are stubbed (their real
+    sweeps are covered above); ICI runs for real on the 8-device mesh."""
+    from tpu_operator.validator import perf
+
+    monkeypatch.setattr(perf, "measure_mxu_tflops",
+                        lambda *a, **k: (150.0, True, 1.0))
+    monkeypatch.setattr(perf, "measure_hbm_gbps",
+                        lambda *a, **k: (500.0, True))
+    report = perf.run_perf(**TINY)
+    assert report.ici_skipped is False
+    assert report.ici_allreduce_gbps > 0
+
+
+def test_info_renders_ici_skip_distinct_from_zero(tmp_path):
+    from tpu_operator.validator import info as info_mod
+    from tpu_operator.validator.status import StatusFiles
+
+    status = StatusFiles(str(tmp_path))
+    status.write("perf", {"mxu_tflops": 200.0, "hbm_gbps": 700.0,
+                          "ici_allreduce_gbps": None, "ici_skipped": True})
+    data = info_mod.collect(str(tmp_path / "libtpu"), status=status)
+    assert data["perf"]["ici_allreduce_gbps"] is None
+    assert data["perf"]["ici_skipped"] is True
+    assert "skipped (single chip)" in info_mod.render(data)
+
+    # a legacy barrier with a literal 0.0 renders the number, preserving
+    # the distinction in the other direction
+    status.write("perf", {"mxu_tflops": 200.0, "hbm_gbps": 700.0,
+                          "ici_allreduce_gbps": 0.0})
+    text = info_mod.render(info_mod.collect(str(tmp_path / "libtpu"),
+                                            status=status))
+    assert "0 GB/s" in text
+
+
+def test_node_metrics_ici_series_absent_when_skipped(tmp_path):
+    """The exporter contract: no ici sample at all when the sweep skipped
+    the measurement (series absence IS the signal), sample present for any
+    numeric value including a legacy 0.0."""
+    from tpu_operator.validator.metrics import NodeMetrics
+    from tpu_operator.validator.status import StatusFiles
+
+    status = StatusFiles(str(tmp_path))
+    m = NodeMetrics(status=status)
+
+    status.write("perf", {"mxu_tflops": 200.0, "hbm_gbps": 700.0,
+                          "ici_allreduce_gbps": None, "ici_skipped": True})
+    m.refresh()
+    assert "tpu_operator_node_ici_allreduce_gbps" not in m.scrape().decode()
+
+    status.write("perf", {"mxu_tflops": 200.0, "hbm_gbps": 700.0,
+                          "ici_allreduce_gbps": 42.5})
+    m.refresh()
+    assert "tpu_operator_node_ici_allreduce_gbps 42.5" in m.scrape().decode()
+
+    # regression back to skipped (e.g. re-tile down to one chip): the
+    # series must disappear again, not freeze at its last value
+    status.write("perf", {"mxu_tflops": 200.0, "hbm_gbps": 700.0,
+                          "ici_allreduce_gbps": None, "ici_skipped": True})
+    m.refresh()
+    assert "tpu_operator_node_ici_allreduce_gbps" not in m.scrape().decode()

@@ -1,0 +1,395 @@
+"""Serving subsystem: SLO probe, validator glue, traffic scenario,
+operator rollup, and the kubelet-sim e2e loop.
+
+The probe runs for real on the conftest 8-device CPU mesh (same contract
+as the workload/perf sweeps: identical code path on TPU, only the numbers
+differ); the traffic scenario is a seeded discrete-event simulation, so
+every assertion here is bit-for-bit reproducible.
+"""
+
+import copy
+import json
+
+from tpu_operator import consts
+from tpu_operator.serving.probe import _percentile, run_probe, skipped_report
+from tpu_operator.serving.traffic import run_scenario, scenario_from_handoff
+from tpu_operator.validator import main as vmain
+from tpu_operator.validator.serving import (
+    parse_serving_detail,
+    run_serving,
+    serving_detail,
+    SERVING_POD_TEMPLATE,
+)
+from tpu_operator.validator.status import StatusFiles
+
+#: small-but-real probe settings: full code path, sub-second on CPU
+FAST = dict(batch_sizes=(1, 2), steps_per_batch=8)
+
+GROUPS = [{"topology": "2x2", "chips": [0, 1, 2, 3]},
+          {"topology": "2x2", "chips": [4, 5, 6, 7]},
+          {"topology": "2x2", "chips": [8, 9, 10, 11]}]
+
+#: heavy enough that tenants are mid-decode when the re-tile lands
+#: (bench.py uses the same shape; light settings drain the queue before
+#: t=60 and the retile block is vacuous)
+HEAVY = dict(duration_s=120.0, arrival_rate_per_s=3.0, per_token_ms=25.0,
+             queue_slo_s=1.0)
+
+
+# -- probe --------------------------------------------------------------------
+
+def test_probe_passes_on_cpu_mesh():
+    report = run_probe(**FAST)
+    assert report.passed, report.failures
+    assert report.platform == "cpu"
+    assert report.n_devices >= 1
+    assert len(report.batches) == 2
+    assert report.decode_p99_ms >= report.decode_p50_ms > 0
+    assert report.throughput_tokens_per_s > 0
+    assert report.slo_attainment == 1.0
+    # every rung carries its own tail, not just a mean
+    for rung in report.batches:
+        assert rung["p99_ms"] >= rung["p50_ms"]
+        assert rung["steps"] == 8
+
+
+def test_probe_gates_on_p99_ceiling():
+    report = run_probe(max_decode_p99_ms=1e-9, **FAST)
+    assert not report.passed
+    assert any("decode_p99_ms" in f for f in report.failures)
+    # an impossible ceiling also craters attainment — both gates fire
+    assert any("slo_attainment" in f for f in report.failures)
+
+
+def test_probe_gates_on_throughput_floor():
+    report = run_probe(min_throughput_tokens_per_s=1e12, **FAST)
+    assert not report.passed
+    assert any("throughput" in f for f in report.failures)
+
+
+def test_skipped_report_fails_closed():
+    report = skipped_report("health-state=quarantined",
+                            {"max_decode_p99_ms": 200.0})
+    assert report.passed is False
+    assert report.skipped_reason == "health-state=quarantined"
+    assert any(f.startswith("skipped:") for f in report.failures)
+    assert report.to_dict()["thresholds"]["max_decode_p99_ms"] == 200.0
+
+
+def test_percentile_nearest_rank():
+    assert _percentile([], 0.5) == 0.0
+    vals = [float(i) for i in range(1, 101)]
+    assert _percentile(vals, 0.0) == 1.0
+    assert _percentile(vals, 0.50) == 51.0  # nearest rank over 0..99 idx
+    assert _percentile(vals, 1.0) == 100.0
+
+
+# -- validator glue: health gate + barrier contract ---------------------------
+
+def test_run_serving_writes_barrier_on_pass(tmp_path, capsys, monkeypatch):
+    monkeypatch.delenv("TPU_HEALTH_STATE", raising=False)
+    status = StatusFiles(str(tmp_path))
+    assert run_serving(status, **FAST) == 0
+    report = status.read("serving")
+    assert report["passed"] is True
+    assert status.is_ready("serving")
+    # the probe's stdout JSON is the bench/debug channel
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["passed"] is True
+
+
+def test_run_serving_health_gate_fails_closed(tmp_path, monkeypatch, capsys):
+    """A quarantined node must not certify serving SLOs: probe skipped,
+    barrier written with passed=false (unlike perf, which only records
+    passes — a regressed tail must flip the label)."""
+    monkeypatch.setenv("TPU_HEALTH_STATE", "quarantined")
+    status = StatusFiles(str(tmp_path))
+    assert run_serving(status, **FAST) == 1
+    report = status.read("serving")
+    assert report["passed"] is False
+    assert report["skipped_reason"] == "health-state=quarantined"
+    assert not status.is_ready("serving")
+
+
+def test_run_serving_failure_still_writes_barrier(tmp_path, monkeypatch):
+    monkeypatch.delenv("TPU_HEALTH_STATE", raising=False)
+    status = StatusFiles(str(tmp_path))
+    assert run_serving(status, max_decode_p99_ms=1e-9, **FAST) == 1
+    report = status.read("serving")
+    assert report["passed"] is False
+    assert report["skipped_reason"] is None  # measured, not gated
+
+
+def test_serving_detail_round_trip():
+    passed = {"decode_p99_ms": 3.25, "throughput_tokens_per_s": 1234.5,
+              "slo_attainment": 1.0}
+    detail = serving_detail(passed)
+    assert parse_serving_detail(detail) == {
+        "p99_ms": 3.25, "tokens_per_s": 1234.5, "attainment": 1.0}
+    skipped = serving_detail({"skipped_reason": "health-state=failed"})
+    assert parse_serving_detail(skipped) == {"skipped": "health-state=failed"}
+    # garbage degrades to "no numbers", never a sweep crash
+    assert parse_serving_detail(None) == {}
+    assert parse_serving_detail("p99_ms=not-a-number,=,junk") == {}
+
+
+def test_serving_cli_dispatch(tmp_path, monkeypatch, capsys):
+    monkeypatch.delenv("TPU_HEALTH_STATE", raising=False)
+    rc = vmain.run(["-c", "serving", "--status-dir", str(tmp_path),
+                    "--serving-batch-sizes", "1,2", "--serving-steps", "6"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["passed"] is True
+    assert [r["batch"] for r in out["batches"]] == [1, 2]
+    assert (tmp_path / "serving-ready").exists()
+
+
+# -- traffic scenario ---------------------------------------------------------
+
+def test_traffic_scenario_deterministic():
+    a = run_scenario(GROUPS, seed=7, duration_s=30.0, arrival_rate_per_s=2.0,
+                     per_token_ms=5.0)
+    b = run_scenario(GROUPS, seed=7, duration_s=30.0, arrival_rate_per_s=2.0,
+                     per_token_ms=5.0)
+    assert a == b
+    c = run_scenario(GROUPS, seed=8, duration_s=30.0, arrival_rate_per_s=2.0,
+                     per_token_ms=5.0)
+    assert c != a
+
+
+def test_traffic_scenario_conserves_requests():
+    out = run_scenario(GROUPS, seed=3, **HEAVY)
+    assert out["arrivals"] == (out["completed"] + out["rejected"]
+                               + out["incomplete"])
+    assert out["unhandled_errors"] == 0
+    assert out["latency_p99_s"] >= out["latency_p50_s"]
+    assert "retile" not in out  # no re-tile injected, no vacuous block
+
+
+def test_traffic_retile_drains_and_replaces_within_window():
+    """The tentpole acceptance loop: a mid-run health re-tile blocks a
+    slice; every tenant running there drains and re-places onto the
+    remaining healthy capacity inside the drain window, with zero
+    unhandled event-loop errors."""
+    out = run_scenario(
+        GROUPS, seed=20260805,
+        retile={"at": 60.0, "blocked": [1], "drain_window_s": 10.0},
+        **HEAVY)
+    assert out["unhandled_errors"] == 0
+    assert out["slices"][1]["blocked"] is True
+    rt = out["retile"]
+    assert rt["drained_tenants"] > 0  # tenants really were mid-decode
+    assert rt["all_replaced_within_window"] is True
+    assert rt["replaced_within_window"] == rt["drained_tenants"]
+    assert 0 < rt["max_replace_s"] <= 10.0
+    # pressure was real: interactive tenants preempted batch traffic, and
+    # churn counts every beyond-first placement (preempts + drains)
+    assert out["preemptions"] > 0
+    assert out["placement_churn"] >= out["preemptions"]
+
+
+def test_traffic_interactive_preempts_batch():
+    """One slice, a whale batch tenant in the way: the interactive arrival
+    must preempt it rather than queue past its SLO."""
+    out = run_scenario([{"chips": [0, 1, 2, 3]}], seed=11,
+                       duration_s=60.0, arrival_rate_per_s=4.0,
+                       per_token_ms=40.0)
+    assert out["preemptions"] > 0
+    assert out["unhandled_errors"] == 0
+
+
+def test_traffic_soak_retile_under_sustained_load():
+    """Soak: 10 simulated minutes of sustained multi-tenant pressure with
+    a re-tile in the middle — drained tenants re-place within the window,
+    request accounting stays conserved, zero unhandled errors."""
+    out = run_scenario(
+        GROUPS, seed=20260805, duration_s=600.0, arrival_rate_per_s=3.0,
+        per_token_ms=25.0, queue_slo_s=1.0,
+        retile={"at": 300.0, "blocked": [2], "drain_window_s": 10.0})
+    assert out["unhandled_errors"] == 0
+    assert out["arrivals"] > 1000
+    assert out["arrivals"] == (out["completed"] + out["rejected"]
+                               + out["incomplete"])
+    rt = out["retile"]
+    assert rt["drained_tenants"] > 0
+    assert rt["all_replaced_within_window"] is True
+    assert out["slo_attainment"] is not None
+
+
+def test_scenario_from_handoff_falls_back_to_single_slice():
+    out = scenario_from_handoff(None, seed=1, duration_s=10.0)
+    assert out["slices"] == [{"capacity": 4, "blocked": False}]
+    out = scenario_from_handoff({"groups": GROUPS}, seed=1, duration_s=10.0)
+    assert len(out["slices"]) == 3
+
+
+# -- feature discovery publishes the verdict ----------------------------------
+
+def test_feature_discovery_serving_verdict(tmp_path, monkeypatch):
+    from tpu_operator.validator.feature_discovery import serving_slo_verdict
+
+    monkeypatch.setenv("STATUS_DIR", str(tmp_path))
+    # no barrier yet: no-information, label untouched
+    assert serving_slo_verdict() == (None, "")
+
+    status = StatusFiles(str(tmp_path))
+    status.write("serving", {"passed": True, "decode_p99_ms": 2.5,
+                             "throughput_tokens_per_s": 900.0,
+                             "slo_attainment": 1.0})
+    verdict, detail = serving_slo_verdict()
+    assert verdict == "passed"
+    assert parse_serving_detail(detail)["p99_ms"] == 2.5
+
+    status.write("serving", {"passed": False,
+                             "skipped_reason": "health-state=quarantined"})
+    verdict, detail = serving_slo_verdict()
+    assert verdict == "failed"
+    assert parse_serving_detail(detail) == {
+        "skipped": "health-state=quarantined"}
+
+
+# -- operator rollup: gauges, condition, alert feed ---------------------------
+
+def test_controller_sweep_rolls_up_serving_verdicts(fake_client):
+    """Node labels/annotations -> operator gauges + ServingValidated
+    condition + one transition-gated Warning Event (the
+    TPUServingSLOFailed alert reads the failing-nodes gauge)."""
+    from tpu_operator.api.clusterpolicy import new_cluster_policy
+    from tpu_operator.conditions import SERVING_VALIDATED, get_condition
+    from tpu_operator.controllers.clusterpolicy_controller import (
+        ClusterPolicyReconciler,
+    )
+    from tpu_operator.controllers.runtime import Request
+
+    fake_client.create(new_cluster_policy())
+    fake_client.create({
+        "apiVersion": "v1", "kind": "Node",
+        "metadata": {"name": "tpu-1", "labels": {
+            consts.GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice",
+            consts.GKE_TPU_TOPOLOGY_LABEL: "2x4",
+            consts.SERVING_SLO_LABEL: "failed"},
+            "annotations": {consts.SERVING_SLO_ANNOTATION:
+                            "skipped=health-state=quarantined"}},
+        "status": {}})
+    r = ClusterPolicyReconciler(fake_client)
+    r.reconcile(Request("cluster-policy"))
+
+    live = fake_client.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy")
+    cond = get_condition(live, SERVING_VALIDATED)
+    assert cond is not None and cond["status"] == "False"
+    assert "tpu-1" in cond["message"]
+    assert r.metrics.serving_slo_failing_nodes._value.get() == 1
+    assert r.debug_state()["serving_failing"] == ["tpu-1"]
+    reasons = [e.get("reason") for e in
+               fake_client.list("v1", "Event", "tpu-operator")]
+    assert reasons.count("ServingSLOFailed") == 1
+    # same persistent failure across sweeps: still exactly one Event
+    r.reconcile(Request("cluster-policy"))
+    reasons = [e.get("reason") for e in
+               fake_client.list("v1", "Event", "tpu-operator")]
+    assert reasons.count("ServingSLOFailed") == 1
+
+    # recovery: verdict flips to passed with measured numbers
+    fake_client.patch("v1", "Node", "tpu-1", {"metadata": {
+        "labels": {consts.SERVING_SLO_LABEL: "passed"},
+        "annotations": {consts.SERVING_SLO_ANNOTATION:
+                        "p99_ms=3.2,tokens_per_s=1200.0,attainment=0.997"}}})
+    r.reconcile(Request("cluster-policy"))
+    cond = get_condition(
+        fake_client.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy"),
+        SERVING_VALIDATED)
+    assert cond is not None and cond["status"] == "True"
+    assert r.metrics.serving_slo_failing_nodes._value.get() == 0
+    assert r.metrics.serving_decode_p99.labels(
+        node="tpu-1")._value.get() == 0.0032  # ms -> seconds
+    assert r.metrics.serving_throughput.labels(
+        node="tpu-1")._value.get() == 1200.0
+    assert r.metrics.serving_slo_attainment.labels(
+        node="tpu-1")._value.get() == 0.997
+
+
+def test_controller_sweep_no_verdicts_is_no_information(fake_client):
+    """Nodes without the label (serving disabled / not yet probed) neither
+    fail nor certify: no condition either way."""
+    from tpu_operator.api.clusterpolicy import new_cluster_policy
+    from tpu_operator.conditions import SERVING_VALIDATED, get_condition
+    from tpu_operator.controllers.clusterpolicy_controller import (
+        ClusterPolicyReconciler,
+    )
+    from tpu_operator.controllers.runtime import Request
+
+    fake_client.create(new_cluster_policy())
+    fake_client.create({
+        "apiVersion": "v1", "kind": "Node",
+        "metadata": {"name": "tpu-1", "labels": {
+            consts.GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice",
+            consts.GKE_TPU_TOPOLOGY_LABEL: "2x4"}}, "status": {}})
+    r = ClusterPolicyReconciler(fake_client)
+    r.reconcile(Request("cluster-policy"))
+    live = fake_client.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy")
+    assert get_condition(live, SERVING_VALIDATED) is None
+    assert r.metrics.serving_slo_failing_nodes._value.get() == 0
+
+
+# -- kubelet-sim e2e: the rendered pod through the real CLI -------------------
+
+def _mk_serving_pod(status_dir, extra_env=None):
+    pod = copy.deepcopy(SERVING_POD_TEMPLATE)
+    pod["metadata"]["namespace"] = "tpu-operator"
+    pod["spec"]["nodeName"] = "tpu-0"
+    container = pod["spec"]["containers"][0]
+    container["image"] = "gcr.io/tpu/tpu-validator:0.1.0"
+    container["env"] = [
+        {"name": "STATUS_DIR", "value": status_dir},
+        {"name": "SERVING_BATCH_SIZES", "value": "1,2"},
+        {"name": "SERVING_STEPS", "value": "6"},
+    ] + list(extra_env or [])
+    return pod
+
+
+def _exec_pod(pod, monkeypatch):
+    """The kubelet 'container runtime': run the pod's rendered
+    command/args/env through the real validator CLI."""
+    container = pod["spec"]["containers"][0]
+    assert container["command"] == ["tpu-validator"]
+    for entry in container.get("env", []):
+        monkeypatch.setenv(entry["name"], entry["value"])
+    return vmain.run(list(container.get("args", [])))
+
+
+def test_kubelet_exec_serving_pod_healthy_passes(fake_client, tmp_path,
+                                                 monkeypatch):
+    from tpu_operator.testing.kubelet import KubeletSimulator
+
+    monkeypatch.delenv("TPU_HEALTH_STATE", raising=False)
+    fake_client.create(_mk_serving_pod(str(tmp_path)))
+    kubelet = KubeletSimulator(
+        fake_client, validation_exec=lambda p: _exec_pod(p, monkeypatch))
+    kubelet.tick()
+    pod = fake_client.get("v1", "Pod", "tpu-serving-validation",
+                          "tpu-operator")
+    assert pod["status"]["phase"] == "Succeeded"
+    report = StatusFiles(str(tmp_path)).read("serving")
+    assert report["passed"] is True
+    assert report["decode_p99_ms"] > 0
+
+
+def test_kubelet_exec_serving_pod_quarantined_fails_closed(
+        fake_client, tmp_path, monkeypatch):
+    """The fail-closed half of the e2e loop: TPU_HEALTH_STATE stamped into
+    the pod env gates the probe; the pod goes Failed and the barrier
+    carries the skip reason (-> label failed -> zero serving capacity)."""
+    from tpu_operator.testing.kubelet import KubeletSimulator
+
+    fake_client.create(_mk_serving_pod(
+        str(tmp_path),
+        extra_env=[{"name": "TPU_HEALTH_STATE", "value": "quarantined"}]))
+    kubelet = KubeletSimulator(
+        fake_client, validation_exec=lambda p: _exec_pod(p, monkeypatch))
+    kubelet.tick()
+    pod = fake_client.get("v1", "Pod", "tpu-serving-validation",
+                          "tpu-operator")
+    assert pod["status"]["phase"] == "Failed"
+    report = StatusFiles(str(tmp_path)).read("serving")
+    assert report["passed"] is False
+    assert report["skipped_reason"] == "health-state=quarantined"

@@ -220,6 +220,59 @@ class SlicePartitionerSpec(ComponentSpec):
 
 
 @dataclasses.dataclass
+class ServingSpec(ComponentSpec):
+    """Serving SLO validator (ROADMAP open item #3): a jitted decode-step
+    probe run on every TPU node that measures p50/p99 per-step latency and
+    steady-state throughput over a batch ladder, reusing the persistent XLA
+    compile cache. Results land in the ``serving`` barrier file →
+    ``tpu.ai/serving-slo`` node label → the ``ServingValidated``
+    ClusterPolicy condition. Opt-in like the slice partitioner: serving
+    fleets turn it on, training-only fleets never pay for it."""
+
+    DEFAULT_IMAGE_ENV: str = dataclasses.field(default="VALIDATOR_IMAGE", repr=False)
+
+    max_decode_p99_ms: float = spec_field(
+        200.0, doc="SLO ceiling for p99 per-decode-step latency in "
+                   "milliseconds; a probe measuring above this fails.",
+        minimum=0.1, maximum=60000)
+    min_throughput_tokens_per_s: float = spec_field(
+        0.0, doc="SLO floor for steady-state decode throughput "
+                 "(tokens/s, summed over the batch); 0 disables the "
+                 "throughput gate.",
+        minimum=0, maximum=10_000_000)
+    min_slo_attainment: float = spec_field(
+        0.99, doc="Fraction of probed decode steps that must meet the "
+                  "p99 latency SLO for the node to pass.",
+        minimum=0, maximum=1)
+    batch_sizes: List[int] = spec_field(
+        lambda: [1, 4, 8],
+        doc="Batch ladder the decode probe walks; per-rung latency and "
+            "throughput are measured and the worst rung gates the SLO.")
+    steps_per_batch: int = spec_field(
+        32, doc="Decode steps timed per batch-ladder rung (after a "
+                "compile warm-up step).",
+        minimum=4, maximum=10000)
+    probe_interval_s: int = spec_field(
+        0, doc="Re-run the serving probe every N seconds in the sleep "
+               "container (0 = run once at node join).",
+        minimum=0, maximum=86400)
+
+    def is_enabled(self, default: bool = False) -> bool:
+        # opt-in, like the slice partitioner
+        return default if self.enabled is None else bool(self.enabled)
+
+    def validate(self, path: str = "spec.serving") -> List[str]:
+        errors = super().validate(path)
+        for b in self.batch_sizes:
+            if not isinstance(b, int) or isinstance(b, bool) or b < 1:
+                errors.append(f"{path}.batchSizes: {b!r} must be a "
+                              f"positive integer")
+        if not self.batch_sizes:
+            errors.append(f"{path}.batchSizes: must not be empty")
+        return errors
+
+
+@dataclasses.dataclass
 class HealthSpec(SpecBase):
     """Continuous chip-health remediation: the per-node degraded-state
     machine (``tpu_operator/health``) driven from the ClusterPolicy
@@ -351,6 +404,7 @@ class ClusterPolicySpec(SpecBase):
     node_status_exporter: NodeStatusExporterSpec = spec_field(NodeStatusExporterSpec)
     validator: ValidatorSpec = spec_field(ValidatorSpec)
     slice_partitioner: SlicePartitionerSpec = spec_field(SlicePartitionerSpec)
+    serving: ServingSpec = spec_field(ServingSpec)
     cdi: CDISpec = spec_field(CDISpec)
     host_paths: HostPathsSpec = spec_field(HostPathsSpec)
     psa: PSASpec = spec_field(PSASpec)
@@ -369,7 +423,8 @@ class ClusterPolicySpec(SpecBase):
         errors += self.driver.validate()
         errors += self.host_paths.validate()
         for name in ("device_plugin", "feature_discovery", "telemetry",
-                     "node_status_exporter", "validator", "slice_partitioner"):
+                     "node_status_exporter", "validator", "slice_partitioner",
+                     "serving"):
             sub: ComponentSpec = getattr(self, name)
             errors += sub.validate(f"spec.{name}")
         return errors

@@ -49,6 +49,7 @@ SAMPLE_CLUSTER_POLICY = {
         "validator": {"enabled": True, "repository": "gcr.io/my-project",
                       "image": "tpu-validator", "version": "0.1.0"},
         "slicePartitioner": {"enabled": False},
+        "serving": {"enabled": False},
         "cdi": {"enabled": False},
     },
 }
@@ -372,6 +373,26 @@ def status(base_url=None, namespace="tpu-operator", out=None,
         return 2
 
 
+def _serving_cell(labels: dict, annotations: dict) -> str:
+    """SERVING column: verdict from the tpu.ai/serving-slo label plus the
+    measured decode p99 (or the skip reason) from the detail annotation —
+    the one number the TPUServingSLOFailed alert runbook sends a support
+    case here to read."""
+    from .. import consts
+    from ..validator.serving import parse_serving_detail
+
+    verdict = labels.get(consts.SERVING_SLO_LABEL)
+    if not verdict:
+        return "-"
+    detail = parse_serving_detail(
+        annotations.get(consts.SERVING_SLO_ANNOTATION, ""))
+    if "skipped" in detail:
+        return f"{verdict} ({detail['skipped']})"
+    if "p99_ms" in detail:
+        return f"{verdict} p99={detail['p99_ms']:g}ms"
+    return verdict
+
+
 def _status(client, namespace, out) -> int:
     from .. import consts
     from ..utils import deep_get
@@ -398,7 +419,7 @@ def _status(client, namespace, out) -> int:
 
     # TPU nodes only — presence is the row filter, so no column for it
     print("\nNODE            CAPACITY  HEALTHY  HEALTH-STATE     "
-          "UPGRADE-STATE    SLICE-PARTITION", file=out)
+          "UPGRADE-STATE    SLICE-PARTITION   SERVING", file=out)
     for node in client.list("v1", "Node"):
         labels = node.get("metadata", {}).get("labels", {}) or {}
         if labels.get(consts.TPU_PRESENT_LABEL) != "true":
@@ -431,8 +452,10 @@ def _status(client, namespace, out) -> int:
             partition = f"{slice_cfg or '<none>'}={slice_state or '?'}"
         else:
             partition = "-"
+        serving = _serving_cell(labels, node.get("metadata", {})
+                                .get("annotations", {}) or {})
         print(f"{name:<15} {capacity:<9} {healthy:<8} {health_state:<16} "
-              f"{upgrade:<16} {partition}", file=out)
+              f"{upgrade:<16} {partition:<17} {serving}", file=out)
 
     print("\nDAEMONSET                 DESIRED  AVAILABLE  UPDATED", file=out)
     for ds in client.list("apps/v1", "DaemonSet", namespace):

@@ -38,6 +38,15 @@ SLICE_PARTITION_FAILED = "SlicePartitionFailed"
 NODE_HEALTH_DEGRADED = "NodeHealthDegraded"
 REASON_NODE_HEALTH_DEGRADED = "NodeHealthDegraded"
 
+#: auxiliary condition type: rollup of per-node serving-SLO verdicts
+#: (tpu.ai/serving-slo). True = every node that ran the serving probe met
+#: its SLO; False = at least one node is failing; absent until any node
+#: has published a verdict (absence is no-information, like the workload
+#: health annotation)
+SERVING_VALIDATED = "ServingValidated"
+REASON_SERVING_SLO_MET = "ServingSLOMet"
+REASON_SERVING_SLO_FAILED = "ServingSLOFailed"
+
 
 def make_condition(type_: str, status: str, reason: str, message: str = "") -> dict:
     return {

@@ -96,6 +96,16 @@ HEALTH_FAILED_TEMPLATE_ANNOTATION = "tpu.ai/health-failed-template"
 #: "passed" | "failed" | "failed:<chip,chip>" | "corrupt"
 WORKLOAD_HEALTH_ANNOTATION = "tpu.ai/workload-health"
 
+# -- serving SLO validation ----------------------------------------------------
+#: the node's serving-barrier verdict, published by feature discovery from
+#: the serving barrier file: "passed" | "failed" | "corrupt" (label values
+#: must stay label-safe; detail travels in the annotation below)
+SERVING_SLO_LABEL = "tpu.ai/serving-slo"
+#: measured serving numbers for the verdict label, e.g.
+#: "p99_ms=3.1,tokens_per_s=5120,attainment=1.0" — an annotation because
+#: commas/decimals are not label-safe
+SERVING_SLO_ANNOTATION = "tpu.ai/serving-slo-detail"
+
 # -- labels read from the platform (GKE / device discovery) -------------------
 GKE_TPU_ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"
 GKE_TPU_TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"
@@ -122,6 +132,7 @@ OPERANDS = (
     "node-status-exporter",
     "operator-validator",
     "slice-partitioner",
+    "serving",
 )
 
 

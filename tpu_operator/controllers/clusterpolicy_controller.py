@@ -25,7 +25,10 @@ from ..conditions import (
     REASON_OPERAND_NOT_READY,
     REASON_READY,
     REASON_RECONCILE_FAILED,
+    REASON_SERVING_SLO_FAILED,
+    REASON_SERVING_SLO_MET,
     REASON_SLICE_PARTITION_FAILED,
+    SERVING_VALIDATED,
     SLICE_PARTITION_FAILED,
     get_condition,
     is_new_error,
@@ -74,12 +77,15 @@ class ClusterPolicyReconciler(Reconciler):
         self._last_slice_state: dict = {}
         #: last sweep's health rollup, surfaced on /debug/queue
         self._last_health_counts: dict = {}
+        #: nodes failing the serving SLO on the last sweep (debug surface)
+        self._last_serving_failing: list = []
 
     def debug_state(self) -> dict:
         return {
             "node_health": dict(self._last_health_counts),
             "slice_states": {n: s for n, s in
                              sorted(self._last_slice_state.items()) if s},
+            "serving_failing": list(self._last_serving_failing),
         }
 
     # -- singleton guard (reference clusterpolicy_controller.go:121-126) ------
@@ -171,6 +177,64 @@ class ClusterPolicyReconciler(Reconciler):
             set_condition(conditions, make_condition(
                 SLICE_PARTITION_FAILED, "False", REASON_READY, ""))
 
+    def _sweep_serving(self, policy: ClusterPolicy,
+                       nodes: List[dict]) -> None:
+        """Roll the per-node serving-SLO verdicts up to the CR. Feature
+        discovery publishes each node's verdict as the ``tpu.ai/serving-slo``
+        label with measured numbers in the detail annotation; this sweep
+        republishes them as operator gauges and maintains a
+        ``ServingValidated`` condition + transition-gated Warning Event.
+        Nodes with no verdict (serving validation disabled, or not yet
+        probed) are no-information: they neither fail nor certify."""
+        from ..validator.serving import parse_serving_detail
+
+        failing: List[str] = []
+        reporting = 0
+        self.metrics.serving_decode_p99.clear()
+        self.metrics.serving_throughput.clear()
+        self.metrics.serving_slo_attainment.clear()
+        for node in nodes:
+            name = node["metadata"]["name"]
+            verdict = deep_get(node, "metadata", "labels",
+                               consts.SERVING_SLO_LABEL)
+            if verdict is None:
+                continue
+            reporting += 1
+            if verdict != "passed":
+                failing.append(name)
+            detail = parse_serving_detail(deep_get(
+                node, "metadata", "annotations",
+                consts.SERVING_SLO_ANNOTATION))
+            if "p99_ms" in detail:
+                self.metrics.serving_decode_p99.labels(node=name).set(
+                    detail["p99_ms"] / 1000.0)
+            if "tokens_per_s" in detail:
+                self.metrics.serving_throughput.labels(node=name).set(
+                    detail["tokens_per_s"])
+            if "attainment" in detail:
+                self.metrics.serving_slo_attainment.labels(node=name).set(
+                    detail["attainment"])
+        self.metrics.serving_slo_failing_nodes.set(len(failing))
+        self._last_serving_failing = sorted(failing)
+        conditions = policy.obj.setdefault("status", {}).setdefault(
+            "conditions", [])
+        current = get_condition(policy.obj, SERVING_VALIDATED)
+        if failing:
+            message = ("serving SLO failing on node(s): "
+                       + ", ".join(sorted(failing)))
+            if (current is None or current.get("status") != "False"
+                    or current.get("message") != message):
+                events.record(self.client, self.namespace, policy.obj,
+                              events.WARNING, REASON_SERVING_SLO_FAILED,
+                              message)
+            set_condition(conditions, make_condition(
+                SERVING_VALIDATED, "False", REASON_SERVING_SLO_FAILED,
+                message))
+        elif reporting:
+            set_condition(conditions, make_condition(
+                SERVING_VALIDATED, "True", REASON_SERVING_SLO_MET,
+                f"serving SLO met on {reporting} reporting node(s)"))
+
     def _sweep_health(self, policy: ClusterPolicy,
                       nodes: List[dict]) -> None:
         """Drive the per-node chip-health machine and publish its rollup:
@@ -256,6 +320,7 @@ class ClusterPolicyReconciler(Reconciler):
         # landing on the CR would re-emit the event every backoff retry
         self._surface_slice_failures(policy, label_result.nodes)
         self._sweep_health(policy, label_result.nodes)
+        self._sweep_serving(policy, label_result.nodes)
         previous_state = deep_get(policy.obj, "status", "state")
 
         if results.ready:
@@ -324,10 +389,10 @@ def setup_clusterpolicy_controller(client: Client,
         return _all_policy_requests(client)
 
     def map_validation_pod(event: WatchEvent) -> List[Request]:
-        # multihost rendezvous pods completing must re-trigger promptly
-        # rather than waiting out the 5s NotReady requeue
+        # multihost rendezvous / serving probe pods completing must
+        # re-trigger promptly rather than waiting out the 5s NotReady requeue
         app = deep_get(event.object, "metadata", "labels", "app")
-        if app == "tpu-multihost-validation":
+        if app in ("tpu-multihost-validation", "tpu-serving-validation"):
             return _all_policy_requests(client)
         return []
 

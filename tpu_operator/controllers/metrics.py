@@ -73,6 +73,30 @@ class OperatorMetrics:
             "tpu_operator_partition_retile_total",
             "Node transitions into a health-aware re-tiled slice layout "
             "(tpu.ai/slice.config.state=retiled)", registry=self.registry)
+        # serving-SLO rollup: per-node verdicts land on nodes as the
+        # tpu.ai/serving-slo label (+ measured numbers in the detail
+        # annotation); the reconcile sweep republishes them here so one
+        # scrape target answers "is the fleet meeting its serving SLO"
+        self.serving_slo_failing_nodes = Gauge(
+            "tpu_operator_serving_slo_failing_nodes",
+            "Nodes whose serving SLO probe failed or failed closed "
+            "(tpu.ai/serving-slo label is failed or corrupt)",
+            registry=self.registry)
+        self.serving_decode_p99 = Gauge(
+            "tpu_operator_serving_decode_p99_seconds",
+            "Worst-rung decode-step p99 latency measured by the node's "
+            "serving SLO probe (from the tpu.ai/serving-slo-detail "
+            "annotation; absent until the node reports)",
+            ["node"], registry=self.registry)
+        self.serving_throughput = Gauge(
+            "tpu_operator_serving_throughput_tokens_per_s",
+            "Peak steady-state decode throughput measured by the node's "
+            "serving SLO probe", ["node"], registry=self.registry)
+        self.serving_slo_attainment = Gauge(
+            "tpu_operator_serving_slo_attainment_ratio",
+            "Fraction of probed decode steps on the node that met the "
+            "per-step latency SLO (min over batch rungs)",
+            ["node"], registry=self.registry)
 
         # controller-runtime/client-go equivalents (workqueue + rest client)
         self.workqueue_depth = Gauge(

@@ -1,0 +1,21 @@
+"""Serving subsystem: SLO-probed serving validation + traffic scenarios.
+
+ROADMAP open item #3 ("serving must become a measured number, not a
+slogan") in two halves:
+
+- :mod:`probe` — the on-node serving validator: a jitted decode-step loop
+  measuring p50/p99 per-step latency and steady-state throughput over a
+  configurable batch ladder, reusing the persistent XLA compile cache the
+  bench already quantifies (0.61 s cold -> 0.13 s warm).
+- :mod:`traffic` — a seeded multi-tenant traffic generator that bin-packs
+  tenants onto the slice partitioner's healthy layout, queues and preempts
+  under capacity pressure, and reacts to health-driven re-tiles.
+
+The probe publishes through the standard validation pipeline: barrier file
+-> feature-discovery label (``tpu.ai/serving-slo``) -> ``ServingValidated``
+ClusterPolicy condition; the traffic scenario publishes
+``serving_traffic_scenario`` in bench.py next to join time.
+"""
+
+from .probe import ServingReport, run_probe  # noqa: F401
+from .traffic import run_scenario  # noqa: F401

@@ -1,0 +1,193 @@
+"""The serving SLO probe: a jitted autoregressive decode-step loop.
+
+The workload check (validator/workload.py) proves the stack can *train*
+(one allreduce); this proves it can *serve*: repeated small-batch
+matmul-bound decode steps whose per-step latency and steady-state
+throughput are what a production inference fleet actually sells. The probe
+walks a batch ladder, times each decode step individually (p50/p99, not
+just a mean — tail latency is the serving SLO), and gates on configurable
+thresholds from ``spec.serving``.
+
+Compile time is measured AOT (``.lower().compile()``) exactly like the ICI
+sweep, and the persistent XLA compile cache is enabled first, so a node
+whose cache is warm reports the warm number — the 0.61 s -> 0.13 s win the
+bench quantifies is a serving-latency win here.
+
+Runs identically under ``JAX_PLATFORMS=cpu`` (tests, bench) and on real
+TPU chips; the math is a deterministic integer-valued bf16 matmul chain so
+a wrong result is a hard fail, never a tolerance call.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import List, Optional, Sequence
+
+
+@dataclasses.dataclass
+class BatchRungResult:
+    """Measured numbers for one rung of the batch ladder."""
+
+    batch: int
+    steps: int
+    p50_ms: float
+    p99_ms: float
+    mean_ms: float
+    tokens_per_s: float
+    #: fraction of this rung's steps whose latency met the p99 SLO ceiling
+    slo_attainment: float
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class ServingReport:
+    passed: bool
+    platform: str
+    n_devices: int
+    compile_s: float
+    elapsed_s: float
+    #: worst rung's tail latency — the number the SLO gate applies to
+    decode_p99_ms: float
+    decode_p50_ms: float
+    #: best rung's steady-state throughput (peak of the ladder)
+    throughput_tokens_per_s: float
+    #: min over rungs: fraction of steps meeting the p99 SLO ceiling
+    slo_attainment: float
+    batches: List[dict]
+    thresholds: dict
+    failures: List[str] = dataclasses.field(default_factory=list)
+    #: set when the probe never ran (quarantined node fails closed);
+    #: carries the reason so consumers can distinguish "too slow" from
+    #: "health-gated"
+    skipped_reason: Optional[str] = None
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def skipped_report(reason: str, thresholds: Optional[dict] = None) -> ServingReport:
+    """A fail-closed report for a probe that was gated off (quarantined
+    node): ``passed=False`` so the barrier blocks serving traffic, with the
+    reason preserved for the label/annotation pipeline."""
+    return ServingReport(
+        passed=False, platform="", n_devices=0, compile_s=0.0, elapsed_s=0.0,
+        decode_p99_ms=0.0, decode_p50_ms=0.0, throughput_tokens_per_s=0.0,
+        slo_attainment=0.0, batches=[], thresholds=dict(thresholds or {}),
+        failures=[f"skipped: {reason}"], skipped_reason=reason)
+
+
+def _percentile(sorted_vals: Sequence[float], q: float) -> float:
+    """Nearest-rank percentile over an ascending-sorted sequence."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def run_probe(batch_sizes: Sequence[int] = (1, 4, 8),
+              steps_per_batch: int = 32,
+              max_decode_p99_ms: float = 200.0,
+              min_throughput_tokens_per_s: float = 0.0,
+              min_slo_attainment: float = 0.99,
+              model_dim: int = 256) -> ServingReport:
+    """Walk the batch ladder, measure per-step decode latency, gate on SLOs.
+
+    The decode step is the matmul-bound core of autoregressive inference:
+    one token embedding per sequence multiplied through a square weight, a
+    KV-cache-shaped accumulator update, and an argmax — all inside one
+    jitted function per batch size (shape change = recompile, exactly as a
+    real serving stack pays it, which is why the compile cache matters).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..validator.workload import enable_compilation_cache
+
+    enable_compilation_cache()
+    start = time.monotonic()
+    devices = jax.devices()
+    platform = devices[0].platform
+
+    # deterministic integer-valued weights: bf16 matmul of 0/1 matrices is
+    # exact, so the correctness check below is equality, not tolerance
+    w = jnp.eye(model_dim, dtype=jnp.bfloat16)
+
+    @jax.jit
+    def decode_step(tokens, cache):
+        # tokens: (batch, dim) one-hot-ish embeddings; cache: (batch, dim)
+        h = (tokens @ w).astype(jnp.float32)
+        h = h + 0.0 * cache  # cache participates so XLA can't elide it
+        cache = cache + h
+        logits = (h.astype(jnp.bfloat16) @ w).astype(jnp.float32)
+        return jnp.argmax(logits, axis=-1), cache
+
+    compile_s_total = 0.0
+    rungs: List[BatchRungResult] = []
+    failures: List[str] = []
+    for batch in batch_sizes:
+        tokens = jnp.zeros((batch, model_dim), jnp.bfloat16).at[:, 0].set(1)
+        cache = jnp.zeros((batch, model_dim), jnp.float32)
+        compile_start = time.monotonic()
+        compiled = decode_step.lower(tokens, cache).compile()
+        compile_s_total += time.monotonic() - compile_start
+        # warm-up step: first execution can still pay dispatch setup
+        out, cache = compiled(tokens, cache)
+        out.block_until_ready()
+        if int(out[0]) != 0:  # identity weights: argmax must be column 0
+            failures.append(f"batch={batch}: decode produced wrong argmax "
+                            f"{int(out[0])} (expected 0)")
+        lat_s: List[float] = []
+        for _ in range(steps_per_batch):
+            t0 = time.monotonic()
+            out, cache = compiled(tokens, cache)
+            out.block_until_ready()
+            lat_s.append(time.monotonic() - t0)
+        lat_s.sort()
+        p50 = _percentile(lat_s, 0.50) * 1000
+        p99 = _percentile(lat_s, 0.99) * 1000
+        total = sum(lat_s)
+        met = sum(1 for s in lat_s if s * 1000 <= max_decode_p99_ms)
+        rungs.append(BatchRungResult(
+            batch=batch, steps=steps_per_batch,
+            p50_ms=round(p50, 4), p99_ms=round(p99, 4),
+            mean_ms=round(total / len(lat_s) * 1000, 4),
+            tokens_per_s=round(batch * len(lat_s) / total, 1) if total else 0.0,
+            slo_attainment=round(met / len(lat_s), 4)))
+
+    elapsed = time.monotonic() - start
+    worst_p99 = max((r.p99_ms for r in rungs), default=0.0)
+    worst_p50 = max((r.p50_ms for r in rungs), default=0.0)
+    peak_tps = max((r.tokens_per_s for r in rungs), default=0.0)
+    attainment = min((r.slo_attainment for r in rungs), default=0.0)
+
+    if worst_p99 > max_decode_p99_ms:
+        failures.append(f"decode_p99_ms={worst_p99} above SLO ceiling "
+                        f"{max_decode_p99_ms}")
+    if min_throughput_tokens_per_s > 0 and peak_tps < min_throughput_tokens_per_s:
+        failures.append(f"throughput_tokens_per_s={peak_tps} below required "
+                        f"floor {min_throughput_tokens_per_s}")
+    if attainment < min_slo_attainment:
+        failures.append(f"slo_attainment={attainment} below required "
+                        f"{min_slo_attainment}")
+
+    return ServingReport(
+        passed=not failures,
+        platform=platform,
+        n_devices=len(devices),
+        compile_s=round(compile_s_total, 4),
+        elapsed_s=round(elapsed, 4),
+        decode_p99_ms=round(worst_p99, 4),
+        decode_p50_ms=round(worst_p50, 4),
+        throughput_tokens_per_s=peak_tps,
+        slo_attainment=attainment,
+        batches=[r.to_dict() for r in rungs],
+        thresholds={
+            "max_decode_p99_ms": max_decode_p99_ms,
+            "min_throughput_tokens_per_s": min_throughput_tokens_per_s,
+            "min_slo_attainment": min_slo_attainment,
+        },
+        failures=failures,
+    )

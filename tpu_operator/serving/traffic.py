@@ -1,0 +1,300 @@
+"""Seeded multi-tenant serving traffic scenario over partitioned slices.
+
+Makes "heavy traffic from millions of users" a measured number: a
+discrete-event simulation that replays a Poisson-arrival, heavy-tailed
+multi-tenant request mix against the slice partitioner's healthy layout
+(the ``groups`` list from the partition handoff file). Tenants are
+bin-packed first-fit onto slices with free chip capacity, queue under
+pressure, and interactive (priority-0) tenants preempt batch traffic when
+the queue would otherwise violate their SLO. A mid-run health re-tile can
+block slices: tenants running there drain and re-place onto the remaining
+healthy capacity, and the scenario measures how fast.
+
+Everything is driven by one ``random.Random(seed)`` so bench runs are
+reproducible bit-for-bit; no wall clock is consulted (simulated time only).
+
+Outputs (one dict, published as ``serving_traffic_scenario`` in bench.py):
+SLO attainment %, p50/p99 queue+decode latency, preemptions, placement
+churn, and — when a re-tile was injected — whether every drained tenant
+re-placed within the drain window.
+"""
+
+from __future__ import annotations
+
+import heapq
+import random
+from typing import Dict, List, Optional, Sequence
+
+#: priority vocabulary: 0 = interactive (may preempt), 2 = batch
+PRIORITIES = (0, 1, 2)
+_PRIORITY_WEIGHTS = (0.15, 0.35, 0.50)
+
+
+class _Request:
+    __slots__ = ("rid", "arrival", "priority", "chips", "tokens",
+                 "remaining", "slice_id", "service_start", "first_start",
+                 "finish", "placements", "preempted", "drained_at",
+                 "replaced_at", "epoch")
+
+    def __init__(self, rid: int, arrival: float, priority: int,
+                 chips: int, tokens: int):
+        self.rid = rid
+        self.arrival = arrival
+        self.priority = priority
+        self.chips = chips
+        self.tokens = tokens
+        self.remaining = float(tokens)
+        self.slice_id: Optional[int] = None
+        self.service_start = 0.0
+        self.first_start: Optional[float] = None
+        self.finish: Optional[float] = None
+        self.placements = 0
+        self.preempted = 0
+        self.drained_at: Optional[float] = None
+        self.replaced_at: Optional[float] = None
+        self.epoch = 0  # bumped on preempt/drain so stale completions drop
+
+
+class _Slice:
+    __slots__ = ("sid", "capacity", "free", "blocked")
+
+    def __init__(self, sid: int, capacity: int):
+        self.sid = sid
+        self.capacity = capacity
+        self.free = capacity
+        self.blocked = False
+
+
+def _gen_requests(rng: random.Random, duration_s: float,
+                  arrival_rate_per_s: float, max_chips: int) -> List[_Request]:
+    """Poisson arrivals; Pareto (heavy-tailed) chip footprints and token
+    counts — a few whale tenants among many small interactive ones."""
+    out: List[_Request] = []
+    t = 0.0
+    rid = 0
+    while True:
+        t += rng.expovariate(arrival_rate_per_s)
+        if t >= duration_s:
+            return out
+        chips = min(max_chips, max(1, int(rng.paretovariate(1.6))))
+        tokens = max(8, min(4096, int(rng.paretovariate(1.2) * 32)))
+        priority = rng.choices(PRIORITIES, weights=_PRIORITY_WEIGHTS)[0]
+        out.append(_Request(rid, t, priority, chips, tokens))
+        rid += 1
+
+
+def _percentile(sorted_vals: Sequence[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def run_scenario(groups: Sequence[dict],
+                 seed: int = 0,
+                 duration_s: float = 60.0,
+                 arrival_rate_per_s: float = 2.0,
+                 per_token_ms: float = 2.0,
+                 queue_slo_s: float = 1.0,
+                 retile: Optional[dict] = None) -> Dict:
+    """Run the multi-tenant scenario against a slice layout.
+
+    ``groups`` is the partitioner handoff's ``groups`` list (each entry
+    needs a ``chips`` list; ``topology`` is carried through for labels).
+    ``retile``, when given, injects a health-driven re-tile:
+    ``{"at": <sim seconds>, "blocked": [group index, ...],
+    "drain_window_s": <float>}`` — at that moment the named slices go
+    unhealthy, tenants running there drain and re-place.
+
+    Returns a plain dict (bench-JSON-ready); ``unhandled_errors`` counts
+    event-loop exceptions and must be 0 in any healthy run.
+    """
+    rng = random.Random(seed)
+    slices = [_Slice(i, len(g.get("chips", [])) or 1)
+              for i, g in enumerate(groups)]
+    if not slices:
+        slices = [_Slice(0, 1)]
+    max_chips = max(s.capacity for s in slices)
+    requests = _gen_requests(rng, duration_s, arrival_rate_per_s, max_chips)
+
+    # tokens/s a request decodes at: linear in assigned chips (each chip
+    # serves its shard of the batch), 1 chip = 1000/per_token_ms tokens/s
+    def rate(req: _Request) -> float:
+        return req.chips * 1000.0 / per_token_ms
+
+    ARRIVE, COMPLETE, RETILE = 0, 1, 2
+    events: List[tuple] = []
+    seq = 0
+    for req in requests:
+        events.append((req.arrival, seq, ARRIVE, req, 0))
+        seq += 1
+    if retile:
+        events.append((float(retile["at"]), seq, RETILE, None, 0))
+        seq += 1
+    heapq.heapify(events)
+
+    waiting: List[_Request] = []
+    running: Dict[int, _Request] = {}
+    completed: List[_Request] = []
+    rejected = 0
+    preemptions = 0
+    unhandled_errors = 0
+    drained: List[_Request] = []
+
+    def push_completion(req: _Request, now: float) -> None:
+        nonlocal seq
+        finish = now + req.remaining / rate(req)
+        heapq.heappush(events, (finish, seq, COMPLETE, req, req.epoch))
+        seq += 1
+
+    def unplace(req: _Request, now: float) -> None:
+        """Take a running request off its slice, crediting decoded tokens."""
+        req.remaining = max(0.0, req.remaining - rate(req) * (now - req.service_start))
+        slices[req.slice_id].free += req.chips
+        req.slice_id = None
+        req.epoch += 1
+        del running[req.rid]
+
+    def place(req: _Request, sl: _Slice, now: float) -> None:
+        sl.free -= req.chips
+        req.slice_id = sl.sid
+        req.service_start = now
+        if req.first_start is None:
+            req.first_start = now
+        if req.drained_at is not None and req.replaced_at is None:
+            req.replaced_at = now
+        req.placements += 1
+        running[req.rid] = req
+        push_completion(req, now)
+
+    def try_place_all(now: float) -> None:
+        # interactive first, then arrival order; stable across runs
+        waiting.sort(key=lambda r: (r.priority, r.arrival, r.rid))
+        still: List[_Request] = []
+        for req in waiting:
+            sl = next((s for s in slices
+                       if not s.blocked and s.free >= req.chips), None)
+            if sl is None and req.priority == 0:
+                # preempt batch traffic: find a slice where evicting
+                # strictly-lower-priority tenants frees enough chips
+                for cand in slices:
+                    if cand.blocked or cand.capacity < req.chips:
+                        continue
+                    victims = sorted(
+                        (r for r in running.values()
+                         if r.slice_id == cand.sid and r.priority > 0),
+                        key=lambda r: (-r.priority, -r.service_start))
+                    freed = cand.free
+                    chosen = []
+                    for v in victims:
+                        if freed >= req.chips:
+                            break
+                        chosen.append(v)
+                        freed += v.chips
+                    if freed >= req.chips:
+                        for v in chosen:
+                            unplace(v, now)
+                            v.preempted += 1
+                            still.append(v)
+                        sl = cand
+                        break
+            if sl is not None:
+                place(req, sl, now)
+            else:
+                still.append(req)
+        waiting[:] = still
+
+    while events:
+        now, _, kind, req, epoch = heapq.heappop(events)
+        try:
+            if kind == ARRIVE:
+                if req.chips > max_chips:
+                    rejected += 1
+                    continue
+                waiting.append(req)
+                try_place_all(now)
+            elif kind == COMPLETE:
+                if req.epoch != epoch or req.rid not in running:
+                    continue  # stale: preempted/drained since scheduled
+                slices[req.slice_id].free += req.chips
+                del running[req.rid]
+                req.slice_id = None
+                req.remaining = 0.0
+                req.finish = now
+                completed.append(req)
+                try_place_all(now)
+            elif kind == RETILE:
+                for idx in retile.get("blocked", []):
+                    if 0 <= idx < len(slices):
+                        slices[idx].blocked = True
+                        for r in [r for r in running.values()
+                                  if r.slice_id == idx]:
+                            unplace(r, now)
+                            r.drained_at = now
+                            drained.append(r)
+                            waiting.append(r)
+                try_place_all(now)
+        except Exception:
+            unhandled_errors += 1
+
+    preemptions = sum(r.preempted for r in requests)
+    # churn: every placement beyond a request's first (preempt or drain)
+    churn = sum(max(0, r.placements - 1) for r in requests)
+
+    lat = sorted(r.finish - r.arrival for r in completed)
+    excess = []
+    slo_met = 0
+    for r in completed:
+        ideal = r.tokens / rate(r)
+        e = (r.finish - r.arrival) - ideal
+        excess.append(e)
+        if e <= queue_slo_s:
+            slo_met += 1
+    excess.sort()
+
+    result = {
+        "simulated": True,
+        "seed": seed,
+        "duration_s": duration_s,
+        "slices": [{"capacity": s.capacity, "blocked": s.blocked}
+                   for s in slices],
+        "arrivals": len(requests),
+        "completed": len(completed),
+        "rejected": rejected,
+        "incomplete": len(waiting) + len(running),
+        "slo_attainment": round(slo_met / len(completed), 4) if completed else None,
+        "latency_p50_s": round(_percentile(lat, 0.50), 4),
+        "latency_p99_s": round(_percentile(lat, 0.99), 4),
+        "queue_excess_p50_s": round(_percentile(excess, 0.50), 4),
+        "queue_excess_p99_s": round(_percentile(excess, 0.99), 4),
+        "preemptions": preemptions,
+        "placement_churn": churn,
+        "unhandled_errors": unhandled_errors,
+    }
+    if retile:
+        window = float(retile.get("drain_window_s", 5.0))
+        replaced = [r for r in drained if r.replaced_at is not None]
+        within = [r for r in replaced
+                  if r.replaced_at - r.drained_at <= window]
+        result["retile"] = {
+            "at": float(retile["at"]),
+            "blocked": list(retile.get("blocked", [])),
+            "drain_window_s": window,
+            "drained_tenants": len(drained),
+            "replaced": len(replaced),
+            "replaced_within_window": len(within),
+            "all_replaced_within_window": len(within) == len(drained),
+            "max_replace_s": round(max(
+                (r.replaced_at - r.drained_at for r in replaced),
+                default=0.0), 4),
+        }
+    return result
+
+
+def scenario_from_handoff(handoff: Optional[dict], **kwargs) -> Dict:
+    """Convenience: run the scenario against a partitioner handoff payload
+    (``read_handoff`` result); falls back to a single 4-chip slice when no
+    partition has been applied yet."""
+    groups = (handoff or {}).get("groups") or [{"topology": "2x2",
+                                                "chips": [0, 1, 2, 3]}]
+    return run_scenario(groups, **kwargs)

@@ -244,6 +244,16 @@ def slice_partitioner_extras(policy: ClusterPolicy) -> dict:
             "slice_state_label": consts.TPU_SLICE_STATE_LABEL}
 
 
+def serving_extras(policy: ClusterPolicy) -> dict:
+    s = policy.spec.serving
+    return {"serving_batch_sizes": ",".join(str(b) for b in s.batch_sizes),
+            "serving_steps": s.steps_per_batch,
+            "serving_max_p99_ms": s.max_decode_p99_ms,
+            "serving_min_tokens": s.min_throughput_tokens_per_s,
+            "serving_min_attainment": s.min_slo_attainment,
+            "probe_interval_s": s.probe_interval_s}
+
+
 def validator_extras(policy: ClusterPolicy) -> dict:
     v = policy.spec.validator
     return {
@@ -294,4 +304,11 @@ def cluster_policy_states(client: Client) -> List:
                      lambda p: p.spec.slice_partitioner, default_enabled=False,
                      extras=slice_partitioner_extras,
                      app_name="tpu-slice-partitioner"),
+        # last in the DAG: serving SLOs are only meaningful on a node the
+        # whole stack (driver->plugin->workload, partitioning) already
+        # certified. Opt-in like the partitioner.
+        OperandState("state-operator-serving", "serving", client,
+                     lambda p: p.spec.serving, default_enabled=False,
+                     extras=serving_extras,
+                     app_name="tpu-serving-validator"),
     ]

@@ -182,12 +182,14 @@ class KubeletSimulator:
         return available, updated
 
     def _complete_validation_pods(self) -> None:
-        """Pinned validation pods (workload + multihost rendezvous) run to
-        completion instantly in the simulator — through ``validation_exec``
-        when the test supplied a runtime, else teleported to Succeeded."""
+        """Pinned validation pods (workload + multihost rendezvous +
+        serving probe) run to completion instantly in the simulator —
+        through ``validation_exec`` when the test supplied a runtime, else
+        teleported to Succeeded."""
         for pod in self.client.list("v1", "Pod", self.namespace):
             app = deep_get(pod, "metadata", "labels", "app", default="")
-            if app not in ("tpu-multihost-validation", "tpu-workload-validation"):
+            if app not in ("tpu-multihost-validation", "tpu-workload-validation",
+                           "tpu-serving-validation"):
                 continue
             if deep_get(pod, "status", "phase") in ("Succeeded", "Failed"):
                 continue  # terminal, restartPolicy: Never

@@ -75,7 +75,7 @@ VALIDATOR_COMPONENT = "tpu-operator-validator"
 OPERAND_COMPONENTS = frozenset({
     "tpu-driver", "tpu-device-plugin", "tpu-operator-validator",
     "tpu-telemetry", "tpu-feature-discovery", "tpu-slice-partitioner",
-    "tpu-node-status-exporter",
+    "tpu-node-status-exporter", "tpu-serving-validator",
 })
 
 

@@ -115,6 +115,27 @@ def workload_health_verdict() -> Optional[str]:
     return "failed"
 
 
+def serving_slo_verdict():
+    """The node's serving-barrier verdict for the ``tpu.ai/serving-slo``
+    label: ``("passed"|"failed"|"corrupt", detail)`` — detail is the
+    annotation payload (measured p99/throughput/attainment or the skip
+    reason). ``(None, "")`` when the barrier has not been written yet
+    (serving validation disabled or not yet run — absence is
+    no-information, not failure)."""
+    from .serving import serving_detail
+    from .status import StatusFiles
+
+    status_dir = os.environ.get("STATUS_DIR", consts.VALIDATION_STATUS_DIR)
+    status = StatusFiles(status_dir)
+    info = status.read("serving")
+    if info is None:
+        if os.path.exists(status.path("serving")):
+            return "corrupt", ""  # present but unparsable: fail safe
+        return None, ""
+    verdict = "passed" if info.get("passed") is not False else "failed"
+    return verdict, serving_detail(info)
+
+
 def sync_node_labels(client, node_name: str, use_jax: bool = True) -> Dict[str, str]:
     """One discovery pass: compute labels, mirror GKE labels, patch if drifted."""
     node = client.get("v1", "Node", node_name)
@@ -140,6 +161,20 @@ def sync_node_labels(client, node_name: str, use_jax: bool = True) -> Dict[str, 
             "annotations": {consts.WORKLOAD_HEALTH_ANNOTATION: verdict}}})
         log.info("feature discovery: %s workload health -> %s",
                  node_name, verdict)
+    # same node-agent role for the serving barrier: verdict label gates
+    # traffic placement, measured numbers ride in the detail annotation
+    serving, detail = serving_slo_verdict()
+    if serving is not None:
+        if serving != current.get(consts.SERVING_SLO_LABEL):
+            client.patch("v1", "Node", node_name, {"metadata": {
+                "labels": {consts.SERVING_SLO_LABEL: serving}}})
+            log.info("feature discovery: %s serving SLO -> %s",
+                     node_name, serving)
+        current_detail = deep_get(node, "metadata", "annotations",
+                                  consts.SERVING_SLO_ANNOTATION)
+        if detail and detail != current_detail:
+            client.patch("v1", "Node", node_name, {"metadata": {
+                "annotations": {consts.SERVING_SLO_ANNOTATION: detail}}})
     return desired
 
 

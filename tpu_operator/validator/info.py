@@ -35,7 +35,8 @@ def collect(install_dir: str = consts.DEFAULT_LIBTPU_DIR,
                    "valid": is_valid_libtpu(libtpu_path(install_dir))},
         "chips": [],
         "validations": {c: status.is_ready(c)
-                        for c in ("driver", "plugin", "workload", "perf")},
+                        for c in ("driver", "plugin", "workload", "perf",
+                                  "serving")},
     }
     driver_record = status.read("driver") or {}
     if driver_record.get("libtpu_version"):
@@ -63,8 +64,21 @@ def collect(install_dir: str = consts.DEFAULT_LIBTPU_DIR,
             info["failed_chips"] = sorted(failed)
     perf = status.read("perf") or {}
     if perf:
+        # ici_allreduce_gbps stays None when the sweep skipped it (single
+        # chip): rendering it as 0.0 would read as a dead fabric
         info["perf"] = {k: perf.get(k, 0.0) for k in
-                        ("mxu_tflops", "hbm_gbps", "ici_allreduce_gbps")}
+                        ("mxu_tflops", "hbm_gbps")}
+        info["perf"]["ici_allreduce_gbps"] = perf.get("ici_allreduce_gbps")
+        info["perf"]["ici_skipped"] = bool(perf.get("ici_skipped"))
+    serving = status.read("serving") or {}
+    if serving:
+        info["serving"] = {
+            "passed": serving.get("passed"),
+            "decode_p99_ms": serving.get("decode_p99_ms"),
+            "throughput_tokens_per_s": serving.get("throughput_tokens_per_s"),
+            "slo_attainment": serving.get("slo_attainment"),
+            "skipped_reason": serving.get("skipped_reason"),
+        }
     if use_jax and os.environ.get("TPU_INFO_SKIP_JAX") != "1":
         try:
             import jax
@@ -127,9 +141,26 @@ def render(info: dict) -> str:
         lines.append(f"  UNHEALTHY:    workload sweep failed — {detail}")
     if "perf" in info:
         p = info["perf"]
-        ici = f"{p['ici_allreduce_gbps']:.0f} GB/s" if p.get("ici_allreduce_gbps") else MISS
+        if p.get("ici_skipped"):
+            # explicitly distinguish "not measured" from "measured 0"
+            ici = "skipped (single chip)"
+        elif p.get("ici_allreduce_gbps") is not None:
+            ici = f"{p['ici_allreduce_gbps']:.0f} GB/s"
+        else:
+            ici = MISS
         lines.append(f"  perf:         MXU {p['mxu_tflops']:.0f} TFLOP/s · "
                      f"HBM {p['hbm_gbps']:.0f} GB/s · ICI {ici}")
+    if "serving" in info:
+        s = info["serving"]
+        if s.get("skipped_reason"):
+            lines.append(f"  serving:      FAILED CLOSED ({s['skipped_reason']})")
+        else:
+            verdict = "pass" if s.get("passed") else "FAIL"
+            lines.append(
+                f"  serving:      {verdict} · p99 "
+                f"{(s.get('decode_p99_ms') or 0):.2f} ms · "
+                f"{(s.get('throughput_tokens_per_s') or 0):.0f} tok/s · "
+                f"attainment {(s.get('slo_attainment') or 0):.2f}")
     return "\n".join(lines)
 
 

@@ -14,6 +14,10 @@ workload-multihost  slice-wide sweep after jax.distributed rendezvous
 perf                measured MXU TFLOP/s, HBM GB/s, ICI allreduce GB/s;
                     optional floors turn it into a gate (no reference
                     analog — DCGM diag is functional-only)
+serving             jitted decode-step SLO probe (p50/p99 latency,
+                    tokens/s over a batch ladder); health-gated — a
+                    quarantined node fails closed; write barrier on
+                    pass AND fail
 info                at-a-glance node status (the nvidia-smi analog):
                     chips, device nodes, libtpu, barriers, perf
 wait                block on another component's barrier (--for)
@@ -44,9 +48,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-c", "--component", required=True,
                    choices=["driver", "driver-daemon", "driver-probe", "plugin",
                             "workload", "workload-local", "workload-multihost",
-                            "perf", "wait", "sleep", "metrics", "telemetry",
-                            "feature-discovery", "slice-partitioner",
-                            "device-plugin", "cdi", "info"])
+                            "perf", "serving", "wait", "sleep", "metrics",
+                            "telemetry", "feature-discovery",
+                            "slice-partitioner", "device-plugin", "cdi",
+                            "info"])
     p.add_argument("--json", action="store_true",
                    help="info: machine-readable output")
     p.add_argument("--cdi-dir", default="/etc/cdi")
@@ -79,6 +84,22 @@ def build_parser() -> argparse.ArgumentParser:
                    default=float(os.environ.get("MIN_HBM_GBPS", "0")))
     p.add_argument("--min-ici-gbps", type=float,
                    default=float(os.environ.get("MIN_ICI_GBPS", "0")))
+    p.add_argument("--serving-batch-sizes",
+                   default=os.environ.get("SERVING_BATCH_SIZES", "1,4,8"),
+                   help="comma-separated batch ladder for the serving probe")
+    p.add_argument("--serving-steps", type=int,
+                   default=int(os.environ.get("SERVING_STEPS", "32")))
+    p.add_argument("--max-decode-p99-ms", type=float,
+                   default=float(os.environ.get("MAX_DECODE_P99_MS", "200")))
+    p.add_argument("--min-tokens-per-s", type=float,
+                   default=float(os.environ.get("MIN_TOKENS_PER_S", "0")))
+    p.add_argument("--min-slo-attainment", type=float,
+                   default=float(os.environ.get("MIN_SLO_ATTAINMENT", "0.99")))
+    p.add_argument("--serving-interval", type=float,
+                   default=float(os.environ.get("SERVING_PROBE_INTERVAL", "0")),
+                   help="serving: re-probe every N seconds (continuous "
+                        "mode for the DS main container when "
+                        "spec.serving.probeIntervalS > 0); 0 = one shot")
     p.add_argument("--coordinator", default=os.environ.get("TPU_COORDINATOR_ADDRESS", ""))
     p.add_argument("--num-processes", type=int,
                    default=int(os.environ.get("TPU_NUM_PROCESSES", "1")))
@@ -288,6 +309,41 @@ def run(argv=None, client=None) -> int:
         if report.passed:
             status.write("perf", report.to_dict())
         return 0 if report.passed else 1
+
+    if component == "serving":
+        from .serving import run_serving
+        from .workload import enable_compilation_cache
+
+        enable_compilation_cache()
+        batch_sizes = [int(b) for b in
+                       str(args.serving_batch_sizes).split(",") if b.strip()]
+
+        def probe_once() -> int:
+            return run_serving(
+                status, batch_sizes=batch_sizes or [1],
+                steps_per_batch=args.serving_steps,
+                max_decode_p99_ms=args.max_decode_p99_ms,
+                min_throughput_tokens_per_s=args.min_tokens_per_s,
+                min_slo_attainment=args.min_slo_attainment,
+                client=client)
+
+        rc = probe_once()
+        # continuous mode (DS main container): keep re-probing so a decode
+        # tail that regresses AFTER pod start flips the barrier/label —
+        # one-shot init-container certification goes stale the same way
+        # the workload sweep would without revalidation
+        while args.serving_interval > 0:
+            import time as _time
+
+            _time.sleep(args.serving_interval)
+            try:
+                rc = probe_once()
+            except Exception:
+                # never crash-loop the serving DS over one probe hiccup;
+                # the barrier keeps its last real verdict
+                log.exception("serving re-probe failed; retrying next "
+                              "interval")
+        return rc
 
     if component == "wait":
         ok = status.wait_for(args.wait_for, timeout=args.timeout)

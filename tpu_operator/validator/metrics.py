@@ -59,10 +59,13 @@ class NodeMetrics:
                  "Measured MXU throughput (bf16 TFLOP/s) from perf validation"),
                 ("hbm_gbps",
                  "Measured HBM bandwidth (GB/s) from perf validation"),
-                ("ici_allreduce_gbps",
-                 "Measured ICI allreduce bus bandwidth (GB/s) from perf validation"),
             )
         }
+        # ICI bandwidth is registered lazily: a single-chip host never
+        # measures it (perf.py records null + ici_skipped) and a 0.0 gauge
+        # would read as a dead fabric on dashboards. No series until the
+        # barrier carries a real number — matching the native exporter.
+        self._ici: Optional[Gauge] = None
 
     def refresh(self) -> None:
         for component, gauge in self.ready.items():
@@ -103,7 +106,28 @@ class NodeMetrics:
             # reset to 0 when the barrier is cleared (e.g. during an
             # upgrade re-validation) so stale throughput never looks current
             gauge.set(value if isinstance(value, (int, float)) else 0)
+        self._set_ici(perf.get("ici_allreduce_gbps"))
         self.last_refresh.set(time.time())
+
+    def _set_ici(self, value) -> None:
+        """ICI series present iff the barrier holds a measured number:
+        null/absent (skipped on a single-chip host, or barrier cleared)
+        unregisters the gauge rather than publishing a lying 0.0."""
+        measured = (isinstance(value, (int, float))
+                    and not isinstance(value, bool))
+        if not measured:
+            if self._ici is not None:
+                self.registry.unregister(self._ici)
+                self._ici = None
+            return
+        if self._ici is None:
+            self._ici = Gauge(
+                "tpu_operator_node_ici_allreduce_gbps",
+                "Measured ICI allreduce bus bandwidth (GB/s) from perf "
+                "validation; series absent when the sweep skipped the "
+                "measurement (single chip)",
+                registry=self.registry)
+        self._ici.set(value)
 
     def scrape(self) -> bytes:
         return generate_latest(self.registry)

@@ -84,7 +84,12 @@ class PerfReport:
     accumulation: str = "fp32"
     mxu_tflops: float = 0.0
     hbm_gbps: float = 0.0
-    ici_allreduce_gbps: float = 0.0  # 0 when single-chip (no ICI to measure)
+    #: None when unmeasured (single chip: no ICI fabric exists) — a real
+    #: measured 0.0 would mean a dead fabric, so the two must not share a
+    #: value; consumers (info, metrics, bench) key off ici_skipped
+    ici_allreduce_gbps: Optional[float] = None
+    #: True when the ICI sweep was skipped rather than measured
+    ici_skipped: bool = False
     #: measured / published-peak; None when the chip has no PEAK_TABLE row.
     #: A fraction > 1.05 is physically impossible and fails the gate.
     mxu_peak_fraction: Optional[float] = None
@@ -318,23 +323,30 @@ def measure_hbm_pallas_gbps(mib: int = 512, iters: int = 5
 HBM_STREAMING_BAND = (0.8, 1.25)
 
 
-def measure_ici_allreduce_gbps(mib: int = 64, iters: int = 5
-                               ) -> Tuple[float, bool]:
-    """Ring-allreduce bus bandwidth across all local devices (0 if <2).
+def measure_ici_allreduce_gbps(mib: int = 64, iters: int = 5,
+                               growth_budget_s: float = 15.0
+                               ) -> Tuple[Optional[float], bool]:
+    """Ring-allreduce bus bandwidth across all local devices; (None, True)
+    when there is nothing to measure (<2 chips — "no fabric" is not the
+    same number as "fabric at 0 GB/s").
 
     Unlike the MXU/HBM sweeps this grows the BUFFER, not the chain, to
     clear the noise floor: deep chains of pmap collectives wedge XLA's
     in-process CPU rendezvous (every chained call needs all N per-device
     threads simultaneously; ~64 deep, one participant starves past the 40 s
     rendezvous abort), and a bandwidth measurement is equally honest with a
-    bigger payload."""
+    bigger payload. The growth is wall-clock bounded: once
+    ``growth_budget_s`` is spent without clearing the floor the result is
+    returned untrustworthy as-is — on a host whose timing is that noisy,
+    ballooning to the 512 MiB cap burns minutes of multi-GiB allocations
+    to reach the same ok=False verdict."""
     import jax
     import jax.numpy as jnp
 
     devices = jax.local_devices()
     n = len(devices)
     if n < 2:
-        return 0.0, True
+        return None, True
 
     @functools.partial(jax.pmap, axis_name="i")
     def allreduce(x):
@@ -343,10 +355,12 @@ def measure_ici_allreduce_gbps(mib: int = 64, iters: int = 5
 
     elems = mib * 1024 * 1024 // 4
     cap = 512 * 1024 * 1024 // 4  # per-device fp32 elements at 512 MiB
+    grow_start = time.monotonic()
     while True:
         x = jnp.ones((n, elems), dtype=jnp.float32)
         t, ok, _, _ = _chain_time(allreduce, x, iters, max_iters=8)
-        if ok or elems * 4 > cap:
+        if (ok or elems * 4 > cap
+                or time.monotonic() - grow_start > growth_budget_s):
             break
         elems *= 4
     # standard allreduce traffic model: each chip sends+receives
@@ -376,7 +390,10 @@ def run_perf(matrix_dim: int = 4096, hbm_mib: int = 512, ici_mib: int = 64,
         ici, ici_ok = measure_ici_allreduce_gbps(ici_mib, iters)
         report.mxu_tflops = round(mxu, 3)
         report.hbm_gbps = round(hbm, 3)
-        report.ici_allreduce_gbps = round(ici, 3)
+        if ici is None:
+            report.ici_skipped = True  # single chip: no fabric to measure
+        else:
+            report.ici_allreduce_gbps = round(ici, 3)
         report.mxu_cross_check_ratio = ratio
         pallas_hbm, pallas_ok = measure_hbm_pallas_gbps(hbm_mib, iters)
         if pallas_ok and pallas_hbm > 0:
@@ -431,7 +448,12 @@ def run_perf(matrix_dim: int = 4096, hbm_mib: int = 512, ici_mib: int = 64,
     for key in ("mxu_tflops", "hbm_gbps", "ici_allreduce_gbps"):
         floor = thresholds.get(key, 0.0)
         measured = getattr(report, key)
-        if floor > 0 and measured < floor:
+        if floor > 0 and measured is None:
+            # an explicit floor demands a measurement; "skipped" cannot
+            # satisfy it (a single-chip node can't certify ICI bandwidth)
+            report.failures.append(
+                f"{key} not measured (skipped) but floor {floor} required")
+        elif floor > 0 and measured < floor:
             report.failures.append(
                 f"{key}={measured} below required floor {floor}")
     report.passed = not report.failures

@@ -1,0 +1,143 @@
+"""Serving SLO validation: CLI glue between the probe and the barrier.
+
+The probe itself lives in :mod:`tpu_operator.serving.probe`; this module
+adds what the validator pipeline needs around it:
+
+- the **health gate**: a quarantined/remediating/failed node must not
+  certify serving SLOs — the probe is skipped and the barrier written
+  fail-CLOSED (``passed: false`` with a ``skipped_reason``), so the
+  ``tpu.ai/serving-slo`` label goes ``failed`` and traffic placement
+  (bench traffic scenario, future tenant placement) treats the node as
+  zero serving capacity. Health state comes from the pod env
+  (``TPU_HEALTH_STATE``, stamped by the DS template via the downward API
+  analog) or, when a client is available, the node's
+  ``tpu.ai/health-state`` label directly.
+- the **barrier contract**: unlike perf (which only records passes), the
+  serving barrier is written on BOTH pass and fail — a node whose decode
+  tail regresses must flip its label to ``failed``, exactly like the
+  workload barrier, or SLO monitoring is theater.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Optional, Sequence
+
+from .. import consts
+from ..utils import deep_get
+from .status import StatusFiles
+
+log = logging.getLogger(__name__)
+
+#: health states that fail the serving probe closed (the machine's
+#: unhealthy half: degraded is still serving, these are not)
+GATED_HEALTH_STATES = ("quarantined", "remediating", "failed")
+
+#: standalone probe pod (workload.py WORKLOAD_POD_TEMPLATE analog) — the
+#: shape tests exec through the kubelet simulator's validation_exec
+SERVING_POD_TEMPLATE = {
+    "apiVersion": "v1",
+    "kind": "Pod",
+    "metadata": {"name": "tpu-serving-validation",
+                 "labels": {"app": "tpu-serving-validation"}},
+    "spec": {
+        "restartPolicy": "Never",
+        "tolerations": [{"key": "google.com/tpu", "operator": "Exists",
+                         "effect": "NoSchedule"}],
+        "containers": [{
+            "name": "tpu-serving",
+            "image": "FILLED_BY_VALIDATOR",
+            "command": ["tpu-validator"],
+            "args": ["-c", "serving"],
+            "env": [{"name": "STATUS_DIR", "value": "FILLED_BY_VALIDATOR"}],
+        }],
+    },
+}
+
+
+def node_health_state(client=None, node_name: Optional[str] = None) -> Optional[str]:
+    """This node's chip-health state, best source first: the pod env
+    (``TPU_HEALTH_STATE``), then the node label via the apiserver. None =
+    unknown/healthy (absence of the label is the healthy steady state)."""
+    state = os.environ.get("TPU_HEALTH_STATE")
+    if state:
+        return state
+    node_name = node_name or os.environ.get("NODE_NAME", "")
+    if client is None or not node_name:
+        return None
+    try:
+        node = client.get("v1", "Node", node_name)
+        return deep_get(node, "metadata", "labels", consts.HEALTH_STATE_LABEL)
+    except Exception as e:
+        # can't read the label -> don't gate: the probe's own numbers are
+        # still a real verdict, and FD/health own quarantine enforcement
+        log.debug("serving: health-state lookup failed: %s", e)
+        return None
+
+
+def run_serving(status: StatusFiles,
+                batch_sizes: Sequence[int] = (1, 4, 8),
+                steps_per_batch: int = 32,
+                max_decode_p99_ms: float = 200.0,
+                min_throughput_tokens_per_s: float = 0.0,
+                min_slo_attainment: float = 0.99,
+                client=None, node_name: Optional[str] = None) -> int:
+    """One probe cycle: health gate, probe, barrier write, exit code."""
+    from ..serving.probe import run_probe, skipped_report
+
+    thresholds = {"max_decode_p99_ms": max_decode_p99_ms,
+                  "min_throughput_tokens_per_s": min_throughput_tokens_per_s,
+                  "min_slo_attainment": min_slo_attainment}
+    state = node_health_state(client, node_name)
+    if state in GATED_HEALTH_STATES:
+        report = skipped_report(f"health-state={state}", thresholds)
+        log.warning("serving probe skipped, failing closed: node is %s", state)
+    else:
+        try:
+            report = run_probe(
+                batch_sizes=batch_sizes, steps_per_batch=steps_per_batch,
+                max_decode_p99_ms=max_decode_p99_ms,
+                min_throughput_tokens_per_s=min_throughput_tokens_per_s,
+                min_slo_attainment=min_slo_attainment)
+        except Exception as e:
+            # a probe that can't run (no runtime, chips busy) is a failed
+            # serving verdict, not a crash: fail closed with the reason
+            log.exception("serving probe crashed")
+            report = skipped_report(f"probe-error: {e}"[:200], thresholds)
+    print(json.dumps(report.to_dict()))
+    status.write("serving", report.to_dict())
+    return 0 if report.passed else 1
+
+
+def serving_detail(report: dict) -> str:
+    """Compact annotation value for the measured numbers (commas/decimals
+    are not label-safe, so detail rides in an annotation)."""
+    if report.get("skipped_reason"):
+        return f"skipped={report['skipped_reason']}"
+    return (f"p99_ms={report.get('decode_p99_ms', 0)},"
+            f"tokens_per_s={report.get('throughput_tokens_per_s', 0)},"
+            f"attainment={report.get('slo_attainment', 0)}")
+
+
+def parse_serving_detail(detail) -> dict:
+    """Inverse of :func:`serving_detail`, for the operator's rollup sweep
+    and ``tpuop-cfg status``: ``{"p99_ms": .., "tokens_per_s": ..,
+    "attainment": ..}`` or ``{"skipped": reason}``; ``{}`` on absent or
+    garbled annotations (a half-written value must degrade to
+    "no numbers", never crash the reconcile sweep)."""
+    if not detail or not isinstance(detail, str):
+        return {}
+    if detail.startswith("skipped="):
+        return {"skipped": detail[len("skipped="):]}
+    out: dict = {}
+    for part in detail.split(","):
+        key, sep, value = part.partition("=")
+        if not sep:
+            continue
+        try:
+            out[key.strip()] = float(value)
+        except ValueError:
+            continue
+    return out
