@@ -144,6 +144,44 @@ def test_serving_cli_dispatch(tmp_path, monkeypatch, capsys):
     assert (tmp_path / "serving-ready").exists()
 
 
+def test_serving_cli_health_gate_via_node_label(fake_client, tmp_path,
+                                                monkeypatch, capsys):
+    """The deployed DS stamps no TPU_HEALTH_STATE env, so the gate must
+    reach the node's tpu.ai/health-state label through the apiserver
+    client the serving branch builds (regression: the branch passed
+    client=None, node_health_state always returned None in production,
+    and a quarantined node could publish a passing barrier)."""
+    monkeypatch.delenv("TPU_HEALTH_STATE", raising=False)
+    monkeypatch.setenv("NODE_NAME", "tpu-0")
+    fake_client.create({
+        "apiVersion": "v1", "kind": "Node",
+        "metadata": {"name": "tpu-0",
+                     "labels": {consts.HEALTH_STATE_LABEL: "quarantined"}},
+        "status": {}})
+    rc = vmain.run(["-c", "serving", "--status-dir", str(tmp_path),
+                    "--serving-batch-sizes", "1", "--serving-steps", "4"],
+                   client=fake_client)
+    assert rc == 1
+    report = StatusFiles(str(tmp_path)).read("serving")
+    assert report["passed"] is False
+    assert report["skipped_reason"] == "health-state=quarantined"
+
+
+def test_serving_cli_tolerates_off_cluster_client_failure(tmp_path,
+                                                          monkeypatch,
+                                                          capsys):
+    """Off-cluster (no KUBE_API_URL, no in-cluster env) make_client
+    raises; the probe must still run with the gate degraded to env-only
+    instead of crashing."""
+    monkeypatch.delenv("TPU_HEALTH_STATE", raising=False)
+    monkeypatch.delenv("KUBE_API_URL", raising=False)
+    monkeypatch.delenv("KUBERNETES_SERVICE_HOST", raising=False)
+    rc = vmain.run(["-c", "serving", "--status-dir", str(tmp_path),
+                    "--serving-batch-sizes", "1", "--serving-steps", "4"])
+    assert rc == 0
+    assert StatusFiles(str(tmp_path)).read("serving")["passed"] is True
+
+
 # -- traffic scenario ---------------------------------------------------------
 
 def test_traffic_scenario_deterministic():
@@ -248,6 +286,52 @@ def test_feature_discovery_serving_verdict(tmp_path, monkeypatch):
         "skipped": "health-state=quarantined"}
 
 
+def test_serving_verdict_corrupt_barrier_fails_safe(tmp_path, monkeypatch):
+    """Only an explicit ``passed: true`` certifies. A barrier that does
+    not parse, or parses but carries no verdict key (truncated-but-valid
+    or foreign payload), is corrupt — regression: ``is not False``
+    labeled the verdict-less case 'passed'."""
+    from tpu_operator.validator.feature_discovery import serving_slo_verdict
+
+    monkeypatch.setenv("STATUS_DIR", str(tmp_path))
+    status = StatusFiles(str(tmp_path))
+    with open(status.path("serving"), "w") as f:
+        f.write("{truncated")
+    assert serving_slo_verdict() == ("corrupt", "skipped=corrupt")
+    with open(status.path("serving"), "w") as f:
+        f.write(json.dumps({"decode_p99_ms": 2.5,
+                            "throughput_tokens_per_s": 900.0}))
+    assert serving_slo_verdict() == ("corrupt", "skipped=corrupt")
+
+
+def test_sync_replaces_stale_numbers_on_corrupt_barrier(fake_client, tmp_path,
+                                                        monkeypatch):
+    """When the barrier goes corrupt the detail annotation must be
+    overwritten too — regression: the ``if detail`` guard left the old
+    measured p99/tokens/attainment on the node next to a 'corrupt' label
+    and the operator kept exporting them as live gauges."""
+    from tpu_operator.validator.feature_discovery import sync_node_labels
+
+    monkeypatch.setenv("TPU_FD_SKIP_JAX", "1")
+    monkeypatch.setenv("STATUS_DIR", str(tmp_path))
+    monkeypatch.setenv("TPU_DEV_GLOBS", str(tmp_path / "dev" / "accel*"))
+    fake_client.create({
+        "apiVersion": "v1", "kind": "Node",
+        "metadata": {"name": "n1",
+                     "labels": {consts.SERVING_SLO_LABEL: "passed"},
+                     "annotations": {
+                         consts.SERVING_SLO_ANNOTATION:
+                         "p99_ms=3.2,tokens_per_s=1200.0,attainment=0.997"}},
+        "status": {}})
+    with open(StatusFiles(str(tmp_path)).path("serving"), "w") as f:
+        f.write("{truncated")
+    sync_node_labels(fake_client, "n1")
+    node = fake_client.get("v1", "Node", "n1")
+    assert node["metadata"]["labels"][consts.SERVING_SLO_LABEL] == "corrupt"
+    assert node["metadata"]["annotations"][consts.SERVING_SLO_ANNOTATION] \
+        == "skipped=corrupt"
+
+
 # -- operator rollup: gauges, condition, alert feed ---------------------------
 
 def test_controller_sweep_rolls_up_serving_verdicts(fake_client):
@@ -306,6 +390,43 @@ def test_controller_sweep_rolls_up_serving_verdicts(fake_client):
         node="tpu-1")._value.get() == 1200.0
     assert r.metrics.serving_slo_attainment.labels(
         node="tpu-1")._value.get() == 0.997
+
+
+def test_controller_sweep_unfreezes_condition_when_labels_vanish(fake_client):
+    """Serving disabled / nodes replaced AFTER a failure rolled up: the
+    ServingValidated condition must go Unknown instead of freezing at
+    False with a stale SLO-failed message forever."""
+    from tpu_operator.api.clusterpolicy import new_cluster_policy
+    from tpu_operator.conditions import SERVING_VALIDATED, get_condition
+    from tpu_operator.controllers.clusterpolicy_controller import (
+        ClusterPolicyReconciler,
+    )
+    from tpu_operator.controllers.runtime import Request
+
+    fake_client.create(new_cluster_policy())
+    fake_client.create({
+        "apiVersion": "v1", "kind": "Node",
+        "metadata": {"name": "tpu-1", "labels": {
+            consts.GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice",
+            consts.GKE_TPU_TOPOLOGY_LABEL: "2x4",
+            consts.SERVING_SLO_LABEL: "failed"}}, "status": {}})
+    r = ClusterPolicyReconciler(fake_client)
+    r.reconcile(Request("cluster-policy"))
+    cond = get_condition(
+        fake_client.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy"),
+        SERVING_VALIDATED)
+    assert cond is not None and cond["status"] == "False"
+
+    # the verdict label disappears (merge-patch delete)
+    fake_client.patch("v1", "Node", "tpu-1", {"metadata": {
+        "labels": {consts.SERVING_SLO_LABEL: None}}})
+    r.reconcile(Request("cluster-policy"))
+    cond = get_condition(
+        fake_client.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy"),
+        SERVING_VALIDATED)
+    assert cond is not None and cond["status"] == "Unknown"
+    assert cond["reason"] == "ServingNotReporting"
+    assert "no nodes reporting" in cond["message"]
 
 
 def test_controller_sweep_no_verdicts_is_no_information(fake_client):

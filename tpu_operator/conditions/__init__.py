@@ -46,6 +46,10 @@ REASON_NODE_HEALTH_DEGRADED = "NodeHealthDegraded"
 SERVING_VALIDATED = "ServingValidated"
 REASON_SERVING_SLO_MET = "ServingSLOMet"
 REASON_SERVING_SLO_FAILED = "ServingSLOFailed"
+#: every serving label disappeared (validation disabled, nodes replaced)
+#: AFTER a verdict had been rolled up: the condition goes Unknown rather
+#: than freezing at its last True/False
+REASON_SERVING_NOT_REPORTING = "ServingNotReporting"
 
 
 def make_condition(type_: str, status: str, reason: str, message: str = "") -> dict:

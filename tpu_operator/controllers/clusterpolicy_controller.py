@@ -25,6 +25,7 @@ from ..conditions import (
     REASON_OPERAND_NOT_READY,
     REASON_READY,
     REASON_RECONCILE_FAILED,
+    REASON_SERVING_NOT_REPORTING,
     REASON_SERVING_SLO_FAILED,
     REASON_SERVING_SLO_MET,
     REASON_SLICE_PARTITION_FAILED,
@@ -234,6 +235,13 @@ class ClusterPolicyReconciler(Reconciler):
             set_condition(conditions, make_condition(
                 SERVING_VALIDATED, "True", REASON_SERVING_SLO_MET,
                 f"serving SLO met on {reporting} reporting node(s)"))
+        elif current is not None:
+            # every verdict label vanished (serving disabled / nodes
+            # replaced): without this the condition freezes at its last
+            # True/False and a stale SLO-failed message lives forever
+            set_condition(conditions, make_condition(
+                SERVING_VALIDATED, "Unknown", REASON_SERVING_NOT_REPORTING,
+                "no nodes reporting a serving-SLO verdict"))
 
     def _sweep_health(self, policy: ClusterPolicy,
                       nodes: List[dict]) -> None:

@@ -119,9 +119,10 @@ def serving_slo_verdict():
     """The node's serving-barrier verdict for the ``tpu.ai/serving-slo``
     label: ``("passed"|"failed"|"corrupt", detail)`` — detail is the
     annotation payload (measured p99/throughput/attainment or the skip
-    reason). ``(None, "")`` when the barrier has not been written yet
-    (serving validation disabled or not yet run — absence is
-    no-information, not failure)."""
+    reason; ``skipped=corrupt`` on a corrupt barrier so stale measured
+    numbers never outlive their verdict). ``(None, "")`` when the barrier
+    has not been written yet (serving validation disabled or not yet run —
+    absence is no-information, not failure)."""
     from .serving import serving_detail
     from .status import StatusFiles
 
@@ -130,9 +131,14 @@ def serving_slo_verdict():
     info = status.read("serving")
     if info is None:
         if os.path.exists(status.path("serving")):
-            return "corrupt", ""  # present but unparsable: fail safe
+            return "corrupt", "skipped=corrupt"  # unparsable: fail safe
         return None, ""
-    verdict = "passed" if info.get("passed") is not False else "failed"
+    if "passed" not in info:
+        # parses as JSON but carries no verdict (truncated-but-valid or
+        # foreign payload): never certify from it — only an explicit
+        # ``passed: true`` may label the node passed
+        return "corrupt", "skipped=corrupt"
+    verdict = "passed" if info.get("passed") is True else "failed"
     return verdict, serving_detail(info)
 
 
@@ -172,7 +178,10 @@ def sync_node_labels(client, node_name: str, use_jax: bool = True) -> Dict[str, 
                      node_name, serving)
         current_detail = deep_get(node, "metadata", "annotations",
                                   consts.SERVING_SLO_ANNOTATION)
-        if detail and detail != current_detail:
+        # patch on ANY drift (detail is never empty when a verdict exists):
+        # a corrupt barrier must replace stale measured numbers with its
+        # skipped=corrupt marker or the operator keeps exporting them
+        if detail != current_detail:
             client.patch("v1", "Node", node_name, {"metadata": {
                 "annotations": {consts.SERVING_SLO_ANNOTATION: detail}}})
     return desired

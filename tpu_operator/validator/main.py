@@ -317,6 +317,18 @@ def run(argv=None, client=None) -> int:
         enable_compilation_cache()
         batch_sizes = [int(b) for b in
                        str(args.serving_batch_sizes).split(",") if b.strip()]
+        # the health gate reads the node's tpu.ai/health-state label via
+        # the apiserver (no manifest stamps TPU_HEALTH_STATE); without a
+        # client the deployed DS would never see quarantine and could
+        # certify a bad node. Client construction may fail off-cluster —
+        # tolerate it, matching node_health_state's no-gate-on-lookup-
+        # failure policy (the env path still applies when stamped).
+        if client is None:
+            try:
+                client = make_client()
+            except Exception as e:
+                log.warning("serving: no apiserver client (%s); health "
+                            "gate limited to TPU_HEALTH_STATE env", e)
 
         def probe_once() -> int:
             return run_serving(
