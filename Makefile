@@ -30,6 +30,13 @@ chaos:  ## seeded fault-injection/soak suite: convergence under 30% API failure 
 	CHAOS_SEED=$(CHAOS_SEED) SOAK_SEED=$(CHAOS_SEED) $(PYTHON) -m pytest tests/ -q \
 		-k "chaos or fault or soak" --continue-on-collection-errors
 
+DRAIN_SOAK_SEED ?= 20260805
+
+.PHONY: drain-soak
+drain-soak:  ## coordinated drain/handoff acceptance soak: plan -> checkpoint-ack -> incremental re-tile -> resume; kill-mid-drain + deadline-expiry variants, seed-pinned chaos
+	CHAOS_SEED=$(DRAIN_SOAK_SEED) $(PYTHON) -m pytest \
+		tests/test_health_soak.py tests/test_drain.py -q
+
 .PHONY: bench
 bench:
 	$(PYTHON) bench.py

@@ -300,11 +300,12 @@ SERVING_TRAFFIC_SEED = 20260805
 
 def bench_serving_traffic(seed: int = SERVING_TRAFFIC_SEED) -> dict:
     """Seeded multi-tenant traffic scenario over a partitioned slice
-    layout with a health-driven re-tile injected mid-run: slice 1 goes
-    unhealthy at t=60s, its tenants drain and must re-place onto the
-    remaining capacity within the 10 s drain window. Pure simulation
+    layout with a COORDINATED re-tile injected mid-run: the RetilePlanned
+    signal for slice 1 lands at t=60s, its tenants migrate during the 10 s
+    drain window, and the slice blocks at the deadline. Pure simulation
     (labeled as such) — the published numbers are SLO attainment, latency
-    percentiles, preemptions, placement churn, and the re-place record."""
+    percentiles, preemptions, placement churn, and the drain record
+    (drained_within_window)."""
     from tpu_operator.serving.traffic import run_scenario
 
     groups = [{"topology": "2x2", "chips": [0, 1, 2, 3]},
@@ -317,7 +318,8 @@ def bench_serving_traffic(seed: int = SERVING_TRAFFIC_SEED) -> dict:
     return run_scenario(
         groups, seed=seed, duration_s=120.0, arrival_rate_per_s=3.0,
         per_token_ms=25.0, queue_slo_s=1.0,
-        retile={"at": 60.0, "blocked": [1], "drain_window_s": 10.0})
+        retile={"at": 60.0, "blocked": [1], "drain_window_s": 10.0,
+                "planned": True})
 
 
 def _run_json_subprocess(script: str, timeout: float, env=None) -> dict:

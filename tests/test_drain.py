@@ -1,0 +1,276 @@
+"""Drain-protocol vocabulary (tpu_operator/health/drain.py) and its
+incremental re-tile companion (topology.retile_incremental).
+
+The machine-side gate has its own suite (test_health.py test_drain_gate_*),
+the partitioner-side gate lives in test_partitioner.py, and the full-stack
+soak is test_health_soak.py — this file covers the shared primitives those
+all build on: fingerprints, plan (de)serialisation, barrier ack stamps,
+host-path checkpoints, the agent-side ack hook, and the simulated training
+job the soak drives.
+"""
+
+import json
+import os
+
+import pytest
+
+from tpu_operator import consts
+from tpu_operator.health import drain
+from tpu_operator.partitioner import topology
+from tpu_operator.testing import SimulatedTrainingJob
+from tpu_operator.validator.status import StatusFiles
+
+NODE = "tpu-0"
+
+
+@pytest.fixture
+def status(tmp_path):
+    return StatusFiles(str(tmp_path / "status"))
+
+
+def mk_node(fake_client, annotations=None):
+    fake_client.create({"apiVersion": "v1", "kind": "Node",
+                        "metadata": {"name": NODE,
+                                     "annotations": annotations or {}},
+                        "spec": {}, "status": {}})
+    return fake_client
+
+
+def publish_plan(fake_client, partition="split", blocked=(2,),
+                 deadline=2_000_000.0):
+    plan = drain.RetilePlan(
+        fingerprint=drain.plan_fingerprint(partition, list(blocked)),
+        deadline=deadline, reason=drain.REASON_RETILE,
+        blocked=list(blocked))
+    fake_client.patch("v1", "Node", NODE, {"metadata": {"annotations": {
+        consts.RETILE_PLAN_ANNOTATION: plan.to_json()}}})
+    return plan
+
+
+# -- fingerprints -------------------------------------------------------------
+
+def test_fingerprint_is_order_and_type_insensitive():
+    a = drain.plan_fingerprint("split", [5, 2])
+    assert a == drain.plan_fingerprint("split", (2, 5))
+    assert a == drain.plan_fingerprint("split", ["5", "2"])
+    assert a != drain.plan_fingerprint("split", [2])
+    assert a != drain.plan_fingerprint("other", [5, 2])
+
+
+def test_fingerprint_no_partition_matches_empty_string():
+    # the operator reads the label (may be absent -> None), the partitioner
+    # reads `desired` (may be "") — both must land on the same identity
+    assert drain.plan_fingerprint(None, []) == drain.plan_fingerprint("", [])
+    assert drain.plan_fingerprint(None, None) == drain.plan_fingerprint("", [])
+
+
+# -- plan (de)serialisation ---------------------------------------------------
+
+def test_plan_roundtrip_through_annotation():
+    plan = drain.RetilePlan(fingerprint="abc123", deadline=1234.5,
+                            reason=drain.REASON_REMEDIATE, blocked=[3, 1])
+    parsed = drain.parse_plan(plan.to_json())
+    assert parsed.fingerprint == "abc123"
+    assert parsed.deadline == 1234.5
+    assert parsed.reason == drain.REASON_REMEDIATE
+    assert parsed.blocked == [1, 3]  # canonicalised
+
+
+def test_plan_expiry_uses_injected_clock():
+    plan = drain.RetilePlan(fingerprint="f", deadline=100.0, reason="retile")
+    assert not plan.expired(99.9)
+    assert plan.expired(100.0)
+
+
+@pytest.mark.parametrize("raw", [
+    None, "", "{not json", "[]", '{"deadline": 5}',
+    '{"fingerprint": "f", "deadline": "soon"}'])
+def test_corrupt_plan_parses_to_none(raw):
+    assert drain.parse_plan(raw) is None
+
+
+# -- barrier ack stamps -------------------------------------------------------
+
+def test_drain_ack_preserves_barrier_verdict(status):
+    status.write("workload", {"passed": False, "n_devices": 8,
+                              "failed_local_chips": [2]})
+    drain.write_drain_ack(status, "fp-1", step=41,
+                          checkpoint="/x/ckpt.json", now=lambda: 5.0)
+    info = status.read("workload")
+    # the verdict payload rode along untouched
+    assert info["passed"] is False
+    assert info["failed_local_chips"] == [2]
+    ack = drain.read_drain_ack(status)
+    assert ack == {"plan": "fp-1", "acked_at": 5.0, "step": 41,
+                   "checkpoint": "/x/ckpt.json"}
+
+
+def test_read_drain_ack_absent_or_malformed(status):
+    assert drain.read_drain_ack(status) is None  # no barrier at all
+    status.write("workload", {"passed": True})
+    assert drain.read_drain_ack(status) is None  # barrier, no stamp
+    status.write("workload", {"passed": True, "drain_ack": "yes"})
+    assert drain.read_drain_ack(status) is None  # stamp not a dict
+
+
+def test_ack_annotation_roundtrip(fake_client):
+    mk_node(fake_client)
+    value = drain.ack_annotation_value({"plan": "fp-9", "step": 12,
+                                        "acked_at": 1.0,
+                                        "checkpoint": "/x"})
+    # compact: only what the operator's gate needs
+    assert json.loads(value) == {"plan": "fp-9", "step": 12}
+    fake_client.patch("v1", "Node", NODE, {"metadata": {"annotations": {
+        consts.DRAIN_ACK_ANNOTATION: value}}})
+    assert drain.node_acked_plan(fake_client.get("v1", "Node", NODE)) == "fp-9"
+
+
+def test_node_acked_plan_corrupt_is_none(fake_client):
+    mk_node(fake_client, {consts.DRAIN_ACK_ANNOTATION: "{broken"})
+    assert drain.node_acked_plan(fake_client.get("v1", "Node", NODE)) is None
+    assert drain.ack_annotation_value(None) is None
+
+
+# -- host-path checkpoints ----------------------------------------------------
+
+def test_checkpoint_roundtrip(tmp_path):
+    path = drain.checkpoint_path(str(tmp_path))
+    drain.save_checkpoint(path, 17, rng_state=[1, 2],
+                          compile_cache="/cache", extra={"epoch": 3},
+                          now=lambda: 9.0)
+    ckpt = drain.load_checkpoint(path)
+    assert ckpt == {"step": 17, "saved_at": 9.0, "rng_state": [1, 2],
+                    "compile_cache": "/cache", "epoch": 3}
+    assert not os.path.exists(path + ".tmp")  # atomic: no droppings
+
+
+def test_checkpoint_corrupt_or_absent_is_none(tmp_path):
+    path = drain.checkpoint_path(str(tmp_path))
+    assert drain.load_checkpoint(path) is None
+    with open(path, "w") as f:
+        f.write("{torn")
+    assert drain.load_checkpoint(path) is None
+    with open(path, "w") as f:
+        json.dump({"rng_state": 4}, f)  # no step: unusable
+    assert drain.load_checkpoint(path) is None
+
+
+# -- agent-side ack hook ------------------------------------------------------
+
+def test_maybe_ack_plan_checkpoints_and_stamps(fake_client, status,
+                                               monkeypatch):
+    monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", "/jit-cache")
+    mk_node(fake_client)
+    status.write("workload", {"passed": False, "failed_local_chips": [2]})
+    plan = publish_plan(fake_client)
+
+    assert drain.maybe_ack_plan(fake_client, NODE, status, step=33,
+                                now=lambda: 7.0) is True
+    ack = drain.read_drain_ack(status)
+    assert ack["plan"] == plan.fingerprint
+    assert ack["step"] == 33
+    ckpt = drain.load_checkpoint(drain.checkpoint_path(status.directory))
+    assert ckpt["step"] == 33
+    assert ckpt["compile_cache"] == "/jit-cache"
+    # idempotent: the same plan is never re-acked
+    assert drain.maybe_ack_plan(fake_client, NODE, status, step=99) is False
+    assert drain.read_drain_ack(status)["step"] == 33
+
+
+def test_maybe_ack_plan_without_step_uses_prior_checkpoint(fake_client,
+                                                           status):
+    mk_node(fake_client)
+    status.write("workload", {"passed": False})
+    drain.save_checkpoint(drain.checkpoint_path(status.directory), 21)
+    publish_plan(fake_client)
+    assert drain.maybe_ack_plan(fake_client, NODE, status) is True
+    assert drain.read_drain_ack(status)["step"] == 21
+
+
+def test_maybe_ack_plan_retires_stale_stamp(fake_client, status):
+    """Plan annotation gone (episode over): the stale barrier stamp is
+    dropped so feature discovery clears the node's ack annotation."""
+    mk_node(fake_client)
+    status.write("workload", {"passed": True, "n_devices": 8})
+    drain.write_drain_ack(status, "old-plan")
+    assert drain.maybe_ack_plan(fake_client, NODE, status) is False
+    assert drain.read_drain_ack(status) is None
+    assert status.read("workload")["passed"] is True  # verdict kept
+
+
+def test_maybe_ack_plan_survives_client_failure(status):
+    class DeadClient:
+        def get(self, *a, **k):
+            raise ConnectionError("apiserver down")
+
+    assert drain.maybe_ack_plan(DeadClient(), NODE, status) is False
+
+
+# -- incremental re-tile ------------------------------------------------------
+
+def test_retile_incremental_keeps_unaffected_groups_verbatim():
+    previous = [{"topology": "2x2", "chips": [0, 1, 4, 5]},
+                {"topology": "2x2", "chips": [2, 3, 6, 7]}]
+    groups, dropped = topology.retile_incremental(
+        "tpu-v5-lite-podslice", 8, [2], previous)
+    # the untouched group keeps its exact chip ids (tenants stay valid)...
+    assert previous[0] in groups
+    # ...and the hit group could not be re-placed on the 1 free cell
+    assert dropped == [previous[1]]
+    assert groups == [previous[0]]
+
+
+def test_retile_incremental_replaces_hit_group_when_space_exists():
+    previous = [{"topology": "1x2", "chips": [0, 1]},
+                {"topology": "1x2", "chips": [2, 3]}]
+    groups, dropped = topology.retile_incremental(
+        "tpu-v5-lite-podslice", 8, [2], previous)
+    assert dropped == []
+    assert previous[0] in groups
+    moved = [g for g in groups if g != previous[0]]
+    assert len(moved) == 1
+    assert 2 not in moved[0]["chips"]
+    assert len(moved[0]["chips"]) == 2
+
+
+def test_retile_incremental_rejects_malformed_previous():
+    with pytest.raises(topology.TopologyError):
+        topology.retile_incremental("tpu-v5-lite-podslice", 8, [0],
+                                    [{"chips": "zero-and-one"}])
+    with pytest.raises(topology.TopologyError):
+        topology.retile_incremental("tpu-v5-lite-podslice", 8, [99],
+                                    [{"topology": "1x2", "chips": [0, 1]}])
+
+
+# -- simulated training job (the soak's workload) -----------------------------
+
+def test_trainjob_acks_checkpoint_and_resumes(fake_client, status):
+    mk_node(fake_client)
+    job = SimulatedTrainingJob(fake_client, NODE, status)
+    status.write("workload", {"passed": True, "n_devices": 8})
+    for _ in range(5):
+        job.tick()
+    assert job.step == 5
+    assert not job.acked_plans  # no plan, no ack
+
+    plan = publish_plan(fake_client)
+    job.tick()  # sees the plan: checkpoint + ack at step 6
+    assert job.acked_plans == [plan.fingerprint]
+    assert drain.read_drain_ack(status)["step"] == 6
+    rng_at_ack = drain.load_checkpoint(
+        drain.checkpoint_path(status.directory))["rng_state"]
+
+    job.tick()  # steps inside the drain window, after the checkpoint
+    job.crash()
+    assert job.resume() == 6  # exactly the acked step: loss bounded to the
+    assert job.rng_state == rng_at_ack  # window, RNG stream back in sync
+
+
+def test_trainjob_resume_without_checkpoint_restarts_from_scratch(
+        fake_client, status):
+    mk_node(fake_client)
+    job = SimulatedTrainingJob(fake_client, NODE, status)
+    job.tick()
+    job.crash()
+    assert job.resume() is None  # the PR 5 behavior the protocol avoids
+    assert job.step == 0

@@ -78,6 +78,9 @@ def setup(fake_client, verdict="failed"):
 def sweep(fake_client, clock, **spec):
     """One reconcile-driven sweep with a BRAND NEW machine: resumability
     from cluster state alone is exercised on every step."""
+    # these tests exercise the uncoordinated machine; the drain gate has
+    # its own suite (test_drain_gate_* below)
+    spec.setdefault("drainDeadlineS", 0)
     sm = HealthStateMachine(fake_client, NS,
                             HealthSpec.from_dict(spec), now=clock)
     counts = sm.process(fake_client.list("v1", "Node"))
@@ -441,3 +444,188 @@ def test_clear_all_removes_machine_state(fake_client, clock):
     anns = node["metadata"].get("annotations", {})
     assert consts.HEALTH_ATTEMPTS_ANNOTATION not in anns
     assert consts.HEALTH_STATE_SINCE_ANNOTATION not in anns
+
+
+# -- coordinated drain gate (quarantined -> remediating edge) -----------------
+#
+# These sweeps pass drainDeadlineS explicitly (the shipped default is 120)
+# and drive the machine exactly like the suites above: a BRAND NEW machine
+# per sweep, so every step doubles as an operator-restart resume test.
+
+from tpu_operator.health import drain  # noqa: E402
+
+
+def drain_sweep(fake_client, clock, deadline=120):
+    return sweep(fake_client, clock, drainDeadlineS=deadline)
+
+
+def to_quarantined(fake_client, clock, deadline=120):
+    drain_sweep(fake_client, clock, deadline)   # healthy -> degraded
+    clock.t += 30
+    drain_sweep(fake_client, clock, deadline)   # degraded -> quarantined
+    clock.t += 30
+    assert node_health_state(get_node(fake_client)) == QUARANTINED
+
+
+def ack_plan(fake_client, step=7):
+    plan = drain.node_plan(get_node(fake_client))
+    assert plan is not None
+    fake_client.patch("v1", "Node", "tpu-0", {"metadata": {"annotations": {
+        consts.DRAIN_ACK_ANNOTATION:
+            '{"plan": "%s", "step": %d}' % (plan.fingerprint, step)}}})
+    return plan
+
+
+def test_drain_gate_publishes_plan_and_holds_quarantine(fake_client, clock):
+    setup(fake_client, verdict="failed:2")
+    fake_client.patch("v1", "Node", "tpu-0", {"metadata": {"labels": {
+        consts.TPU_SLICE_CONFIG_LABEL: "split-2x2"}}})
+    to_quarantined(fake_client, clock)
+
+    sm, counts = drain_sweep(fake_client, clock)
+    node = get_node(fake_client)
+    # the gate held: still quarantined, NO remediation fired, plan published
+    assert node_health_state(node) == QUARANTINED
+    assert counts.quarantined == 1
+    assert sm.attempts_fired == 0
+    assert sm.plans_pending == 1
+    plan = drain.node_plan(node)
+    assert plan is not None
+    assert plan.reason == drain.REASON_RETILE
+    assert plan.blocked == [2]
+    assert plan.deadline == clock.t + 120
+    # the fingerprint is the rendezvous-free identity both sides compute
+    assert plan.fingerprint == drain.plan_fingerprint("split-2x2", [2])
+    assert len(events_with_reason(fake_client, "RetilePlanned")) == 1
+
+
+def test_drain_gate_publishes_once_across_operator_restarts(fake_client, clock):
+    """The kill-mid-drain invariant: every subsequent sweep is a FRESH
+    machine (sweep() constructs one), and none of them re-announce — the
+    Event fires only when the annotation value actually changes."""
+    setup(fake_client, verdict="failed:2")
+    to_quarantined(fake_client, clock)
+    for _ in range(5):
+        sm, _ = drain_sweep(fake_client, clock)
+        assert node_health_state(get_node(fake_client)) == QUARANTINED
+        assert sm.plans_pending == 1
+        clock.t += 10
+    published = events_with_reason(fake_client, "RetilePlanned")
+    assert sum(e.get("count", 1) for e in published) == 1
+
+
+def test_drain_gate_ack_releases_remediation(fake_client, clock):
+    setup(fake_client, verdict="failed:2")
+    to_quarantined(fake_client, clock)
+    drain_sweep(fake_client, clock)  # publishes the plan
+    ack_plan(fake_client)
+
+    sm, _ = drain_sweep(fake_client, clock)
+    node = get_node(fake_client)
+    assert node_health_state(node) == REMEDIATING
+    assert sm.attempts_fired == 1
+    assert sm.plans_pending == 0
+    assert sm.deadline_misses == 0
+
+
+def test_drain_gate_deadline_expiry_forces_with_miss(fake_client, clock):
+    setup(fake_client, verdict="failed:2")
+    to_quarantined(fake_client, clock, deadline=60)
+    drain_sweep(fake_client, clock, deadline=60)  # publish; no ack ever
+
+    clock.t += 59  # window still open: held
+    sm, _ = drain_sweep(fake_client, clock, deadline=60)
+    assert node_health_state(get_node(fake_client)) == QUARANTINED
+    assert sm.deadline_misses == 0
+
+    clock.t += 2  # past the deadline: fail-safe force
+    sm, _ = drain_sweep(fake_client, clock, deadline=60)
+    assert node_health_state(get_node(fake_client)) == REMEDIATING
+    assert sm.deadline_misses == 1
+    assert sm.plans_pending == 0
+    assert events_with_reason(fake_client, "RetileDeadlineExpired")
+
+
+def test_drain_gate_disabled_keeps_immediate_remediation(fake_client, clock):
+    """drainDeadlineS=0 is the PR 5 behavior: quarantined goes straight to
+    remediating, no plan annotation ever appears."""
+    setup(fake_client, verdict="failed:2")
+    to_quarantined(fake_client, clock, deadline=0)
+    sm, _ = drain_sweep(fake_client, clock, deadline=0)
+    node = get_node(fake_client)
+    assert node_health_state(node) == REMEDIATING
+    assert sm.attempts_fired == 1
+    assert drain.node_plan(node) is None
+    assert not events_with_reason(fake_client, "RetilePlanned")
+
+
+def test_drain_gate_supersedes_plan_when_more_chips_fail(fake_client, clock):
+    """More chips failing mid-drain changes the fingerprint: the plan is
+    re-published (new deadline, second Event) instead of force-proceeding
+    against a layout nobody acked."""
+    setup(fake_client, verdict="failed:2")
+    to_quarantined(fake_client, clock)
+    drain_sweep(fake_client, clock)
+    first = drain.node_plan(get_node(fake_client))
+
+    clock.t += 30
+    set_verdict(fake_client, "failed:2,5")
+    sm, _ = drain_sweep(fake_client, clock)
+    node = get_node(fake_client)
+    assert node_health_state(node) == QUARANTINED
+    second = drain.node_plan(node)
+    assert second.fingerprint != first.fingerprint
+    assert second.blocked == [2, 5]
+    assert second.deadline == clock.t + 120
+    assert sum(e.get("count", 1)
+               for e in events_with_reason(fake_client, "RetilePlanned")) == 2
+
+
+def test_drain_gate_recovery_retires_plan_and_ack(fake_client, clock):
+    """Episode end is the ONLY place the plan is cleared (never mid-episode
+    — a partitioner waiting on it would wedge pending forever)."""
+    setup(fake_client, verdict="failed:2")
+    to_quarantined(fake_client, clock)
+    drain_sweep(fake_client, clock)
+    ack_plan(fake_client)
+    drain_sweep(fake_client, clock)  # -> remediating
+    assert node_health_state(get_node(fake_client)) == REMEDIATING
+    # plan + ack survive INTO remediation (the partitioner may still be
+    # waiting to apply against them)
+    anns = get_node(fake_client)["metadata"]["annotations"]
+    assert consts.RETILE_PLAN_ANNOTATION in anns
+
+    set_verdict(fake_client, "passed")
+    drain_sweep(fake_client, clock)  # -> recovered
+    clock.t += 30
+    drain_sweep(fake_client, clock)  # -> healthy
+    anns = get_node(fake_client)["metadata"].get("annotations", {})
+    assert consts.RETILE_PLAN_ANNOTATION not in anns
+    assert consts.DRAIN_ACK_ANNOTATION not in anns
+    assert node_health_state(get_node(fake_client)) == HEALTHY
+
+
+def test_drain_gate_unattributed_failure_plans_remediate(fake_client, clock):
+    """A failure with no chip attribution (no re-tile possible) still
+    announces before the pod recycle — the reason is just 'remediate'."""
+    setup(fake_client, verdict="failed")
+    to_quarantined(fake_client, clock)
+    drain_sweep(fake_client, clock)
+    plan = drain.node_plan(get_node(fake_client))
+    assert plan is not None
+    assert plan.reason == drain.REASON_REMEDIATE
+    assert plan.blocked == []
+
+
+def test_drain_gate_corrupt_plan_annotation_republishes(fake_client, clock):
+    """A corrupt plan annotation parses to None and must never wedge the
+    drain: the gate re-publishes a fresh plan over it."""
+    setup(fake_client, verdict="failed:2")
+    to_quarantined(fake_client, clock)
+    drain_sweep(fake_client, clock)
+    fake_client.patch("v1", "Node", "tpu-0", {"metadata": {"annotations": {
+        consts.RETILE_PLAN_ANNOTATION: "{not json"}}})
+    sm, _ = drain_sweep(fake_client, clock)
+    plan = drain.node_plan(get_node(fake_client))
+    assert plan is not None
+    assert plan.fingerprint == drain.plan_fingerprint(None, [2])

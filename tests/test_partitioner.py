@@ -599,24 +599,53 @@ def test_sync_retiles_around_gated_chip_and_restores(fake_client, config_path,
     assert len(data["groups"]) == 8
 
 
-def test_sync_impossible_retile_defers_not_fails(fake_client, config_path,
-                                                 tmp_path):
-    """When no healthy-only placement exists the node DEFERS (pending):
-    the configured layout is still valid, the chips are merely gated —
-    failing would misreport a health incident as a config error."""
+def test_sync_incremental_retile_keeps_healthy_group(fake_client,
+                                                     config_path, tmp_path):
+    """With an applied handoff, a gated chip triggers the INCREMENTAL
+    re-tile: the untouched 2x2 keeps its exact chip ids (tenants/device
+    advertisements stay valid) and the hit 2x2 — unplaceable on the 3
+    remaining healthy cells — is dropped, not deferred. Deferring would
+    keep advertising the broken group; dropping it is the strictly better
+    degraded outcome (Tenplex-style incremental migration)."""
     handoff = str(tmp_path / "handoff")
     status = str(tmp_path / "status")
     mk_node(fake_client, config="v5e-2x2-pair")
     sync_once(fake_client, "n1", config_path, handoff, status_dir=status)
     applied = read_handoff(handoff)
+    healthy_group = next(g for g in applied["groups"]
+                         if 2 not in g["chips"])
+
+    write_barrier(status, passed=False, failed_chips=[2])
+    assert sync_once(fake_client, "n1", config_path, handoff,
+                     status_dir=status) == "retiled"
+    data = read_handoff(handoff)
+    assert data["blocked"] == [2]
+    assert data["groups"] == [healthy_group], \
+        "healthy group keeps its chip ids; the hit group is dropped"
+
+    write_barrier(status, passed=True)
+    assert sync_once(fake_client, "n1", config_path, handoff,
+                     status_dir=status) == "success"
+    assert len(read_handoff(handoff)["groups"]) == 2
+
+
+def test_sync_impossible_retile_defers_not_fails(fake_client, config_path,
+                                                 tmp_path):
+    """On a FRESH node (no applied handoff to migrate incrementally) an
+    impossible healthy-only placement DEFERS (pending): the configured
+    layout is still valid, the chips are merely gated — failing would
+    misreport a health incident as a config error."""
+    handoff = str(tmp_path / "handoff")
+    status = str(tmp_path / "status")
+    mk_node(fake_client, config="v5e-2x2-pair")
 
     write_barrier(status, passed=False, failed_chips=[2])
     assert sync_once(fake_client, "n1", config_path, handoff,
                      status_dir=status) == "pending"
     labels = fake_client.get("v1", "Node", "n1")["metadata"]["labels"]
     assert labels[consts.TPU_SLICE_STATE_LABEL] == "pending"
-    assert read_handoff(handoff) == applied, \
-        "a deferred re-tile must not clobber the applied handoff"
+    assert read_handoff(handoff) is None, \
+        "a deferred re-tile must not write a handoff"
 
     write_barrier(status, passed=True)
     assert sync_once(fake_client, "n1", config_path, handoff,

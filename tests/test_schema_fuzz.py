@@ -9,6 +9,12 @@ keys the schema knows about. Any drift between schema_gen, schema_validate
 and SpecBase shows up here as a counterexample.
 """
 
+import pytest
+
+# hypothesis is an optional dev dependency; the sealed CI image may not ship
+# it and nothing may be pip-installed there, so skip (not error) when absent.
+pytest.importorskip("hypothesis")
+
 from hypothesis import HealthCheck, given, settings
 from hypothesis import strategies as st
 

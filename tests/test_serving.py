@@ -226,6 +226,47 @@ def test_traffic_retile_drains_and_replaces_within_window():
     assert out["placement_churn"] >= out["preemptions"]
 
 
+def test_traffic_planned_drain_migrates_before_deadline():
+    """Coordinated-drain mode (satellite of the drain-protocol tentpole):
+    the RetilePlanned signal lands at ``at``, the named slice stops taking
+    NEW tenants immediately, running tenants migrate during the window, and
+    the slice only blocks at the deadline — so nobody is caught mid-decode
+    by the block itself."""
+    out = run_scenario(
+        GROUPS, seed=20260805,
+        retile={"at": 60.0, "blocked": [1], "drain_window_s": 10.0,
+                "planned": True},
+        **HEAVY)
+    assert out["unhandled_errors"] == 0
+    assert out["slices"][1]["blocked"] is True
+    rt = out["retile"]
+    assert rt["planned"] is True
+    assert rt["drained_tenants"] > 0
+    # the drain-protocol bench number: everyone migrated inside the window
+    assert rt["drained_within_window"] == rt["drained_tenants"]
+    assert rt["all_drained_within_window"] is True
+    assert 0 < rt["max_replace_s"] <= 10.0
+
+
+def test_traffic_planned_vs_unplanned_drain_clock():
+    """Planned and unplanned runs over the same seed both converge (all
+    tenants re-placed), but only the planned run reports the protocol's
+    drained_within_window summary as its headline semantics."""
+    common = dict(seed=4242, **HEAVY)
+    unplanned = run_scenario(
+        GROUPS, retile={"at": 60.0, "blocked": [1],
+                        "drain_window_s": 10.0}, **common)
+    planned = run_scenario(
+        GROUPS, retile={"at": 60.0, "blocked": [1], "drain_window_s": 10.0,
+                        "planned": True}, **common)
+    assert unplanned["retile"]["planned"] is False
+    assert planned["retile"]["planned"] is True
+    for out in (unplanned, planned):
+        assert out["unhandled_errors"] == 0
+        assert out["arrivals"] == (out["completed"] + out["rejected"]
+                                   + out["incomplete"])
+
+
 def test_traffic_interactive_preempts_batch():
     """One slice, a whale batch tenant in the way: the interactive arrival
     must preempt it rather than queue past its SLO."""

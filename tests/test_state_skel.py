@@ -294,4 +294,9 @@ def test_returning_webhook_reannounces_suspension(fake_client):
     fight_until_damped()  # the webhook returns
     suspended = [e for e in fake_client.list("v1", "Event", "tpu-operator")
                  if e.get("reason") == "DriftHealSuspended"]
-    assert len(suspended) == 2, "each distinct fight announces itself once"
+    # event aggregation (client-go style) folds the identical re-announcement
+    # into the same Event object and bumps count — so the second fight shows
+    # up as count == 2 on one object, not a second object
+    assert sum(e.get("count", 1) for e in suspended) == 2, \
+        "each distinct fight announces itself once"
+    assert len(suspended) == 1, "identical announcements aggregate"

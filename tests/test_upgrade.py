@@ -952,6 +952,54 @@ def test_operand_components_set_matches_manifests():
     assert found == set(m.OPERAND_COMPONENTS)
 
 
+def test_drain_exempt_covers_every_rendered_operand_pod(monkeypatch):
+    """The unified drain-exemption predicate (consts.drain_exempt, shared
+    by the upgrade drain and the health force-drain) must cover a pod built
+    from EVERY rendered operand DaemonSet template — with the ownerRef
+    present (the normal DS-pod path) AND without it (an orphaned operand
+    pod still matches on namespace+component), so a new operand whose
+    component is missing from OPERAND_COMPONENTS fails here, not in
+    production by evicting our own pods."""
+    from tpu_operator.api.clusterpolicy import ClusterPolicy
+    from tpu_operator.state.operands import cluster_policy_states
+
+    for env in ("DRIVER_IMAGE", "VALIDATOR_IMAGE", "FEATURE_DISCOVERY_IMAGE",
+                "TELEMETRY_EXPORTER_IMAGE", "SLICE_PARTITIONER_IMAGE",
+                "DEVICE_PLUGIN_IMAGE"):
+        monkeypatch.setenv(env, "gcr.io/tpu/x:0.1.0")
+    policy = ClusterPolicy.from_obj(new_cluster_policy(spec={
+        "slicePartitioner": {"enabled": True},
+        "serving": {"enabled": True}}))
+    daemonsets = []
+    for state in cluster_policy_states(client=None):
+        if not hasattr(state, "render_data"):
+            continue
+        if state.name == "pre-requisites":
+            continue
+        for obj in state.render_objects(policy, NS):
+            if obj.get("kind") == "DaemonSet":
+                daemonsets.append(obj)
+    assert len(daemonsets) >= len(m.OPERAND_COMPONENTS) - 1  # driver et al.
+    for ds in daemonsets:
+        template = ds["spec"]["template"]
+        pod = {"metadata": {
+            "name": f"{ds['metadata']['name']}-abc12",
+            "namespace": NS,
+            "labels": dict(template["metadata"].get("labels") or {}),
+            "ownerReferences": [{"kind": "DaemonSet", "controller": True,
+                                 "name": ds["metadata"]["name"]}]}}
+        assert consts.drain_exempt(pod, NS), \
+            f"DS-owned operand pod from {ds['metadata']['name']} not exempt"
+        pod["metadata"].pop("ownerReferences")
+        assert consts.drain_exempt(pod, NS), \
+            f"orphaned operand pod from {ds['metadata']['name']} not exempt"
+    # and the predicate is not a rubber stamp: a plain user pod is fair game
+    assert not consts.drain_exempt(
+        {"metadata": {"name": "train-0", "namespace": "default",
+                      "labels": {"app.kubernetes.io/component": "trainer"}}},
+        NS)
+
+
 # -- whole-template outdated detection (VERDICT r4 weak-#1) -------------------
 
 def test_env_only_template_change_triggers_upgrade(fake_client):

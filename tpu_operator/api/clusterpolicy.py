@@ -307,6 +307,16 @@ class HealthSpec(SpecBase):
                "trip sticky quarantine (cleared by template change or "
                "manual label clear).",
         minimum=2, maximum=100)
+    drain_deadline_s: int = spec_field(
+        120, doc="Coordinated drain window for planned re-tiles: before "
+                 "re-tiling or recycling a workload's pods the operator "
+                 "publishes a tpu.ai/planned-retile annotation + "
+                 "RetilePlanned Event and waits up to this many seconds "
+                 "for the workload's drain-ack (checkpoint + barrier "
+                 "stamp). On expiry the re-tile proceeds anyway (fail-"
+                 "safe) and the miss is counted. 0 disables coordination "
+                 "(immediate re-tile, PR 5 behavior).",
+        minimum=0, maximum=86400)
     extra: Dict[str, Any] = spec_field(dict)
 
 

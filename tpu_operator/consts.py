@@ -96,6 +96,23 @@ HEALTH_FAILED_TEMPLATE_ANNOTATION = "tpu.ai/health-failed-template"
 #: "passed" | "failed" | "failed:<chip,chip>" | "corrupt"
 WORKLOAD_HEALTH_ANNOTATION = "tpu.ai/workload-health"
 
+# -- coordinated drain/handoff (planned re-tiles) ------------------------------
+#: a published re-tile/remediation plan (JSON: layout fingerprint, drain
+#: deadline, reason, blocked chips). The operator announces the plan here
+#: BEFORE mutating the handoff or recycling pods; workloads get
+#: spec.health.drainDeadlineS seconds to checkpoint and ack. Lives on the
+#: node so an operator restarted mid-drain resumes (and does not
+#: re-announce) from cluster state alone.
+RETILE_PLAN_ANNOTATION = "tpu.ai/planned-retile"
+#: the node's drain-ack, published by feature discovery from the workload
+#: barrier file (JSON: acked plan fingerprint + checkpointed step). The
+#: ack's source of truth is the barrier stamp — node-local, so the
+#: partitioner never races the apiserver for it.
+DRAIN_ACK_ANNOTATION = "tpu.ai/drain-ack"
+#: host-path file (under the validation status dir) workloads checkpoint
+#: step/RNG/compile-cache state into before acking a drain
+DRAIN_CHECKPOINT_FILE = "drain-checkpoint.json"
+
 # -- serving SLO validation ----------------------------------------------------
 #: the node's serving-barrier verdict, published by feature discovery from
 #: the serving barrier file: "passed" | "failed" | "corrupt" (label values
@@ -138,3 +155,37 @@ OPERANDS = (
 
 def deploy_label(operand: str) -> str:
     return DEPLOY_LABEL_PREFIX + operand
+
+
+#: every app.kubernetes.io/component value the operator's own operand
+#: DaemonSets stamp on their pods (manifests/*/0500_daemonset.yaml). The
+#: upgrade drain and the health force-drain both exempt ONLY these (in the
+#: operator namespace) plus DaemonSet-owned and mirror pods — label
+#: *presence* is not ownership: app.kubernetes.io/component is a standard
+#: recommended label and a user TPU workload labeled component=web must
+#: still be drained (reference drain_manager.go:76-82 skips only DaemonSet
+#: + mirror pods). tests/test_upgrade.py pins this set against the manifest
+#: templates AND against the rendered operand DaemonSets.
+OPERAND_COMPONENTS = frozenset({
+    "tpu-driver", "tpu-device-plugin", "tpu-operator-validator",
+    "tpu-telemetry", "tpu-feature-discovery", "tpu-slice-partitioner",
+    "tpu-node-status-exporter", "tpu-serving-validator",
+})
+
+
+def drain_exempt(pod: dict, namespace: str) -> bool:
+    """THE shared drain-exemption predicate: pods no eviction sweep
+    (driver-upgrade drain, health force-drain) may ever target —
+    DaemonSet-owned and static (mirror) pods (kubectl drain semantics, the
+    reference's IgnoreAllDaemonSets:true) plus the operator's own operand
+    pods identified by namespace AND a component value from
+    OPERAND_COMPONENTS. One predicate so the two sweeps cannot drift
+    (PR 6 had to hand-add tpu-serving-validator to a second copy)."""
+    meta = pod.get("metadata") or {}
+    for ref in meta.get("ownerReferences") or []:
+        if ref.get("kind") == "DaemonSet" and ref.get("controller"):
+            return True
+    if (meta.get("annotations") or {}).get("kubernetes.io/config.mirror"):
+        return True
+    component = (meta.get("labels") or {}).get("app.kubernetes.io/component")
+    return meta.get("namespace") == namespace and component in OPERAND_COMPONENTS

@@ -276,6 +276,9 @@ class ClusterPolicyReconciler(Reconciler):
             self.metrics.node_health_state.labels(state=state).set(value)
         if machine.attempts_fired:
             self.metrics.remediation_attempts.inc(machine.attempts_fired)
+        if machine.deadline_misses:
+            self.metrics.drain_deadline_missed.inc(machine.deadline_misses)
+        self.metrics.drains_in_progress.set(machine.plans_pending)
 
         unhealthy = {s: v for s, v in counts.as_dict().items()
                      if s not in ("healthy", "recovered") and v}

@@ -73,6 +73,15 @@ class OperatorMetrics:
             "tpu_operator_partition_retile_total",
             "Node transitions into a health-aware re-tiled slice layout "
             "(tpu.ai/slice.config.state=retiled)", registry=self.registry)
+        self.drain_deadline_missed = Counter(
+            "tpu_operator_drain_deadline_missed_total",
+            "Planned re-tile drain deadlines that expired without a "
+            "workload ack (force path taken)", registry=self.registry)
+        self.drains_in_progress = Gauge(
+            "tpu_operator_drains_in_progress",
+            "Nodes currently inside an open drain window (tpu.ai/"
+            "planned-retile published, no matching drain-ack yet)",
+            registry=self.registry)
         # serving-SLO rollup: per-node verdicts land on nodes as the
         # tpu.ai/serving-slo label (+ measured numbers in the detail
         # annotation); the reconcile sweep republishes them here so one

@@ -1,5 +1,16 @@
 """Continuous chip-health remediation (per-node degraded-state machine)."""
 
+from .drain import (  # noqa: F401
+    RetilePlan,
+    load_checkpoint,
+    maybe_ack_plan,
+    node_acked_plan,
+    node_plan,
+    plan_fingerprint,
+    read_drain_ack,
+    save_checkpoint,
+    write_drain_ack,
+)
 from .machine import (  # noqa: F401
     DEGRADED,
     FAILED,

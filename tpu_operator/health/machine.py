@@ -42,6 +42,7 @@ from .. import consts, events
 from ..client.errors import ApiError, NotFoundError
 from ..client.interface import Client
 from ..utils import deep_get
+from . import drain
 
 log = logging.getLogger(__name__)
 
@@ -121,6 +122,13 @@ class HealthStateMachine:
         #: remediation actions fired THIS sweep — the reconciler adds this
         #: to the tpu_operator_remediation_attempts_total counter
         self.attempts_fired = 0
+        #: drain deadlines that expired without a workload ack THIS sweep
+        #: (force path taken) — feeds
+        #: tpu_operator_drain_deadline_missed_total
+        self.deadline_misses = 0
+        #: nodes currently inside an open drain window (plan published,
+        #: no ack yet) — feeds the tpu_operator_drains_in_progress gauge
+        self.plans_pending = 0
 
     # -- cluster inspection ---------------------------------------------------
     def _pods_on(self, node_name: str, component: str) -> List[dict]:
@@ -180,6 +188,8 @@ class HealthStateMachine:
             ann_patch[consts.HEALTH_ATTEMPTS_ANNOTATION] = None
             ann_patch[consts.HEALTH_FAILED_TEMPLATE_ANNOTATION] = None
             ann_patch[consts.HEALTH_FLAP_STICKY_ANNOTATION] = None
+            ann_patch[consts.RETILE_PLAN_ANNOTATION] = None
+            ann_patch[consts.DRAIN_ACK_ANNOTATION] = None
         ann_patch.update(extra_annotations or {})
         self.client.patch("v1", "Node", name, {"metadata": {
             "labels": {consts.HEALTH_STATE_LABEL: state or None},
@@ -271,6 +281,56 @@ class HealthStateMachine:
         for pod in self._pods_on(name, VALIDATOR_COMPONENT):
             self._delete_pod(pod)
 
+    # -- coordinated drain (planned re-tiles) ---------------------------------
+    def _drain_gate(self, node: dict) -> bool:
+        """Coordination gate on the quarantined->remediating edge: returns
+        True when remediation/re-tiling may proceed — no drain window
+        configured, the workload acked the published plan, or the deadline
+        expired (fail-safe force; counted as a miss). Returns False while
+        the window is open: the plan is published (annotation + ONE
+        RetilePlanned Event) and the node simply stays quarantined until
+        the next sweep. Everything the gate consults lives on the node, so
+        an operator restarted mid-drain resumes without re-announcing."""
+        deadline_s = getattr(self.policy, "drain_deadline_s", 0) or 0
+        if deadline_s <= 0:
+            return True
+        name = node["metadata"]["name"]
+        labels = deep_get(node, "metadata", "labels", default={}) or {}
+        partition = labels.get(consts.TPU_SLICE_CONFIG_LABEL)
+        blocked = failed_chips_from_annotation(node) or []
+        fingerprint = drain.plan_fingerprint(partition, blocked)
+        plan = drain.node_plan(node)
+        if plan is None or plan.fingerprint != fingerprint:
+            # publish (or supersede — more chips failed mid-drain). The
+            # Event fires ONLY here, where the annotation value actually
+            # changes: a restarted operator finds the matching annotation
+            # below and never double-announces.
+            reason = (drain.REASON_RETILE if partition and blocked
+                      else drain.REASON_REMEDIATE)
+            new_plan = drain.RetilePlan(
+                fingerprint=fingerprint,
+                deadline=self._now() + deadline_s,
+                reason=reason, blocked=blocked)
+            self._annotate(node, consts.RETILE_PLAN_ANNOTATION,
+                           new_plan.to_json())
+            self._event(node, events.NORMAL, "RetilePlanned",
+                        f"{name}: planned {reason} (layout {fingerprint}"
+                        + (f", chips {blocked} gated" if blocked else "")
+                        + f"); workloads have {deadline_s}s to checkpoint "
+                          f"and ack before the forced drain")
+            self.plans_pending += 1
+            return False
+        if drain.node_acked_plan(node) == fingerprint:
+            return True
+        if plan.expired(self._now()):
+            self.deadline_misses += 1
+            self._event(node, events.WARNING, "RetileDeadlineExpired",
+                        f"{name}: drain deadline passed without a workload "
+                        f"ack for plan {fingerprint}; force-proceeding")
+            return True
+        self.plans_pending += 1
+        return False
+
     # -- the sweep ------------------------------------------------------------
     def process(self, nodes: List[dict]) -> HealthCounts:
         counts = HealthCounts()
@@ -302,7 +362,8 @@ class HealthStateMachine:
                                      consts.HEALTH_ATTEMPTS_ANNOTATION,
                                      consts.HEALTH_FLAP_STICKY_ANNOTATION,
                                      consts.HEALTH_FAILED_TEMPLATE_ANNOTATION,
-                                     consts.HEALTH_FLAP_HISTORY_ANNOTATION)
+                                     consts.HEALTH_FLAP_HISTORY_ANNOTATION,
+                                     consts.RETILE_PLAN_ANNOTATION)
                          if k in anns]
             if leftovers and (consts.HEALTH_FLAP_STICKY_ANNOTATION in anns
                               or consts.HEALTH_FAILED_TEMPLATE_ANNOTATION in anns):
@@ -389,6 +450,11 @@ class HealthStateMachine:
         if state == QUARANTINED:
             if verdict is True:
                 return self._recover(node)
+            if not self._drain_gate(node):
+                # drain window open: workloads are checkpointing; the
+                # partitioner holds the layout and we hold the pods until
+                # ack or deadline (re-checked every sweep, never wedged)
+                return QUARANTINED
             self._set_state(node, REMEDIATING, extra_annotations={
                 consts.HEALTH_ATTEMPTS_ANNOTATION: "1"})
             self._remediate(node, 1)
@@ -466,7 +532,12 @@ class HealthStateMachine:
         if self.policy.cordon_on_quarantine:
             self._cordon(node, False)
         self._set_state(node, RECOVERED, extra_annotations={
-            consts.HEALTH_ATTEMPTS_ANNOTATION: None})
+            consts.HEALTH_ATTEMPTS_ANNOTATION: None,
+            # episode over: retire the drain-protocol artifacts (the plan
+            # is never cleared MID-episode — a partitioner still waiting
+            # on it would otherwise wedge pending forever)
+            consts.RETILE_PLAN_ANNOTATION: None,
+            consts.DRAIN_ACK_ANNOTATION: None})
         self._event(node, events.NORMAL, "NodeHealthRecovered",
                     f"{name}: workload barrier passing again; restoring "
                     f"configured layout")
@@ -483,7 +554,9 @@ class HealthStateMachine:
                 consts.HEALTH_ATTEMPTS_ANNOTATION,
                 consts.HEALTH_FLAP_HISTORY_ANNOTATION,
                 consts.HEALTH_FLAP_STICKY_ANNOTATION,
-                consts.HEALTH_FAILED_TEMPLATE_ANNOTATION))
+                consts.HEALTH_FAILED_TEMPLATE_ANNOTATION,
+                consts.RETILE_PLAN_ANNOTATION,
+                consts.DRAIN_ACK_ANNOTATION))
             if node_health_state(node) == HEALTHY and not has_ann:
                 continue
             if self.policy.cordon_on_quarantine:

@@ -27,6 +27,7 @@ from typing import Dict, List, Optional
 import yaml
 
 from .. import consts
+from ..health import drain as drainproto
 from ..utils import deep_get, pod_requests_resource
 from ..validator.driver import discover_devices
 from . import topology
@@ -163,11 +164,26 @@ def health_gated_chips(status_dir: Optional[str],
 def sync_once(client, node_name: str, config_path: str,
               handoff_dir: str = DEFAULT_HANDOFF_DIR,
               total_chips: Optional[int] = None,
-              status_dir: Optional[str] = None) -> Optional[str]:
-    """One reconcile pass; returns the state written (None = nothing to do)."""
+              status_dir: Optional[str] = None,
+              drain_deadline_s: Optional[int] = None) -> Optional[str]:
+    """One reconcile pass; returns the state written (None = nothing to do).
+
+    ``drain_deadline_s`` > 0 enables the coordinated drain protocol for
+    health-gated re-tiles: the layout write waits for the workload's
+    barrier drain-ack (matching the plan fingerprint both sides compute
+    from the desired partition + gated chips) or for the published plan's
+    deadline to expire — fail-safe force, never wedged. 0 (the default,
+    also via the TPU_DRAIN_DEADLINE_S env the operand DS stamps) keeps the
+    immediate-re-tile behavior."""
     if status_dir is None:
         status_dir = os.environ.get("STATUS_DIR",
                                     consts.VALIDATION_STATUS_DIR)
+    if drain_deadline_s is None:
+        try:
+            drain_deadline_s = int(
+                os.environ.get("TPU_DRAIN_DEADLINE_S", "0") or 0)
+        except ValueError:
+            drain_deadline_s = 0
     node = client.get("v1", "Node", node_name)
     labels = deep_get(node, "metadata", "labels", default={}) or {}
     desired = labels.get(consts.TPU_SLICE_CONFIG_LABEL)
@@ -223,22 +239,53 @@ def sync_once(client, node_name: str, config_path: str,
         blocked = sorted(health_gated_chips(status_dir, total_chips))
         target_state = STATE_SUCCESS
         if blocked:
-            try:
-                groups = compute_partition(table[desired], total_chips,
-                                           accelerator,
-                                           blocked=frozenset(blocked))
-                target_state = STATE_RETILED
-            except PartitionError as e:
-                # the re-tile is impossible (not enough healthy chips /
-                # no adjacent placement): DEFER, don't fail — the
-                # configured layout itself is still valid, the chips are
-                # merely gated; remediation or recovery resolves it
-                if state != STATE_PENDING:
-                    set_state(STATE_PENDING)
-                log.warning("partition %s on %s: re-tile around gated "
-                            "chip(s) %s impossible (%s); deferred until "
-                            "recovery", desired, node_name, blocked, e)
-                return STATE_PENDING
+            target_state = STATE_RETILED
+            groups = None
+            prev_blocked = set(current.get("blocked", [])) if current else None
+            if (current and current.get("partition") == desired
+                    and current.get("groups")
+                    and prev_blocked is not None
+                    and set(blocked) >= prev_blocked):
+                # Tenplex-style incremental migration: when chips DEgrade
+                # on an already-applied layout, keep every group that lost
+                # no chip exactly as it was (same chip ids — the device
+                # plugin's advertisements and any tenants on it stay
+                # valid) and re-place only the hit groups. On shrink
+                # (partial recovery) fall through to the full tiler so
+                # freed chips return to the configured layout.
+                try:
+                    groups, dropped = topology.retile_incremental(
+                        accelerator, total_chips, blocked,
+                        current["groups"])
+                    if not groups:
+                        groups = None  # total loss: let the full tiler
+                        # (whose count:"all" entries scale down) try
+                    elif dropped:
+                        log.warning(
+                            "partition %s on %s: %d group(s) lost to "
+                            "gated chip(s) %s (no healthy placement); "
+                            "%d kept", desired, node_name, len(dropped),
+                            blocked, len(groups))
+                except topology.TopologyError as e:
+                    log.warning("partition %s on %s: previous handoff "
+                                "unusable for incremental re-tile (%s); "
+                                "recomputing", desired, node_name, e)
+            if groups is None:
+                try:
+                    groups = compute_partition(table[desired], total_chips,
+                                               accelerator,
+                                               blocked=frozenset(blocked))
+                except PartitionError as e:
+                    # the re-tile is impossible (not enough healthy chips /
+                    # no adjacent placement): DEFER, don't fail — the
+                    # configured layout itself is still valid, the chips are
+                    # merely gated; remediation or recovery resolves it
+                    if state != STATE_PENDING:
+                        set_state(STATE_PENDING)
+                    log.warning("partition %s on %s: re-tile around gated "
+                                "chip(s) %s impossible (%s); deferred until "
+                                "recovery", desired, node_name, blocked, e)
+                    return STATE_PENDING
         else:
             groups = compute_partition(table[desired], total_chips,
                                        accelerator)
@@ -259,6 +306,40 @@ def sync_once(client, node_name: str, config_path: str,
             if state != target_state:
                 set_state(target_state)
             return target_state
+        if blocked and drain_deadline_s > 0:
+            # coordinated drain: a health-gated layout change waits for the
+            # workload's ack (barrier stamp carrying the plan fingerprint
+            # BOTH sides compute from desired+blocked, no rendezvous
+            # needed) or for the published plan's deadline. Checked AFTER
+            # content identity so an already-applied re-tile stays stable
+            # once its plan is consumed/cleared.
+            from ..validator.status import StatusFiles
+            expected_fp = drainproto.plan_fingerprint(desired, blocked)
+            ack = drainproto.read_drain_ack(StatusFiles(status_dir))
+            if not (ack and ack.get("plan") == expected_fp):
+                plan = drainproto.node_plan(node)
+                if plan is None or not plan.expired():
+                    # no plan yet (health machine still confirming) or
+                    # drain window still open: defer, retried each pass
+                    if state != STATE_PENDING:
+                        set_state(STATE_PENDING)
+                    log.info(
+                        "partition %s on %s: re-tile around %s planned; "
+                        "waiting for workload drain-ack%s", desired,
+                        node_name, blocked,
+                        "" if plan is None else
+                        f" until deadline ({plan.deadline - time.time():.0f}s"
+                        " left)")
+                    return STATE_PENDING
+                # deadline expired with no (matching) ack: force — the
+                # protocol is fail-safe, a wedged workload cannot hold the
+                # layout hostage. The miss is counted operator-side.
+                log.warning(
+                    "partition %s on %s: drain deadline expired without "
+                    "ack%s; force re-tiling around %s", desired, node_name,
+                    "" if plan.fingerprint == expected_fp else
+                    f" (published plan {plan.fingerprint} != expected "
+                    f"{expected_fp})", blocked)
         busy = _consumers_or_none(client, node_name)
         if busy != 0:
             # changing the layout re-IDs every schedulable unit; never

@@ -251,3 +251,73 @@ def tile_partition(accelerator: str, total_chips: int,
         "topology": format_topology(shape),
         "chips": sorted(_chip_id(c, grid) for c in cells),
     } for shape, cells in zip(shapes, placed)]
+
+
+def retile_incremental(accelerator: str, total_chips: int,
+                       blocked: Sequence[int],
+                       previous_groups: List[dict]
+                       ) -> Tuple[List[dict], List[dict]]:
+    """Tenplex-style incremental re-tile (arXiv 2312.05181): instead of
+    recomputing the whole layout from scratch — which reassigns chip ids
+    for EVERY slice and forces every tenant to migrate — keep each previous
+    group that contains no newly-blocked chip exactly as it was (same chip
+    ids, same topology string, so device-plugin advertisements and tenant
+    placements on it stay valid) and re-place only the affected groups on
+    the remaining healthy cells.
+
+    Returns ``(groups, dropped)``: the surviving layout in the original
+    group order (re-placed groups keep their position) and the affected
+    groups that could not be re-placed anywhere (capacity genuinely lost
+    to the blocked chips). Never raises for placement failure — losing a
+    slice is the correct degraded outcome; the full tiler's all-or-nothing
+    TopologyError would instead wedge the whole handoff.
+
+    Raises TopologyError only for the same input errors as
+    :func:`tile_partition` (unknown generation, bad chip ids, malformed
+    previous groups) — callers fall back to the full tiler on those.
+    """
+    grid = host_grid(accelerator, total_chips)
+    blocked_set = set()
+    for chip in blocked or []:
+        if not 0 <= int(chip) < total_chips:
+            raise TopologyError(
+                f"blocked chip {chip} outside this host's 0..{total_chips - 1}")
+        blocked_set.add(int(chip))
+    occupied = {_chip_coord(c, grid) for c in blocked_set}
+    kept: List[Tuple[int, dict]] = []
+    affected: List[Tuple[int, Tuple[int, ...]]] = []
+    for idx, group in enumerate(previous_groups or []):
+        if not isinstance(group, dict) or "chips" not in group:
+            raise TopologyError(f"malformed previous group {group!r}")
+        try:
+            chips = [int(c) for c in group["chips"]]
+        except (TypeError, ValueError) as e:
+            raise TopologyError(
+                f"malformed previous group chips {group.get('chips')!r}: "
+                f"{e}") from e
+        if any(not 0 <= c < total_chips for c in chips):
+            raise TopologyError(f"previous group chips {chips} outside host")
+        shape = parse_topology(group.get("topology", "1"))
+        shape = shape + (1,) * (len(grid) - len(shape))
+        if blocked_set & set(chips):
+            affected.append((idx, shape))
+        else:
+            kept.append((idx, group))
+            # healthy groups keep their cells; nothing may re-place onto them
+            occupied.update(_chip_coord(c, grid) for c in chips)
+    replaced: Dict[int, dict] = {}
+    dropped: List[dict] = []
+    for idx, shape in affected:
+        cells = next(_anchors(shape, grid, occupied), None)
+        if cells is None:
+            dropped.append(previous_groups[idx])
+            continue
+        occupied.update(cells)
+        replaced[idx] = {
+            "topology": format_topology(shape),
+            "chips": sorted(_chip_id(c, grid) for c in cells),
+        }
+    survivors = dict(kept)
+    survivors.update(replaced)
+    out = [survivors[idx] for idx in sorted(survivors)]
+    return out, dropped

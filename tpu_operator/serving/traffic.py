@@ -56,13 +56,16 @@ class _Request:
 
 
 class _Slice:
-    __slots__ = ("sid", "capacity", "free", "blocked")
+    __slots__ = ("sid", "capacity", "free", "blocked", "pending_block")
 
     def __init__(self, sid: int, capacity: int):
         self.sid = sid
         self.capacity = capacity
         self.free = capacity
         self.blocked = False
+        #: a RetilePlanned signal named this slice: still serving, but no
+        #: NEW placements land here — tenants migrate out during the window
+        self.pending_block = False
 
 
 def _gen_requests(rng: random.Random, duration_s: float,
@@ -106,6 +109,13 @@ def run_scenario(groups: Sequence[dict],
     "drain_window_s": <float>}`` — at that moment the named slices go
     unhealthy, tenants running there drain and re-place.
 
+    ``retile["planned"] = True`` models the coordinated drain protocol:
+    the ``RetilePlanned`` signal fires at ``at`` — the named slices stop
+    accepting NEW tenants and the ones running there migrate proactively —
+    and the slices only actually block at ``at + drain_window_s`` (the
+    deadline). The summary then reports ``drained_within_window``: tenants
+    that finished migrating before the deadline.
+
     Returns a plain dict (bench-JSON-ready); ``unhandled_errors`` counts
     event-loop exceptions and must be 0 in any healthy run.
     """
@@ -122,14 +132,24 @@ def run_scenario(groups: Sequence[dict],
     def rate(req: _Request) -> float:
         return req.chips * 1000.0 / per_token_ms
 
-    ARRIVE, COMPLETE, RETILE = 0, 1, 2
+    ARRIVE, COMPLETE, RETILE, PLAN = 0, 1, 2, 3
     events: List[tuple] = []
     seq = 0
     for req in requests:
         events.append((req.arrival, seq, ARRIVE, req, 0))
         seq += 1
+    planned = bool(retile and retile.get("planned"))
     if retile:
-        events.append((float(retile["at"]), seq, RETILE, None, 0))
+        if planned:
+            # coordinated drain: the plan lands at `at`, the block at the
+            # deadline — migration happens in between
+            window = float(retile.get("drain_window_s", 5.0))
+            events.append((float(retile["at"]), seq, PLAN, None, 0))
+            seq += 1
+            events.append((float(retile["at"]) + window, seq, RETILE,
+                           None, 0))
+        else:
+            events.append((float(retile["at"]), seq, RETILE, None, 0))
         seq += 1
     heapq.heapify(events)
 
@@ -173,12 +193,14 @@ def run_scenario(groups: Sequence[dict],
         still: List[_Request] = []
         for req in waiting:
             sl = next((s for s in slices
-                       if not s.blocked and s.free >= req.chips), None)
+                       if not s.blocked and not s.pending_block
+                       and s.free >= req.chips), None)
             if sl is None and req.priority == 0:
                 # preempt batch traffic: find a slice where evicting
                 # strictly-lower-priority tenants frees enough chips
                 for cand in slices:
-                    if cand.blocked or cand.capacity < req.chips:
+                    if (cand.blocked or cand.pending_block
+                            or cand.capacity < req.chips):
                         continue
                     victims = sorted(
                         (r for r in running.values()
@@ -223,10 +245,28 @@ def run_scenario(groups: Sequence[dict],
                 req.finish = now
                 completed.append(req)
                 try_place_all(now)
+            elif kind == PLAN:
+                # RetilePlanned: named slices stop taking new tenants and
+                # running ones start migrating NOW — the whole point of the
+                # protocol is that the drain clock starts at the plan, not
+                # at the block
+                for idx in retile.get("blocked", []):
+                    if 0 <= idx < len(slices):
+                        slices[idx].pending_block = True
+                        for r in [r for r in running.values()
+                                  if r.slice_id == idx]:
+                            unplace(r, now)
+                            r.drained_at = now
+                            drained.append(r)
+                            waiting.append(r)
+                try_place_all(now)
             elif kind == RETILE:
                 for idx in retile.get("blocked", []):
                     if 0 <= idx < len(slices):
                         slices[idx].blocked = True
+                        slices[idx].pending_block = False
+                        # stragglers (none in planned mode — the plan
+                        # already drained them): drain at the deadline
                         for r in [r for r in running.values()
                                   if r.slice_id == idx]:
                             unplace(r, now)
@@ -280,10 +320,16 @@ def run_scenario(groups: Sequence[dict],
             "at": float(retile["at"]),
             "blocked": list(retile.get("blocked", [])),
             "drain_window_s": window,
+            "planned": planned,
             "drained_tenants": len(drained),
             "replaced": len(replaced),
             "replaced_within_window": len(within),
             "all_replaced_within_window": len(within) == len(drained),
+            # the drain-protocol bench number: tenants fully migrated off
+            # the planned slices before the deadline (== replaced within
+            # the window; in planned mode the clock starts at the plan)
+            "drained_within_window": len(within),
+            "all_drained_within_window": len(within) == len(drained),
             "max_replace_s": round(max(
                 (r.replaced_at - r.drained_at for r in replaced),
                 default=0.0), 4),

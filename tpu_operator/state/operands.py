@@ -241,7 +241,13 @@ def slice_partitioner_extras(policy: ClusterPolicy) -> dict:
     sp = policy.spec.slice_partitioner
     return {"partitioner_config": sp.config or {},
             "slice_config_label": consts.TPU_SLICE_CONFIG_LABEL,
-            "slice_state_label": consts.TPU_SLICE_STATE_LABEL}
+            "slice_state_label": consts.TPU_SLICE_STATE_LABEL,
+            # coordinated drain: health-gated re-tiles wait for the
+            # workload's drain-ack up to this deadline (0 = immediate
+            # re-tile; also 0 when the health machine is off — no one
+            # would publish the plan the partitioner waits on)
+            "drain_deadline_s": (policy.spec.health.drain_deadline_s
+                                 if policy.spec.health.enabled else 0)}
 
 
 def serving_extras(policy: ClusterPolicy) -> dict:

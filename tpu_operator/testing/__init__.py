@@ -1,4 +1,5 @@
 from .apiserver import MiniApiServer
 from .chaos import PodChaos
+from .trainjob import SimulatedTrainingJob
 
-__all__ = ["MiniApiServer", "PodChaos"]
+__all__ = ["MiniApiServer", "PodChaos", "SimulatedTrainingJob"]

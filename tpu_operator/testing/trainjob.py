@@ -1,0 +1,91 @@
+"""Simulated training job for drain-protocol soaks.
+
+A minimal drain-protocol participant standing in for a real trainer: it
+advances a step counter against a slice layout, watches the node for a
+published ``tpu.ai/planned-retile`` plan, acks through the real protocol
+helpers (checkpoint to the host-path file, drain-ack stamp into the
+workload barrier), and on "pod recycle" resumes from the checkpoint —
+letting the soak assert the ISSUE's acceptance bar directly: **zero steps
+lost beyond the drain window** (CRIUgpu, arXiv 2502.16631: recovery
+resumes instead of restarts).
+
+Deliberately NOT a subprocess: the soak drives it step-by-step interleaved
+with operator sweeps, so kill/restart points are deterministic.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional
+
+from ..health import drain
+from ..validator.status import StatusFiles
+
+log = logging.getLogger(__name__)
+
+
+class SimulatedTrainingJob:
+    """Step counter + RNG stand-in + drain participation.
+
+    ``tick()`` advances one "training step" and runs one drain-watch pass
+    (exactly what a real trainer's step loop would hook). ``crash()``
+    models the remediation pod recycle: in-memory state is discarded.
+    ``resume()`` models the restarted pod: state comes back from the
+    host-path checkpoint — steps completed after the last checkpoint are
+    the (bounded) loss the soak asserts on.
+    """
+
+    def __init__(self, client, node_name: str, status: StatusFiles):
+        self.client = client
+        self.node_name = node_name
+        self.status = status
+        self.step = 0
+        #: deterministic RNG stand-in, advanced with the step counter so a
+        #: resume that loses steps also detectably loses RNG sync
+        self.rng_state = 0
+        self.acked_plans: List[str] = []
+
+    # -- the "training loop" --------------------------------------------------
+    def tick(self) -> int:
+        """One training step, then one drain-watch pass (checkpoint + ack
+        when a plan is pending). Returns the step counter."""
+        self.step += 1
+        self.rng_state = (self.rng_state * 6364136223846793005 + 1442695040888963407) % (2 ** 64)
+        node = self.client.get("v1", "Node", self.node_name)
+        plan = drain.node_plan(node)
+        if plan is not None and plan.fingerprint not in self.acked_plans:
+            self.checkpoint()
+            drain.write_drain_ack(self.status, plan.fingerprint,
+                                  step=self.step,
+                                  checkpoint=self._ckpt_path())
+            self.acked_plans.append(plan.fingerprint)
+            log.info("trainjob: acked plan %s at step %d",
+                     plan.fingerprint, self.step)
+        return self.step
+
+    def _ckpt_path(self) -> str:
+        return drain.checkpoint_path(self.status.directory)
+
+    def checkpoint(self) -> str:
+        return drain.save_checkpoint(self._ckpt_path(), self.step,
+                                     rng_state=self.rng_state)
+
+    # -- remediation/recycle modelling ----------------------------------------
+    def crash(self) -> None:
+        """The pod-recycle moment: all in-memory state gone."""
+        self.step = -1
+        self.rng_state = -1
+
+    def resume(self) -> Optional[int]:
+        """Restart from the host-path checkpoint (None = no checkpoint —
+        restart from scratch, the PR 5 behavior the protocol exists to
+        avoid). Returns the resumed step."""
+        ckpt = drain.load_checkpoint(self._ckpt_path())
+        if ckpt is None:
+            self.step = 0
+            self.rng_state = 0
+            return None
+        self.step = int(ckpt["step"])
+        self.rng_state = ckpt.get("rng_state", 0)
+        log.info("trainjob: resumed from checkpoint at step %d", self.step)
+        return self.step

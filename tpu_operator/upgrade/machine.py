@@ -64,19 +64,11 @@ IN_PROGRESS_STATES = (CORDON_REQUIRED, WAIT_FOR_JOBS_REQUIRED,
 DRIVER_COMPONENT = "tpu-driver"
 VALIDATOR_COMPONENT = "tpu-operator-validator"
 
-#: every app.kubernetes.io/component value the operator's own operand
-#: DaemonSets stamp on their pods (manifests/*/0500_daemonset.yaml). The
-#: drain/pod-deletion sweeps exempt ONLY these (in the operator namespace)
-#: plus DaemonSet-owned and mirror pods — label *presence* is not ownership:
-#: app.kubernetes.io/component is a standard recommended label and a user
-#: TPU workload labeled component=web must still be drained (reference
-#: drain_manager.go:76-82 skips only DaemonSet + mirror pods).
-#: tests/test_upgrade.py pins this set against the manifest templates.
-OPERAND_COMPONENTS = frozenset({
-    "tpu-driver", "tpu-device-plugin", "tpu-operator-validator",
-    "tpu-telemetry", "tpu-feature-discovery", "tpu-slice-partitioner",
-    "tpu-node-status-exporter", "tpu-serving-validator",
-})
+#: re-exported from consts so existing imports keep working; the canonical
+#: set (and the shared exemption predicate both the upgrade drain and the
+#: health force-drain use) lives in consts.py — one copy, so the two
+#: eviction sweeps cannot drift
+OPERAND_COMPONENTS = consts.OPERAND_COMPONENTS
 
 
 def node_upgrade_state(node: dict) -> str:
@@ -278,17 +270,10 @@ class UpgradeStateMachine:
                              "kubernetes.io/config.mirror"))
 
     def _drain_exempt(self, pod: dict) -> bool:
-        """Pods the drain/pod-deletion sweeps never target: DaemonSet-owned
-        and static (mirror) pods — kubectl drain semantics, the reference's
-        IgnoreAllDaemonSets:true (drain_manager.go:76-82) — plus the
-        operator's own operand pods identified by namespace AND a component
-        value from OPERAND_COMPONENTS (not mere label presence)."""
-        if self._daemonset_owned(pod) or self._mirror_pod(pod):
-            return True
-        component = deep_get(pod, "metadata", "labels",
-                             "app.kubernetes.io/component")
-        return (pod["metadata"].get("namespace") == self.namespace
-                and component in OPERAND_COMPONENTS)
+        """Delegates to the shared predicate in consts — one exemption rule
+        for every eviction sweep (upgrade drain here, health force-drain in
+        the health machine)."""
+        return consts.drain_exempt(pod, self.namespace)
 
     @staticmethod
     def _requests_tpu(pod: dict) -> bool:

@@ -167,6 +167,24 @@ def sync_node_labels(client, node_name: str, use_jax: bool = True) -> Dict[str, 
             "annotations": {consts.WORKLOAD_HEALTH_ANNOTATION: verdict}}})
         log.info("feature discovery: %s workload health -> %s",
                  node_name, verdict)
+    # mirror the barrier's drain-ack stamp to the node (the operator's
+    # drain gate reads acks from annotations; the barrier stays the
+    # node-local source of truth the partitioner consults directly).
+    # Cleared when the stamp disappears — a revalidation rewrite of the
+    # barrier retires the ack along with the episode.
+    from ..health import drain as drainproto
+    from .status import StatusFiles
+    status_dir = os.environ.get("STATUS_DIR", consts.VALIDATION_STATUS_DIR)
+    ack_value = drainproto.ack_annotation_value(
+        drainproto.read_drain_ack(StatusFiles(status_dir)))
+    current_ack = deep_get(node, "metadata", "annotations",
+                           consts.DRAIN_ACK_ANNOTATION)
+    if ack_value != current_ack:
+        client.patch("v1", "Node", node_name, {"metadata": {
+            "annotations": {consts.DRAIN_ACK_ANNOTATION: ack_value}}})
+        if ack_value:
+            log.info("feature discovery: %s drain ack -> %s",
+                     node_name, ack_value)
     # same node-agent role for the serving barrier: verdict label gates
     # traffic placement, measured numbers ride in the detail annotation
     serving, detail = serving_slo_verdict()

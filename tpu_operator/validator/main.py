@@ -176,10 +176,43 @@ def revalidate_local(status, matrix_dim: int, timeout: float = 600.0):
         log.info("revalidation skipped — sweep never produced a report "
                  "(chips busy?): %s", result.stderr[-200:])
         return None
+    # the drain-ack stamp is protocol state, not verdict state: a verdict
+    # refresh mid-drain must not un-ack the plan (the partitioner reads the
+    # ack straight from this barrier). It is retired by the drain watch
+    # once the plan annotation is gone.
+    prior = status.read("workload") or {}
+    if isinstance(prior.get("drain_ack"), dict):
+        report.setdefault("drain_ack", prior["drain_ack"])
     status.write("workload", report)
     if not report.get("passed"):
         log.error("periodic revalidation FAILED: %s", report.get("details"))
     return bool(report.get("passed"))
+
+
+def drain_watch(client, status):
+    """One best-effort drain pass for the long-running agent loops (sleep-
+    mode revalidation, serving re-probe): if the operator published a
+    ``tpu.ai/planned-retile`` plan for this node, checkpoint and stamp the
+    drain-ack into the barrier (health/drain.maybe_ack_plan). Returns the
+    (possibly lazily-built) client so callers can cache it. Never raises —
+    a missed pass retries next interval and the deadline force path keeps
+    the protocol live regardless."""
+    node_name = os.environ.get("NODE_NAME", "")
+    if not node_name:
+        return client
+    if client is None:
+        try:
+            client = make_client()
+        except Exception as e:
+            log.debug("drain watch: no apiserver client (%s)", e)
+            return None
+    try:
+        from ..health import drain as drainproto
+
+        drainproto.maybe_ack_plan(client, node_name, status)
+    except Exception:
+        log.exception("drain watch pass failed; retrying next interval")
+    return client
 
 
 def run(argv=None, client=None) -> int:
@@ -348,6 +381,11 @@ def run(argv=None, client=None) -> int:
             import time as _time
 
             _time.sleep(args.serving_interval)
+            # the serving agent is a drain participant: a planned re-tile
+            # gets its ack (checkpoint + barrier stamp) from here between
+            # probes, so in-flight serving state is preserved before the
+            # layout moves
+            client = drain_watch(client, status)
             try:
                 rc = probe_once()
             except Exception:
@@ -378,6 +416,11 @@ def run(argv=None, client=None) -> int:
                      args.revalidate_interval)
             while True:
                 time.sleep(args.revalidate_interval)
+                # ack any planned re-tile BEFORE revalidating: the sweep
+                # rewrites the barrier, and an ack stamped first rides the
+                # node annotation (published by FD) for the operator while
+                # the checkpoint persists on the host path
+                client = drain_watch(client, status)
                 try:
                     revalidate_local(status, args.matrix_dim)
                 except Exception:
@@ -388,6 +431,7 @@ def run(argv=None, client=None) -> int:
         log.info("all validations complete; sleeping")
         while True:
             time.sleep(args.sleep_interval)
+            client = drain_watch(client, status)
 
     if component == "metrics":
         from . import metrics

@@ -86,7 +86,10 @@ def ici_health_check(matrix_dim: int = 512, devices=None) -> IciCheckReport:
     import numpy as np
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-    shard_map = jax.shard_map
+    try:
+        shard_map = jax.shard_map  # jax >= 0.4.38 top-level export
+    except AttributeError:
+        from jax.experimental.shard_map import shard_map
 
     enable_compilation_cache()
     devices = list(devices if devices is not None else jax.devices())
