@@ -47,6 +47,10 @@ SERVING_TRAFFIC_SEED ?= 20260805
 serving-bench:  ## serving SLO probe (healthy + quarantined fail-closed) + seeded multi-tenant traffic scenario
 	SERVING_TRAFFIC_SEED=$(SERVING_TRAFFIC_SEED) $(PYTHON) bench.py --serving-only
 
+.PHONY: join-bench
+join-bench:  ## one-node end-to-end join trace + critical-path attribution; fails unless attribution covers >=95% of the join window with zero orphan spans. Trace id pinned by construction (sha256 of the policy identity); JAX on CPU for run-to-run comparability.
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --join-only
+
 .PHONY: generate
 generate:  ## regenerate CRDs into all install channels (reference: make manifests)
 	$(PYTHON) hack/gen-crds.py

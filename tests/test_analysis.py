@@ -502,6 +502,13 @@ POSITIVE_FIXTURES = {
 
         C = Counter("x", "doc")
     """),
+    "span-discipline": ("tpu_operator/controllers/sync.py", """
+        from tpu_operator import tracing
+
+        def reconcile(req):
+            sp = tracing.span("render")
+            return sp
+    """),
 }
 
 

@@ -1,0 +1,493 @@
+"""Fleet join profiler: compact span records, the SpanLog/annotation size
+bounds, critical-path attribution (incl. genuinely overlapping phases),
+trace-context inject/extract through a rendered manifest, the JoinProfiler
+stitcher, and the full stack: a real operator + kubelet-sim join with the
+real validator CLI as node agent, stitched into ONE trace with zero orphan
+spans and served on /debug/join-traces."""
+
+import json
+import socket
+import time
+import types
+
+import pytest
+import requests as rq
+
+from tpu_operator import consts, tracing
+from tpu_operator.api.clusterpolicy import ClusterPolicy, new_cluster_policy
+from tpu_operator.joinprofile.collector import JoinProfiler
+from tpu_operator.joinprofile.critical_path import (
+    attribute,
+    phase_of,
+    record_intervals,
+)
+from tpu_operator.joinprofile.records import (
+    MAX_ANNOTATION_RECORDS,
+    MAX_LOG_RECORDS,
+    SpanLog,
+    decode_annotation,
+    encode_annotation,
+    span_to_records,
+)
+
+OPERAND_IMAGE_ENVS = ("DRIVER_IMAGE", "VALIDATOR_IMAGE",
+                      "FEATURE_DISCOVERY_IMAGE", "TELEMETRY_EXPORTER_IMAGE",
+                      "SLICE_PARTITIONER_IMAGE", "DEVICE_PLUGIN_IMAGE")
+
+
+@pytest.fixture(autouse=True)
+def default_images(monkeypatch):
+    for env in OPERAND_IMAGE_ENVS:
+        monkeypatch.setenv(env, "gcr.io/tpu/x:0.1.0")
+
+
+def rec(i, s, d=1.0, n="ici-sweep", t="t" * 32, p=""):
+    return {"i": i, "p": p, "t": t, "n": n, "s": s, "d": d, "st": "ok",
+            "a": {}}
+
+
+# -- span records + SpanLog ----------------------------------------------------
+
+def test_remote_trace_round_trips_through_span_log(tmp_path):
+    """remote_trace -> sink -> SpanLog -> decode: the whole node-side wire
+    path, including the open-root-published-at-entry contract."""
+    logf = SpanLog(str(tmp_path))
+    tp = tracing.stable_traceparent("join:test")
+    trace_id, parent_id = tracing.parse_traceparent(tp)
+    with tracing.remote_trace("operand.wait", traceparent=tp,
+                              sink=logf.sink(), component="wait") as root:
+        # the sink saw the OPEN root at entry: d is None on disk right now
+        open_recs = logf.read()
+        assert [r["i"] for r in open_recs] == [root.span_id]
+        assert open_recs[0]["d"] is None
+        with tracing.span("barrier-wait.workload") as sp:
+            sp.set_attribute("passed", True)
+    records = logf.read()
+    # exit flush replaced the open root (merge by id, new wins) and added
+    # the child — same ids, closed durations
+    assert {r["i"] for r in records} == {root.span_id,
+                                         root.children[0].span_id}
+    assert all(r["d"] is not None and r["t"] == trace_id for r in records)
+    by_name = {r["n"]: r for r in records}
+    assert by_name["operand.wait"]["p"] == parent_id
+    assert by_name["barrier-wait.workload"]["p"] == root.span_id
+    assert by_name["barrier-wait.workload"]["a"] == {"passed": True}
+    # and the annotation encoding round-trips losslessly at this size
+    assert decode_annotation(encode_annotation(records)) == records
+
+
+def test_remote_trace_without_context_is_a_noop(tmp_path):
+    """No TPU_TRACE_PARENT (local/CI runs) or a malformed one: no file, no
+    crash — operand entrypoints call remote_trace unconditionally."""
+    logf = SpanLog(str(tmp_path))
+    for bad in (None, "", "not-a-traceparent", "aa-bb", "x" * 32 + "-" + "y" * 16):
+        with tracing.remote_trace("operand.x", traceparent=bad,
+                                  sink=logf.sink()):
+            pass
+    assert logf.read() == []
+
+
+def test_span_log_is_bounded_and_newest_wins(tmp_path):
+    logf = SpanLog(str(tmp_path))
+    logf.append([rec(f"s{i:04d}", s=float(i)) for i in range(MAX_LOG_RECORDS + 50)])
+    records = logf.read()
+    assert len(records) == MAX_LOG_RECORDS
+    # newest-by-start retained: the oldest 50 fell off
+    assert min(r["s"] for r in records) == 50.0
+
+
+def test_span_log_tolerates_corruption(tmp_path):
+    logf = SpanLog(str(tmp_path))
+    logf.path_file = None
+    (tmp_path / "trace-spans.json").write_text("{not json")
+    assert logf.read() == []
+    (tmp_path / "trace-spans.json").write_text('{"a": 1}')  # not a list
+    assert logf.read() == []
+    (tmp_path / "trace-spans.json").write_text(
+        json.dumps([rec("ok1", 1.0), {"junk": True}, "nope"]))
+    assert [r["i"] for r in logf.read()] == ["ok1"]
+
+
+def test_flush_spans_checkpoints_long_loops(tmp_path):
+    """A never-exiting loop's spans reach the log via flush_spans without
+    waiting for a process exit that never comes."""
+    logf = SpanLog(str(tmp_path))
+    tp = tracing.stable_traceparent("join:loop")
+    with tracing.remote_trace("operand.sleep", traceparent=tp,
+                              sink=logf.sink()):
+        with tracing.span("revalidate.ici-sweep"):
+            pass
+        assert len(logf.read()) == 1  # only the entry-flushed open root
+        tracing.flush_spans()
+        assert len(logf.read()) == 2  # checkpoint published the child
+    # outside any remote trace it's a guarded no-op
+    tracing.flush_spans()
+
+
+def test_dropped_span_loss_is_counted():
+    """span()/record_span() outside an active trace are no-ops whose loss
+    is COUNTED, and the operator gauge exports the same number."""
+    before = tracing.dropped_spans_total()
+    with tracing.span("orphaned"):
+        pass
+    tracing.record_span("also-orphaned", time.time(), 0.1)
+    assert tracing.dropped_spans_total() == before + 2
+
+    from tpu_operator.controllers.metrics import OperatorMetrics
+
+    metrics = OperatorMetrics()
+    metrics.wire_tracing()
+    assert metrics.registry.get_sample_value(
+        "tpu_operator_trace_dropped_total") == tracing.dropped_spans_total()
+
+
+# -- annotation bounds ---------------------------------------------------------
+
+def test_annotation_truncates_oldest_first():
+    records = [rec(f"s{i:04d}", s=float(i)) for i in range(MAX_ANNOTATION_RECORDS + 10)]
+    kept = decode_annotation(encode_annotation(records))
+    assert len(kept) == MAX_ANNOTATION_RECORDS
+    assert min(r["s"] for r in kept) == 10.0  # oldest dropped
+
+
+def test_annotation_byte_bound_shrinks_until_it_fits():
+    big = [dict(rec(f"s{i:04d}", s=float(i)), a={"blob": "x" * 400})
+           for i in range(64)]
+    encoded = encode_annotation(big, max_bytes=2048)
+    assert 0 < len(encoded.encode()) <= 2048
+    kept = decode_annotation(encoded)
+    # still newest-first retention under the byte bound
+    assert max(r["s"] for r in kept) == 63.0
+    # pathological single record larger than the budget: "" (caller clears)
+    assert encode_annotation(
+        [dict(rec("s0", 0.0), a={"blob": "x" * 4000})], max_bytes=1024) == ""
+
+
+# -- critical path -------------------------------------------------------------
+
+def test_phase_naming_rules():
+    assert phase_of("xla-compile") == "xla-compile"
+    assert phase_of("ici-sweep") == "validation-run"
+    assert phase_of("operand.workload-local") == "validation-run"
+    assert phase_of("barrier-wait.workload") == "barrier-handshake"
+    assert phase_of("operand.wait") == "barrier-handshake"
+    # "rollout" must match BEFORE the generic "wait" fragment
+    assert phase_of("ds-rollout-wait") == "ds-rollout-wait"
+    assert phase_of("serving.probe") == "serving-probe"
+    assert phase_of("reconcile") == "reconcile"
+    assert phase_of("mystery-span") == "other"
+    assert phase_of("anything", kind="phase") == "reconcile"
+
+
+def test_attribution_charges_overlaps_to_most_specific_phase():
+    """Overlapping phases — compile inside a validation sweep inside a DS
+    rollout wait, with reconcile sweeps throughout: every instant charged
+    once, to the highest-priority active phase."""
+    out = attribute([
+        ("ds-rollout-wait", 0.0, 10.0),
+        ("reconcile", 0.0, 10.0),          # lower priority than rollout-wait
+        ("validation-run", 2.0, 8.0),
+        ("xla-compile", 3.0, 5.0),         # inside the validation run
+        ("barrier-handshake", 7.0, 9.0),   # overlaps validation tail
+    ], window=(0.0, 10.0))
+    assert out["phases"] == {"ds-rollout-wait": 3.0, "xla-compile": 2.0,
+                             "validation-run": 3.0, "barrier-handshake": 2.0}
+    assert out["attributed_s"] == 10.0
+    assert out["coverage"] == 1.0
+
+
+def test_attribution_clips_and_reports_gaps():
+    out = attribute([
+        ("validation-run", -5.0, 2.0),     # clipped to the window start
+        ("unknown-phase", 6.0, 7.0),       # degrades to "other", not dropped
+    ], window=(0.0, 10.0))
+    assert out["phases"] == {"validation-run": 2.0, "other": 1.0}
+    assert out["unattributed_s"] == 7.0
+    assert out["coverage"] == 0.3
+    # empty window / no intervals degrade cleanly
+    assert attribute([], (0.0, 0.0))["coverage"] == 0.0
+
+
+def test_record_intervals_skip_open_records():
+    intervals = record_intervals([
+        rec("a", 1.0, d=2.0, n="ici-sweep"),
+        rec("b", 2.0, d=None, n="operand.sleep"),  # still open: no interval
+    ])
+    assert intervals == [("validation-run", 1.0, 3.0)]
+
+
+# -- inject/extract through a rendered manifest --------------------------------
+
+def test_trace_context_round_trips_through_rendered_manifest(fake_client):
+    """The reconciler's render output carries the join trace context twice
+    (annotation + env), both derived STABLY from the policy identity, and
+    the env parses back to the exact ids an operand entrypoint will use."""
+    from tpu_operator.state.operands import cluster_policy_states
+
+    policy = ClusterPolicy.from_obj(dict(
+        new_cluster_policy(), metadata={"name": "cluster-policy",
+                                        "uid": "11111111-2222"}))
+    expect_tp = tracing.join_traceparent(policy.obj)
+    trace_id, span_id = tracing.parse_traceparent(expect_tp)
+    daemon_sets = []
+    for state in cluster_policy_states(fake_client):
+        if not hasattr(state, "render_objects"):
+            continue
+        try:
+            objs = state.render_objects(policy, "tpu-operator")
+        except TypeError:
+            continue  # namespace-only states carry no pod template
+        daemon_sets += [o for o in objs if o.get("kind") == "DaemonSet"]
+    assert daemon_sets, "no DaemonSets rendered"
+    for ds in daemon_sets:
+        tpl = ds["spec"]["template"]
+        assert tpl["metadata"]["annotations"][
+            tracing.TRACE_ID_ANNOTATION] == trace_id, ds["metadata"]["name"]
+        envs = [e for c in (tpl["spec"].get("initContainers", [])
+                            + tpl["spec"]["containers"])
+                for e in c.get("env", [])
+                if e.get("name") == tracing.TRACE_PARENT_ENV]
+        assert envs, f"{ds['metadata']['name']}: no TPU_TRACE_PARENT env"
+        for env in envs:
+            assert tracing.parse_traceparent(env["value"]) == (trace_id,
+                                                               span_id)
+    # stability: a second render (fresh objects) yields byte-identical
+    # context — a per-sweep id would roll every DS every sweep
+    assert tracing.join_traceparent(policy.obj) == expect_tp
+
+
+# -- JoinProfiler stitching ----------------------------------------------------
+
+def _policy(uid="u-1"):
+    return types.SimpleNamespace(obj={"metadata": {"name": "cluster-policy",
+                                                   "uid": uid}})
+
+
+def _node(name, schedulable=False, spans=None):
+    node = {"metadata": {"name": name, "annotations": {}}, "status": {}}
+    if schedulable:
+        node["status"]["capacity"] = {consts.TPU_RESOURCE_NAME: "4"}
+    if spans is not None:
+        node["metadata"]["annotations"][
+            consts.TRACE_SPANS_ANNOTATION] = encode_annotation(spans)
+    return node
+
+
+def test_join_profiler_stitches_hand_built_join():
+    profiler = JoinProfiler()
+    policy = _policy()
+    trace_id, parent_id = tracing.parse_traceparent(
+        tracing.join_traceparent(policy.obj))
+    not_ready = types.SimpleNamespace(ready=False)
+    ready = types.SimpleNamespace(ready=True)
+
+    profiler.observe(policy, [_node("n0")], not_ready)
+    time.sleep(0.02)
+    profiler.observe(policy, [_node("n0", schedulable=True)], ready)
+    now = time.time()
+    spans = [
+        # root started BEFORE the first sweep saw the node and a child
+        # ends after completion: the window must extend over both
+        rec("a" * 16, now - 0.5, d=1.0, n="operand.workload-local",
+            t=trace_id, p=parent_id),
+        rec("b" * 16, now - 0.4, d=0.3, n="ici-sweep", t=trace_id,
+            p="a" * 16),
+        rec("c" * 16, now - 0.4, d=0.1, n="xla-compile", t=trace_id,
+            p="b" * 16),
+    ]
+    profiler.observe(policy, [_node("n0", schedulable=True, spans=spans)],
+                     ready)
+
+    trace = profiler.join_trace("n0")
+    assert trace["trace_id"] == trace_id
+    assert trace["window"]["complete"] is True
+    assert trace["orphan_spans"] == []
+    assert {s["phase"] for s in trace["node_spans"]} == {"validation-run",
+                                                         "xla-compile"}
+    att = trace["attribution"]
+    # window covers the early root start and the late end
+    assert att["window_s"] >= 1.0
+    assert "xla-compile" in att["phases"]
+    assert att["coverage"] > 0.9
+    assert profiler.stats()["completed_joins"] == 1
+    assert profiler.join_traces(node="n0") == [trace]
+    assert profiler.join_traces(node="absent") == []
+
+
+def test_join_profiler_flags_orphan_spans():
+    """Records from a foreign trace id, or whose parent chain reaches
+    neither the record set nor the operator-side parent span, are surfaced
+    as orphans — never silently merged."""
+    profiler = JoinProfiler()
+    policy = _policy()
+    trace_id, parent_id = tracing.parse_traceparent(
+        tracing.join_traceparent(policy.obj))
+    ready = types.SimpleNamespace(ready=True)
+    now = time.time()
+    spans = [
+        rec("a" * 16, now, d=0.2, t=trace_id, p=parent_id),       # good
+        rec("d" * 16, now, d=0.2, t="f" * 32, p=parent_id),       # wrong trace
+        rec("e" * 16, now, d=0.2, t=trace_id, p="9" * 16),        # broken chain
+    ]
+    profiler.observe(policy, [_node("n0", schedulable=True, spans=spans)],
+                     ready)
+    trace = profiler.join_trace("n0")
+    assert sorted(trace["orphan_spans"]) == ["d" * 16, "e" * 16]
+
+
+def test_join_profiler_reconcile_latency_summary():
+    profiler = JoinProfiler()
+    for d in (0.01, 0.02, 0.03, 1.0):
+        root = tracing.Span("reconcile", kind="reconcile",
+                            attributes={"controller": "clusterpolicy"})
+        root.duration_s = d
+        profiler.on_trace(root)
+    summary = profiler.reconcile_latency()
+    assert summary["count"] == 4
+    assert summary["p50_s"] == 0.03
+    assert summary["p99_s"] == 1.0
+    # an unfinished root is ignored, not crashed on
+    profiler.on_trace(tracing.Span("reconcile"))
+    assert profiler.reconcile_latency()["count"] == 4
+
+
+# -- full stack ----------------------------------------------------------------
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_full_stack_join_stitches_one_trace(monkeypatch, tmp_path):
+    """Operator + kubelet sim join a node; the REAL validator CLI runs a
+    barrier wait under the TPU_TRACE_PARENT read back off the RENDERED
+    validator DS; real feature discovery mirrors the span log up; the
+    profiler stitches ONE end-to-end trace with zero orphan spans, served
+    on /debug/join-traces and observed into the join-phase histogram."""
+    from tpu_operator.client.cache import CachedClient
+    from tpu_operator.client.rest import RestClient
+    from tpu_operator.controllers.manager import OperatorApp
+    from tpu_operator.testing import MiniApiServer
+    from tpu_operator.testing.kubelet import KubeletSimulator
+    from tpu_operator.utils import deep_get
+    from tpu_operator.validator import feature_discovery
+    from tpu_operator.validator.main import run as validator_run
+    from tpu_operator.validator.status import StatusFiles
+
+    srv = MiniApiServer()
+    base = srv.start()
+    seed = RestClient(base_url=base)
+    seed.create(new_cluster_policy())
+    cached = CachedClient(RestClient(base_url=base))
+    hport = _free_port()
+    app = OperatorApp(cached, health_port=hport)
+    kubelet = KubeletSimulator(RestClient(base_url=base), interval=0.05)
+    app.start()
+    kubelet.start()
+    node_name = "tpu-fs-0"
+    status_dir = str(tmp_path)
+    try:
+        # trace context comes off the rendered manifests, not recomputed
+        trace_parent = None
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and trace_parent is None:
+            for ds in srv.backend.list("apps/v1", "DaemonSet",
+                                       consts.DEFAULT_NAMESPACE):
+                for c in deep_get(ds, "spec", "template", "spec",
+                                  "containers", default=[]):
+                    for env in c.get("env") or []:
+                        if (env.get("name") == tracing.TRACE_PARENT_ENV
+                                and env.get("value")):
+                            trace_parent = env["value"]
+            time.sleep(0.05)
+        assert trace_parent, "operator never rendered trace context"
+        trace_id, _ = tracing.parse_traceparent(trace_parent)
+
+        seed.create({"apiVersion": "v1", "kind": "Node",
+                     "metadata": {"name": node_name, "labels": {
+                         consts.GKE_TPU_ACCELERATOR_LABEL:
+                             "tpu-v5-lite-podslice",
+                         consts.GKE_TPU_TOPOLOGY_LABEL: "4x4"}},
+                     "status": {}})
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            node = srv.backend.get("v1", "Node", node_name)
+            if deep_get(node, "status", "capacity",
+                        consts.TPU_RESOURCE_NAME) is not None:
+                break
+            time.sleep(0.05)
+
+        # node agent: the real validator CLI (barrier pre-written so the
+        # wait returns immediately — no accelerator needed), then a real
+        # feature-discovery pass to mirror the span log up
+        StatusFiles(status_dir).write("workload", {"passed": True})
+        monkeypatch.setenv(tracing.TRACE_PARENT_ENV, trace_parent)
+        monkeypatch.setenv("NODE_NAME", node_name)
+        monkeypatch.setenv("STATUS_DIR", status_dir)
+        assert validator_run(["-c", "wait", "--for", "workload",
+                              "--timeout", "5",
+                              "--status-dir", status_dir]) == 0
+        feature_discovery.sync_node_labels(seed, node_name, use_jax=False)
+
+        trace = None
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            trace = app.join_profiler.join_trace(node_name)
+            if trace is not None and trace["node_spans"]:
+                break
+            time.sleep(0.1)
+        assert trace is not None and trace["node_spans"], \
+            "node spans never reached the profiler"
+
+        # ONE trace: operator-side id == node-side id, zero orphans
+        assert trace["trace_id"] == trace_id
+        assert trace["orphan_spans"] == []
+        assert trace["window"]["complete"] is True
+        assert trace["operator_sweeps"] >= 1
+        names = {s["n"] for s in trace["node_spans"]}
+        assert {"operand.wait", "barrier-wait.workload"} <= names
+        assert "barrier-handshake" in trace["attribution"]["phases"]
+
+        # the debug surface serves the same stitched trace
+        debug = f"http://127.0.0.1:{hport}"
+        body = rq.get(f"{debug}/debug/join-traces?node={node_name}",
+                      timeout=5).json()
+        assert body["count"] == 1
+        assert body["traces"][0]["node"] == node_name
+        assert body["traces"][0]["trace_id"] == trace_id
+        assert body["stats"]["completed_joins"] >= 1
+        assert body["reconcile_latency"]["count"] >= 1
+        assert rq.get(f"{debug}/debug/join-traces?limit=0",
+                      timeout=5).json()["count"] == 0
+
+        # /debug/traces: ?trace_id= alias + ?limit= + dropped-span counter
+        any_trace = rq.get(f"{debug}/debug/traces?limit=1", timeout=5).json()
+        assert any_trace["count"] == 1
+        tid = any_trace["traces"][0]["trace_id"]
+        by_id = rq.get(f"{debug}/debug/traces?trace_id={tid}",
+                       timeout=5).json()
+        assert [t["trace_id"] for t in by_id["traces"]] == [tid]
+        assert "dropped_spans_total" in any_trace["stats"]
+
+        # the completed join fed the phase histogram
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            count = app.metrics.registry.get_sample_value(
+                "tpu_operator_join_phase_seconds_count",
+                {"phase": "barrier-handshake"})
+            if count:
+                break
+            time.sleep(0.1)
+        assert count and count >= 1
+        # and the reconcile-latency summary gauges are live
+        assert app.metrics.registry.get_sample_value(
+            "tpu_operator_reconcile_latency_seconds",
+            {"quantile": "p50"}) is not None
+    finally:
+        app.stop()
+        cached.stop()
+        kubelet.stop()
+        srv.stop()

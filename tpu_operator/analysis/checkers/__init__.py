@@ -8,4 +8,5 @@ from . import (  # noqa: F401
     exception_hygiene,
     lock_discipline,
     metrics_discipline,
+    span_discipline,
 )

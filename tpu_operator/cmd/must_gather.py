@@ -244,6 +244,9 @@ class MustGather:
                      # per-reconcile story (what did each attempt do, what is
                      # each worker stuck on) that metrics alone can't carry
                      (self.operator_health_port, "/debug/traces", "traces.json"),
+                     # merged per-node join traces with critical-path
+                     # attribution (operator sweeps + node span records)
+                     (self.operator_health_port, "/debug/join-traces", "join-traces.json"),
                      (self.operator_health_port, "/debug/queue", "queue.json"),
                      (self.operator_health_port, "/debug/state", "state.json"))
         for name, ip in targets:

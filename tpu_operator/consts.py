@@ -95,6 +95,11 @@ HEALTH_FAILED_TEMPLATE_ANNOTATION = "tpu.ai/health-failed-template"
 #: the node-local barrier file so the operator's health sweep can read it:
 #: "passed" | "failed" | "failed:<chip,chip>" | "corrupt"
 WORKLOAD_HEALTH_ANNOTATION = "tpu.ai/workload-health"
+#: compact span records mirrored up from the node's host-path span log
+#: (trace-spans.json) by feature discovery, so the operator's JoinProfiler
+#: can stitch node-side spans into the end-to-end join trace. Bounded to
+#: joinprofile.records.MAX_ANNOTATION_BYTES encoded bytes, newest-first.
+TRACE_SPANS_ANNOTATION = "tpu.ai/trace-spans"
 
 # -- coordinated drain/handoff (planned re-tiles) ------------------------------
 #: a published re-tile/remediation plan (JSON: layout fingerprint, drain

@@ -65,12 +65,16 @@ class ClusterPolicyReconciler(Reconciler):
 
     def __init__(self, client: Client, namespace: Optional[str] = None,
                  metrics: Optional[OperatorMetrics] = None,
-                 cluster_info=None, requeue_after: float = NOT_READY_REQUEUE):
+                 cluster_info=None, requeue_after: float = NOT_READY_REQUEUE,
+                 join_profiler=None):
         self.client = client
         self.namespace = namespace or os.environ.get(consts.NAMESPACE_ENV, consts.DEFAULT_NAMESPACE)
         self.metrics = metrics or OperatorMetrics()
         self.cluster_info = cluster_info
         self.requeue_after = requeue_after
+        #: joinprofile.JoinProfiler (None outside the assembled operator):
+        #: fed one observation per sweep so it can stitch join traces
+        self.join_profiler = join_profiler
         self.state_manager = Manager(cluster_policy_states(client))
         #: last-seen tpu.ai/slice.config.state per node, for counting
         #: transitions INTO "retiled" (the counter must tick once per
@@ -326,6 +330,13 @@ class ClusterPolicyReconciler(Reconciler):
         with tracing.phase_span("sync-state") as sp:
             results = self.state_manager.sync_state(catalog)
             sp.set_attribute("ready", results.ready)
+        if self.join_profiler is not None:
+            # one join-profiler observation per sweep: schedulability,
+            # readiness and the mirrored trace-spans annotation per node
+            try:
+                self.join_profiler.observe(policy, label_result.nodes, results)
+            except Exception:  # opalint: disable=breaker-swallow — observe() is in-memory only (no API calls), so no BreakerOpenError can arrive; profiling must never fail a reconcile
+                log.debug("join profiler observation failed", exc_info=True)
         # after the (crash-prone) state sweep, right before the status
         # writes: an exception between the Warning Event and the condition
         # landing on the CR would re-emit the event every backoff retry

@@ -69,7 +69,10 @@ def serve_health_and_metrics(metrics: OperatorMetrics, metrics_port: int,
             controller = (query.get("controller") or [None])[0]
             errors_only = (query.get("error") or ["false"])[0].lower() in (
                 "1", "true", "yes")
-            trace_id = (query.get("trace") or [None])[0]
+            # ?trace_id= is the documented spelling; ?trace= kept for
+            # compatibility with the original endpoint
+            trace_id = (query.get("trace_id") or query.get("trace")
+                        or [None])[0]
             try:
                 limit = int((query.get("limit") or ["50"])[0])
             except ValueError:
@@ -77,10 +80,28 @@ def serve_health_and_metrics(metrics: OperatorMetrics, metrics_port: int,
             roots = recorder.traces(controller=controller,
                                     errors_only=errors_only,
                                     trace_id=trace_id, limit=limit)
+            stats = dict(recorder.stats(),
+                         dropped_spans_total=tracing.dropped_spans_total())
             self._send_json({
-                "stats": recorder.stats(),
+                "stats": stats,
                 "count": len(roots),
                 "traces": [r.to_dict() for r in roots],
+            })
+
+        def _debug_join_traces(self, query: dict) -> None:
+            # the stitched operator+node join traces with critical-path
+            # attribution; ?node=<name>&limit=
+            node = (query.get("node") or [None])[0]
+            try:
+                limit = int((query.get("limit") or ["20"])[0])
+            except ValueError:
+                limit = 20
+            traces = app.join_profiler.join_traces(limit=limit, node=node)
+            self._send_json({
+                "stats": app.join_profiler.stats(),
+                "reconcile_latency": app.join_profiler.reconcile_latency(),
+                "count": len(traces),
+                "traces": traces,
             })
 
         def do_GET(self):
@@ -97,6 +118,11 @@ def serve_health_and_metrics(metrics: OperatorMetrics, metrics_port: int,
                 # the flight recorder: last-N reconcile traces, error traces
                 # pinned; ?controller=&error=true&trace=<id>&limit=
                 self._debug_traces(query)
+                return
+            if path == "/debug/join-traces" and debug_on:
+                # per-node end-to-end join traces + phase attribution;
+                # ?node=<name>&limit=
+                self._debug_join_traces(query)
                 return
             if path == "/debug/queue" and debug_on:
                 # per-controller workqueue depth, in-flight request, backoff
@@ -159,12 +185,21 @@ class OperatorApp:
         self.recorder = tracing.FlightRecorder(trace_buffer_size)
         self.tracer = tracing.Tracer(self.recorder, self.metrics)
         tracing.set_default_tracer(self.tracer)
+        # fleet join profiler: subscribes to finalized reconcile traces and
+        # (via the reconciler's sweep observations) node-side span records,
+        # stitches them into per-node join traces behind /debug/join-traces
+        from ..joinprofile import JoinProfiler
+
+        self.join_profiler = JoinProfiler(metrics=self.metrics)
+        self.tracer.on_finalize = self.join_profiler.on_trace
+        self.metrics.wire_tracing()
         self.debug_endpoints = debug_endpoints
         self.elector = None  # set by run_operator under --leader-elect
         self._controllers_started = threading.Event()
         self.manager = ControllerManager(client)
         self.clusterpolicy_reconciler = ClusterPolicyReconciler(
-            client, namespace=namespace, metrics=self.metrics)
+            client, namespace=namespace, metrics=self.metrics,
+            join_profiler=self.join_profiler)
         self.clusterpolicy_controller = self.manager.add(
             setup_clusterpolicy_controller(client, self.clusterpolicy_reconciler))
         from .tpudriver_controller import TPUDriverReconciler, setup_tpudriver_controller
@@ -265,6 +300,7 @@ class OperatorApp:
                           if hasattr(self.client, "stats") else []),
             "controllers": [c.debug_state() for c in self.manager.controllers],
             "flight_recorder": self.recorder.stats(),
+            "join_profiler": self.join_profiler.stats(),
         }
 
     def stop(self) -> None:

@@ -107,6 +107,28 @@ class OperatorMetrics:
             "per-step latency SLO (min over batch rungs)",
             ["node"], registry=self.registry)
 
+        # fleet join profiler (joinprofile.JoinProfiler feeds these from
+        # the stitched operator+node join traces)
+        self.join_phase_seconds = Histogram(
+            "tpu_operator_join_phase_seconds",
+            "Critical-path attribution of one node's join wall-clock, per "
+            "phase (reconcile / ds-rollout-wait / image-pull / xla-compile / "
+            "barrier-handshake / validation-run / serving-probe / other); "
+            "observed once per completed join",
+            ["phase"], registry=self.registry,
+            buckets=(.01, .1, .5, 1, 2, 5, 10, 30, 60, 300))
+        self.reconcile_latency = Gauge(
+            "tpu_operator_reconcile_latency_seconds",
+            "Rolling reconcile root-span latency summary (window of recent "
+            "sweeps across all controllers), by quantile (p50/p99); feeds "
+            "bench.py's control_plane_scale_envelope",
+            ["quantile"], registry=self.registry)
+        self.trace_dropped = Gauge(
+            "tpu_operator_trace_dropped_total",
+            "Spans silently dropped because no trace was active on the "
+            "calling thread (monotonic; mirrored from the tracing module "
+            "via set_function, hence a gauge)", registry=self.registry)
+
         # controller-runtime/client-go equivalents (workqueue + rest client)
         self.workqueue_depth = Gauge(
             "tpu_operator_workqueue_depth",
@@ -166,6 +188,15 @@ class OperatorMetrics:
             "Cumulative time requests waited on the client-side token-bucket "
             "rate limiter (client-go flowcontrol analog)",
             registry=self.registry)
+
+    def wire_tracing(self) -> None:
+        """Mirror the tracing module's dropped-span counter into the
+        ``tpu_operator_trace_dropped_total`` gauge (pull, not push: the
+        drop happens on arbitrary threads with no trace active, so the
+        metric reads the module counter at scrape time)."""
+        from .. import tracing
+
+        self.trace_dropped.set_function(tracing.dropped_spans_total)
 
     def observe_rest_response(self, method: str, code: int) -> None:
         """RestClient.on_response hook target."""

@@ -1,0 +1,29 @@
+"""Fleet join profiler: cross-process span records + critical-path
+attribution of the node-join DAG.
+
+Three pieces (docs/design.md §10):
+
+* :mod:`.records` — the compact span-record format that rides the node's
+  host-path status barrier (``trace-spans.json``) and, mirrored by feature
+  discovery, the ``tpu.ai/trace-spans`` node annotation.
+* :mod:`.critical_path` — name→phase mapping and the sweep-line that
+  attributes join wall-clock to phases (reconcile sweeps vs DS rollout
+  wait vs image pull vs XLA compile vs barrier handshake vs validation).
+* :mod:`.collector` — the operator-side :class:`JoinProfiler` stitching
+  operator spans (via ``Tracer.on_finalize``) and node-side records (via
+  the annotation) into one end-to-end join trace per node, behind
+  ``/debug/join-traces``, the ``tpu_operator_join_phase_seconds`` family
+  and bench.py's ``join_attribution`` block.
+"""
+
+from .collector import JoinProfiler  # noqa: F401
+from .critical_path import PHASES, attribute, phase_of  # noqa: F401
+from .records import (  # noqa: F401
+    MAX_ANNOTATION_BYTES,
+    MAX_ANNOTATION_RECORDS,
+    MAX_LOG_RECORDS,
+    SpanLog,
+    decode_annotation,
+    encode_annotation,
+    span_to_records,
+)

@@ -14,7 +14,7 @@ import dataclasses
 import os
 from typing import Dict, List, Optional
 
-from .. import consts
+from .. import consts, tracing
 from ..api.clusterpolicy import ClusterPolicy
 from ..client.interface import Client
 from ..render import Renderer
@@ -67,6 +67,7 @@ class StateDriver:
             "tpu_resource": consts.TPU_RESOURCE_NAME,
             "validation_status_dir": policy.spec.host_paths.validation_status_dir,
             "dev_globs": ",".join(policy.spec.host_paths.dev_globs),
+            "trace_parent": tracing.join_traceparent(policy.obj),
             "node_selector": o.node_selector or {},
             "node_affinity": o.node_affinity,
             "extra_labels": o.extra_labels or {},

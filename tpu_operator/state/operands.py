@@ -15,7 +15,7 @@ from __future__ import annotations
 import os
 from typing import Callable, Dict, List, Optional
 
-from .. import consts
+from .. import consts, tracing
 from ..api.clusterpolicy import ClusterPolicy
 from ..api.common import ComponentSpec
 from ..client.interface import Client
@@ -79,6 +79,13 @@ def stamp_operator_meta(objs: List[dict], policy: ClusterPolicy) -> List[dict]:
             merge(tpl_meta, "labels", ds_spec.labels)
         if ds_spec.annotations:
             merge(tpl_meta, "annotations", ds_spec.annotations)
+        # join-trace context on every operand pod (the env-var twin rides
+        # host_env): STABLE per policy — derived from the CR uid, never a
+        # per-sweep id, or the template fingerprint below would change
+        # every sweep and roll every DaemonSet
+        tpl_meta.setdefault("annotations", {}).setdefault(
+            tracing.TRACE_ID_ANNOTATION,
+            tracing.join_traceparent(policy.obj).split("-")[0])
         if runtime_class:
             tpl.setdefault("spec", {})["runtimeClassName"] = runtime_class
         # LAST template mutation: the DS controller copies template labels
@@ -135,6 +142,9 @@ class OperandState:
             "validation_status_dir": policy.spec.host_paths.validation_status_dir,
             "dev_globs": ",".join(policy.spec.host_paths.dev_globs),
             "handoff_dir": policy.spec.host_paths.partition_handoff_dir,
+            # cross-process trace propagation: operand entrypoints parse
+            # this into their remote root span's trace context
+            "trace_parent": tracing.join_traceparent(policy.obj),
             # image for the barrier-wait init containers: the operator
             # initContainer override wins, else the validator image
             "validator_image": (policy.spec.operator.init_container_image()

@@ -39,15 +39,91 @@ from typing import Dict, Iterator, List, Optional
 #: Event annotation carrying the reconcile trace that emitted it
 TRACE_ID_ANNOTATION = "tpu.ai/trace-id"
 
+#: env var carrying trace context into operand pods (stamped by the common
+#: manifest template from the reconciler's render data)
+TRACE_PARENT_ENV = "TPU_TRACE_PARENT"
+
 #: default flight-recorder capacity (``--trace-buffer-size``)
 DEFAULT_BUFFER_SIZE = 256
 
 _current_span: contextvars.ContextVar[Optional["Span"]] = contextvars.ContextVar(
     "tpu_operator_current_span", default=None)
 
+#: active remote-trace sink: ``(root, sink)`` set by :func:`remote_trace` so
+#: long-running loops can checkpoint-publish via :func:`flush_spans`
+_remote_sink: contextvars.ContextVar[Optional[tuple]] = contextvars.ContextVar(
+    "tpu_operator_remote_sink", default=None)
+
+#: spans silently discarded because no trace was active on the calling
+#: thread (watch/informer threads, un-traced operand entrypoints) —
+#: read via :func:`dropped_spans_total`, exported as
+#: ``tpu_operator_trace_dropped_total`` and surfaced in /debug/traces
+_dropped_lock = threading.Lock()
+_dropped_spans = 0
+
+
+def _count_dropped() -> None:
+    global _dropped_spans
+    with _dropped_lock:
+        _dropped_spans += 1
+
+
+def dropped_spans_total() -> int:
+    with _dropped_lock:
+        return _dropped_spans
+
 
 def _new_id(nbytes: int) -> str:
     return uuid.uuid4().hex[: nbytes * 2]
+
+
+# -- cross-process propagation ------------------------------------------------
+#
+# Simplified traceparent: ``<trace_id:32 hex>-<span_id:16 hex>`` (the W3C
+# format minus version/flags, which nothing here consumes). The operator
+# derives it STABLY from the ClusterPolicy identity — never from a live
+# reconcile trace — because the value rides the DaemonSet pod template: a
+# per-sweep id would change the template fingerprint every sweep and roll
+# every operand DS forever.
+
+def format_traceparent(trace_id: str, span_id: str) -> str:
+    return f"{trace_id}-{span_id}"
+
+
+def parse_traceparent(value: Optional[str]):
+    """``(trace_id, span_id)`` or None for anything malformed — bad context
+    from an older/foreign manifest must degrade to untraced, never crash an
+    operand entrypoint."""
+    if not value or not isinstance(value, str):
+        return None
+    parts = value.strip().split("-")
+    if len(parts) != 2:
+        return None
+    trace_id, span_id = parts
+    if len(trace_id) != 32 or len(span_id) != 16:
+        return None
+    try:
+        int(trace_id, 16), int(span_id, 16)
+    except ValueError:
+        return None
+    return trace_id, span_id
+
+
+def stable_traceparent(seed: str) -> str:
+    """Deterministic traceparent for a seed string (sha256-derived): the
+    same policy always yields the same join trace id, so node-side spans
+    from any sweep stitch into one fleet-join trace."""
+    import hashlib
+
+    h = hashlib.sha256(seed.encode()).hexdigest()
+    return format_traceparent(h[:32], h[32:48])
+
+
+def join_traceparent(policy_obj: dict) -> str:
+    """The fleet-join traceparent for a ClusterPolicy object (uid-keyed,
+    name fallback for simulators that mint no uids)."""
+    meta = (policy_obj or {}).get("metadata", {}) or {}
+    return stable_traceparent(f"join:{meta.get('uid') or meta.get('name', '')}")
 
 
 class Span:
@@ -162,9 +238,13 @@ def current_trace_id() -> Optional[str]:
 
 @contextlib.contextmanager
 def span(name: str, kind: str = "internal", **attributes):
-    """Open a child span of the active span; a no-op outside a trace."""
+    """Open a child span of the active span; a no-op outside a trace (the
+    loss is COUNTED — see :func:`dropped_spans_total` — so spans silently
+    discarded off the worker thread show up in metrics instead of just
+    vanishing)."""
     parent = _current_span.get()
     if parent is None:
+        _count_dropped()
         yield NOOP_SPAN
         return
     child = Span(name, kind=kind, parent=parent, attributes=attributes)
@@ -192,6 +272,79 @@ def api_span(verb: str, path: str, **attributes):
     """An apiserver (or cache-served) call child span."""
     return span(f"api.{verb.lower()}", kind="api", verb=verb, path=path,
                 **attributes)
+
+
+def record_span(name: str, start_unix: float, duration_s: float,
+                kind: str = "internal", **attributes):
+    """Attach an already-measured interval as a child span of the active
+    span (e.g. the XLA compile time a report measured internally). Counted
+    as dropped outside a trace, like :func:`span`."""
+    parent = _current_span.get()
+    if parent is None:
+        _count_dropped()
+        return NOOP_SPAN
+    child = Span(name, kind=kind, parent=parent, attributes=attributes)
+    child.start_unix = float(start_unix)
+    child.duration_s = float(duration_s)
+    child.status = "ok"
+    parent.children.append(child)
+    return child
+
+
+@contextlib.contextmanager
+def remote_trace(name: str, traceparent: Optional[str] = None,
+                 sink=None, **attributes):
+    """Open a ROOT span continuing a trace started in ANOTHER process (the
+    operator), from a ``<trace_id>-<span_id>`` traceparent (usually the
+    ``TPU_TRACE_PARENT`` env the common manifest template stamps).
+
+    Without parseable context this is a free no-op — operand entrypoints
+    call it unconditionally. ``sink`` (a callable taking the root span) is
+    invoked once at entry with the OPEN span and again at exit: operand
+    components that never exit (sleep loops, re-probe loops) still publish
+    their open root immediately, and :func:`flush_spans` re-publishes the
+    current subtree from inside long loops. Sink failures are swallowed —
+    span publication must never fail a validation."""
+    parsed = parse_traceparent(traceparent)
+    if parsed is None:
+        yield NOOP_SPAN
+        return
+    trace_id, parent_span_id = parsed
+    root = Span(name, kind="remote", trace_id=trace_id, attributes=attributes)
+    root.parent_id = parent_span_id
+    token = _current_span.set(root)
+    sink_token = _remote_sink.set((root, sink))
+    _flush(root, sink)
+    try:
+        yield root
+    except BaseException as e:
+        root.finish(error=e)
+        raise
+    else:
+        root.finish()
+    finally:
+        _current_span.reset(token)
+        _remote_sink.reset(sink_token)
+        _flush(root, sink)
+
+
+def _flush(root, sink) -> None:
+    if sink is None:
+        return
+    try:
+        sink(root)
+    except Exception:  # best-effort: a read-only mount must not break operands
+        logging.getLogger(__name__).debug("span sink failed", exc_info=True)
+
+
+def flush_spans() -> None:
+    """Checkpoint-publish the active remote trace through its sink: loop
+    components (revalidation, serving re-probe, feature discovery) call
+    this each pass so their spans are visible before the process exits —
+    which for a DaemonSet main container is never."""
+    active = _remote_sink.get()
+    if active is not None:
+        _flush(*active)
 
 
 class FlightRecorder:
@@ -265,6 +418,9 @@ class Tracer:
                  metrics=None):
         self.recorder = recorder or FlightRecorder()
         self.metrics = metrics
+        #: optional subscriber called with every finalized root span (the
+        #: join profiler's feed); must never raise into a reconcile
+        self.on_finalize = None
 
     @contextlib.contextmanager
     def trace(self, name: str, controller: str, **attributes):
@@ -287,6 +443,12 @@ class Tracer:
 
     def _finalize(self, root: Span) -> None:
         self.recorder.record(root)
+        if self.on_finalize is not None:
+            try:
+                self.on_finalize(root)
+            except Exception:  # telemetry must never break a reconcile
+                logging.getLogger(__name__).debug(
+                    "trace finalize hook failed", exc_info=True)
         if self.metrics is None:
             return
         controller = str(root.attributes.get("controller", ""))

@@ -13,7 +13,7 @@ import os
 import time
 from typing import Dict, Optional
 
-from .. import consts
+from .. import consts, tracing
 from ..utils import deep_get
 from .driver import discover_devices
 
@@ -202,6 +202,19 @@ def sync_node_labels(client, node_name: str, use_jax: bool = True) -> Dict[str, 
         if detail != current_detail:
             client.patch("v1", "Node", node_name, {"metadata": {
                 "annotations": {consts.SERVING_SLO_ANNOTATION: detail}}})
+    # mirror the node's span log (operand entrypoints append their join
+    # spans there) up to the tpu.ai/trace-spans annotation, size-bounded,
+    # so the operator's JoinProfiler can stitch the end-to-end join trace.
+    # Same node-agent rationale as the health verdict: FD already reads
+    # the status hostPath and holds node patch rights.
+    from ..joinprofile.records import SpanLog, encode_annotation
+
+    spans_value = encode_annotation(SpanLog(status_dir).read())
+    current_spans = deep_get(node, "metadata", "annotations",
+                             consts.TRACE_SPANS_ANNOTATION)
+    if spans_value and spans_value != current_spans:
+        client.patch("v1", "Node", node_name, {"metadata": {
+            "annotations": {consts.TRACE_SPANS_ANNOTATION: spans_value}}})
     return desired
 
 
@@ -217,6 +230,10 @@ def run(client, node_name: Optional[str] = None, sleep_interval: float = 60.0,
             sync_node_labels(client, node_name)
         except Exception:
             log.exception("feature discovery pass failed")
+        # checkpoint-publish FD's own remote trace (its status-dir mount is
+        # read-only, so the sink write fails silently in-cluster — the open
+        # root published at entry is the best-effort record)
+        tracing.flush_spans()
         count += 1
         if iterations is not None and count >= iterations:
             break

@@ -37,7 +37,7 @@ import logging
 import os
 import sys
 
-from .. import consts
+from .. import consts, tracing
 from .status import StatusFiles
 
 log = logging.getLogger("tpu-validator")
@@ -220,17 +220,40 @@ def run(argv=None, client=None) -> int:
     logging.basicConfig(level=getattr(logging, args.log_level.upper()),
                         format="%(asctime)s %(levelname)s %(name)s: %(message)s")
     status = StatusFiles(args.status_dir)
+    # distributed join trace: the operator stamps TPU_TRACE_PARENT into
+    # every operand pod (common.j2 host_env); the root span opened here is
+    # a child of the operator-side join trace, and every record lands in
+    # the host-path span log feature discovery mirrors up. Free no-op
+    # when the env is absent (local/CI runs).
+    from ..joinprofile.records import SpanLog
+
+    with tracing.remote_trace(
+            f"operand.{args.component}",
+            traceparent=os.environ.get(tracing.TRACE_PARENT_ENV),
+            sink=SpanLog(args.status_dir).sink(),
+            component=args.component,
+            node=os.environ.get("NODE_NAME", "")) as root:
+        rc = _dispatch(args, status, client)
+        root.set_attribute("exit_code", rc)
+        return rc
+
+
+def _dispatch(args, status, client) -> int:
     component = args.component
     require_devices = not args.no_require_devices
 
     if component == "driver":
         from . import driver
 
-        if os.environ.get("TPU_USE_HOST_DRIVER") == "1":
-            # driver.enabled=false: adopt the platform's pre-installed
-            # libtpu (validateHostDriver analog, validator/main.go:694-708)
-            return 0 if driver.validate_host(status, require_devices) else 1
-        return 0 if driver.validate(args.install_dir, status, require_devices) else 1
+        with tracing.span("driver.validate") as sp:
+            if os.environ.get("TPU_USE_HOST_DRIVER") == "1":
+                # driver.enabled=false: adopt the platform's pre-installed
+                # libtpu (validateHostDriver analog, validator/main.go:694-708)
+                ok = driver.validate_host(status, require_devices)
+            else:
+                ok = driver.validate(args.install_dir, status, require_devices)
+            sp.set_attribute("passed", ok)
+        return 0 if ok else 1
 
     if component == "driver-daemon":
         from . import driver
@@ -246,8 +269,11 @@ def run(argv=None, client=None) -> int:
         from . import plugin
 
         client = client or make_client()
-        return 0 if plugin.validate(client, resource=args.resource, status=status,
-                                    timeout=args.timeout) else 1
+        with tracing.span("plugin.validate", resource=args.resource) as sp:
+            ok = plugin.validate(client, resource=args.resource, status=status,
+                                 timeout=args.timeout)
+            sp.set_attribute("passed", ok)
+        return 0 if ok else 1
 
     if component == "workload":
         from .workload import spawn_workload_pod
@@ -262,9 +288,11 @@ def run(argv=None, client=None) -> int:
         import time as _time
 
         spawn_start = _time.time()
-        ok = spawn_workload_pod(client, namespace, node_name, image,
-                                resource_name=args.resource, timeout=args.timeout,
-                                status_dir=args.status_dir)
+        with tracing.span("workload.spawn-pod", node=node_name) as sp:
+            ok = spawn_workload_pod(client, namespace, node_name, image,
+                                    resource_name=args.resource, timeout=args.timeout,
+                                    status_dir=args.status_dir)
+            sp.set_attribute("passed", bool(ok))
         # the pod mounts the status hostPath and its in-pod sweep writes the
         # DETAILED barrier (per-chip failed_chips) itself; a barrier stamped
         # after spawn is that write — preserve it, the parent only knows the
@@ -286,7 +314,18 @@ def run(argv=None, client=None) -> int:
     if component == "workload-local":
         from .workload import ici_health_check
 
-        report = ici_health_check(matrix_dim=args.matrix_dim)
+        import time as _time
+
+        sweep_start = _time.time()
+        with tracing.span("ici-sweep", matrix_dim=args.matrix_dim) as sp:
+            report = ici_health_check(matrix_dim=args.matrix_dim)
+            sp.set_attribute("passed", report.passed)
+            # the sweep measured its own compile internally — attach it as
+            # a pre-measured child so attribution can split xla-compile
+            # out of validation-run
+            if report.compile_s:
+                tracing.record_span("xla-compile", sweep_start,
+                                    report.compile_s)
         print(json.dumps(report.to_dict()))
         # a FAILED sweep is recorded too (passed: false): overwriting a
         # stale pass is what lets the device plugin's health gate and the
@@ -301,11 +340,20 @@ def run(argv=None, client=None) -> int:
         if not args.coordinator:
             log.error("workload-multihost: --coordinator required")
             return 1
+        import time as _time
+
+        sweep_start = _time.time()
         try:
-            report = run_multihost(args.coordinator, args.num_processes,
-                                   args.process_id,
-                                   matrix_dim=args.matrix_dim,
-                                   init_timeout=args.init_timeout)
+            with tracing.span("multihost.ici-sweep",
+                              num_processes=args.num_processes) as sp:
+                report = run_multihost(args.coordinator, args.num_processes,
+                                       args.process_id,
+                                       matrix_dim=args.matrix_dim,
+                                       init_timeout=args.init_timeout)
+                sp.set_attribute("passed", report.passed)
+                if report.compile_s:
+                    tracing.record_span("xla-compile", sweep_start,
+                                        report.compile_s)
         except Exception as e:
             # fail CLOSED: no barrier file, nonzero exit — a worker that
             # missed the rendezvous must never mark the slice validated
@@ -332,12 +380,14 @@ def run(argv=None, client=None) -> int:
         from .workload import enable_compilation_cache
 
         enable_compilation_cache()
-        report = run_perf(
-            matrix_dim=args.perf_matrix_dim, hbm_mib=args.perf_hbm_mib,
-            ici_mib=args.perf_ici_mib,
-            thresholds={"mxu_tflops": args.min_mxu_tflops,
-                        "hbm_gbps": args.min_hbm_gbps,
-                        "ici_allreduce_gbps": args.min_ici_gbps})
+        with tracing.span("perf.sweep") as sp:
+            report = run_perf(
+                matrix_dim=args.perf_matrix_dim, hbm_mib=args.perf_hbm_mib,
+                ici_mib=args.perf_ici_mib,
+                thresholds={"mxu_tflops": args.min_mxu_tflops,
+                            "hbm_gbps": args.min_hbm_gbps,
+                            "ici_allreduce_gbps": args.min_ici_gbps})
+            sp.set_attribute("passed", report.passed)
         print(json.dumps(report.to_dict()))
         if report.passed:
             status.write("perf", report.to_dict())
@@ -364,13 +414,19 @@ def run(argv=None, client=None) -> int:
                             "gate limited to TPU_HEALTH_STATE env", e)
 
         def probe_once() -> int:
-            return run_serving(
-                status, batch_sizes=batch_sizes or [1],
-                steps_per_batch=args.serving_steps,
-                max_decode_p99_ms=args.max_decode_p99_ms,
-                min_throughput_tokens_per_s=args.min_tokens_per_s,
-                min_slo_attainment=args.min_slo_attainment,
-                client=client)
+            with tracing.span("serving.probe") as sp:
+                rc = run_serving(
+                    status, batch_sizes=batch_sizes or [1],
+                    steps_per_batch=args.serving_steps,
+                    max_decode_p99_ms=args.max_decode_p99_ms,
+                    min_throughput_tokens_per_s=args.min_tokens_per_s,
+                    min_slo_attainment=args.min_slo_attainment,
+                    client=client)
+                sp.set_attribute("exit_code", rc)
+            # checkpoint-publish: the continuous-mode DS loop never exits,
+            # so each probe's spans must reach the log now
+            tracing.flush_spans()
+            return rc
 
         rc = probe_once()
         # continuous mode (DS main container): keep re-probing so a decode
@@ -396,7 +452,9 @@ def run(argv=None, client=None) -> int:
         return rc
 
     if component == "wait":
-        ok = status.wait_for(args.wait_for, timeout=args.timeout)
+        with tracing.span(f"barrier-wait.{args.wait_for}") as sp:
+            ok = status.wait_for(args.wait_for, timeout=args.timeout)
+            sp.set_attribute("passed", ok)
         if not ok:
             log.error("timed out waiting for %s barrier", args.wait_for)
         return 0 if ok else 1
@@ -422,12 +480,14 @@ def run(argv=None, client=None) -> int:
                 # the checkpoint persists on the host path
                 client = drain_watch(client, status)
                 try:
-                    revalidate_local(status, args.matrix_dim)
+                    with tracing.span("revalidate.ici-sweep"):
+                        revalidate_local(status, args.matrix_dim)
                 except Exception:
                     # never crash-loop the validator DS over a revalidation
                     # hiccup: its pods gate upgrades (VALIDATION_REQUIRED)
                     log.exception("revalidation cycle failed; retrying "
                                   "next interval")
+                tracing.flush_spans()
         log.info("all validations complete; sleeping")
         while True:
             time.sleep(args.sleep_interval)
