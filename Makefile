@@ -37,6 +37,13 @@ drain-soak:  ## coordinated drain/handoff acceptance soak: plan -> checkpoint-ac
 	CHAOS_SEED=$(DRAIN_SOAK_SEED) $(PYTHON) -m pytest \
 		tests/test_health_soak.py tests/test_drain.py -q
 
+CRASH_SOAK_SEED ?= 20260805
+
+.PHONY: crash-soak
+crash-soak:  ## coverage-complete crash-point matrix: kill the operator before AND after every mutating apiserver call of a full join->degrade->drain->retile->remediate->recover episode; every replay must converge (docs/design.md §12)
+	CRASH_SOAK_SEED=$(CRASH_SOAK_SEED) $(PYTHON) -m pytest \
+		tests/test_crash_soak.py tests/test_fencing.py tests/test_split_brain.py -q
+
 .PHONY: bench
 bench:
 	$(PYTHON) bench.py

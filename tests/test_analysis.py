@@ -480,6 +480,88 @@ def test_baseline_version_mismatch_rejected(tmp_path):
         baseline_mod.load(str(p))
 
 
+# -- unfenced-write -----------------------------------------------------------
+
+UNFENCED_CHAIN = """
+    from tpu_operator.client import RestClient
+    from tpu_operator.client.resilience import RetryingClient
+
+    def build(url):
+        return RetryingClient(RestClient(base_url=url))
+"""
+
+FENCED_CHAIN = """
+    from tpu_operator.client import RestClient
+    from tpu_operator.client.fenced import FencedClient
+    from tpu_operator.client.resilience import RetryingClient
+
+    def build(url, elector):
+        fenced = FencedClient(RestClient(base_url=url))
+        client = RetryingClient(fenced)
+        fenced.bind(elector)
+        return client
+"""
+
+
+def test_unfenced_write_positive_retrying_over_raw_transport():
+    kept, _ = lint(UNFENCED_CHAIN, "tpu_operator/controllers/manager.py",
+                   "unfenced-write")
+    assert rules_of(kept) == ["unfenced-write"]
+    assert "unfenced transport" in kept[0].message
+
+
+def test_unfenced_write_negative_fenced_chain():
+    kept, _ = lint(FENCED_CHAIN, "tpu_operator/controllers/manager.py",
+                   "unfenced-write")
+    assert kept == []
+
+
+def test_unfenced_write_negative_inline_fenced_chain():
+    src = """
+        from tpu_operator.client.fenced import FencedClient
+        from tpu_operator.client.resilience import RetryingClient
+
+        def build(transport, elector):
+            return RetryingClient(FencedClient(transport, fence=elector))
+    """
+    kept, _ = lint(src, "tpu_operator/cmd/operator.py", "unfenced-write")
+    assert kept == []
+
+
+def test_unfenced_write_positive_unbound_fence():
+    src = """
+        from tpu_operator.client.fenced import FencedClient
+        from tpu_operator.client.resilience import RetryingClient
+
+        def build(transport):
+            fenced = FencedClient(transport)
+            return RetryingClient(fenced)
+    """
+    kept, _ = lint(src, "tpu_operator/controllers/manager.py",
+                   "unfenced-write")
+    assert rules_of(kept) == ["unfenced-write"]
+    assert "never bound" in kept[0].message
+
+
+def test_unfenced_write_out_of_scope_dirs_skipped():
+    # the node validator agent holds no Lease — nothing to fence; and the
+    # client stack's own modules define these classes
+    for rel in ("tpu_operator/validator/main.py",
+                "tpu_operator/client/resilience.py"):
+        kept, _ = lint(UNFENCED_CHAIN, rel, "unfenced-write")
+        assert kept == [], rel
+
+
+def test_unfenced_write_suppressed():
+    src = UNFENCED_CHAIN.replace(
+        "RetryingClient(RestClient(base_url=url))",
+        "RetryingClient(RestClient(base_url=url))  "
+        "# opalint: disable=unfenced-write — read-only diagnostic chain")
+    kept, dropped = lint(src, "tpu_operator/controllers/manager.py",
+                         "unfenced-write")
+    assert kept == [] and dropped == 1
+
+
 # -- CLI ----------------------------------------------------------------------
 
 POSITIVE_FIXTURES = {
@@ -509,6 +591,7 @@ POSITIVE_FIXTURES = {
             sp = tracing.span("render")
             return sp
     """),
+    "unfenced-write": ("tpu_operator/controllers/manager.py", UNFENCED_CHAIN),
 }
 
 

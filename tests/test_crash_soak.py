@@ -1,0 +1,459 @@
+"""Exhaustive crash-point injection across the reconcile episode.
+
+The drain soak (test_health_soak.py) proves ONE mid-drain operator kill is
+survivable. This suite proves ALL of them are: a record-mode episode
+enumerates every mutating apiserver call site the full
+join -> degrade -> drain -> retile -> remediate -> recover episode makes
+through the operator's client, then the matrix replays the episode once
+per (site, before|after) pair with :class:`CrashPointClient` armed to
+simulate a process kill immediately before or after that exact write. The
+killed operator is cold-restarted on a fresh client stack and must resume
+from cluster state alone.
+
+Convergence invariants, asserted after every replay:
+
+  - the terminal node label/annotation state is IDENTICAL to the
+    crash-free baseline (volatile keys — flap-history stamps, trace-span
+    mirrors — excluded)
+  - exactly one ``RetilePlanned`` Event, zero ``NodeHealthFlapping``
+  - exactly one ``NodeHealthRemediating`` Event (zero duplicate
+    remediation attempts)
+  - the training job resumes from its acked checkpoint: zero steps lost
+    beyond the drain window
+  - the configured slice layout is restored exactly
+
+Coverage is COMPLETE, not sampled: a replay whose armed site never fires
+fails ("uncovered crash site"), and any site observed in a replay that the
+record run missed fails the whole matrix. ``make crash-soak`` runs the
+slow full matrix with CRASH_SOAK_SEED pinning the replay order.
+"""
+
+import os
+import random
+import time
+
+import pytest
+import requests
+
+from tpu_operator import consts
+from tpu_operator.api.clusterpolicy import new_cluster_policy
+from tpu_operator.client.cache import CachedClient
+from tpu_operator.client.chaos import (
+    CrashPointClient,
+    OperatorCrashed,
+    crash_site,
+)
+from tpu_operator.client.errors import ApiError
+from tpu_operator.client.fake import FakeClient
+from tpu_operator.client.rest import RestClient
+from tpu_operator.controllers.manager import OperatorApp
+from tpu_operator.health import REMEDIATING, drain, node_health_state
+from tpu_operator.partitioner import sync_once
+from tpu_operator.partitioner.partitioner import read_handoff
+from tpu_operator.testing import MiniApiServer, SimulatedTrainingJob
+from tpu_operator.testing.kubelet import KubeletSimulator
+from tpu_operator.utils import deep_get
+from tpu_operator.validator.feature_discovery import sync_node_labels
+from tpu_operator.validator.status import StatusFiles
+
+TPU_LABELS = {
+    consts.GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice",
+    consts.GKE_TPU_TOPOLOGY_LABEL: "2x4",
+}
+
+PARTITIONS = "version: v1\npartitions:\n  single-chip:\n    - {chips: 1, topology: 1x1, count: all}\n"
+
+#: annotation keys whose values are run-dependent (timestamps, span ids):
+#: excluded from the terminal-state fingerprint the replays must reproduce
+VOLATILE_ANNOTATIONS = (
+    consts.HEALTH_FLAP_HISTORY_ANNOTATION,
+    consts.TRACE_SPANS_ANNOTATION,
+)
+
+#: the health-episode Events whose multiplicity the invariants pin down
+EVENT_REASONS = ("RetilePlanned", "NodeHealthFlapping",
+                 "NodeHealthRemediating", "NodeHealthDegraded",
+                 "NodeHealthQuarantined", "NodeHealthRecovered",
+                 "RetileDeadlineExpired")
+
+
+@pytest.fixture(autouse=True)
+def default_images(monkeypatch):
+    for env in ("DRIVER_IMAGE", "VALIDATOR_IMAGE", "FEATURE_DISCOVERY_IMAGE",
+                "TELEMETRY_EXPORTER_IMAGE", "SLICE_PARTITIONER_IMAGE",
+                "DEVICE_PLUGIN_IMAGE"):
+        monkeypatch.setenv(env, "gcr.io/tpu/x:0.1.0")
+
+
+def barrier(passed, failed=None):
+    payload = {"passed": passed, "n_devices": 8,
+               "local_chips": list(range(8))}
+    if failed is not None:
+        payload["failed_local_chips"] = list(failed)
+    return payload
+
+
+class CrashEpisode:
+    """One full drain/retile episode with an optional armed crash point.
+
+    The operator runs on ``CachedClient(CrashPointClient(RestClient))``;
+    node agents and assertions use a separate plain client (agents are
+    separate processes — a dying operator cannot take them down). Every
+    wait loop polls :meth:`maybe_restart`, so the kill is followed by a
+    cold restart as soon as the harness notices — like a DaemonSet
+    restarting a crashed operator pod."""
+
+    def __init__(self, tmp_path, monkeypatch, arm=None):
+        tmp_path.mkdir(parents=True, exist_ok=True)
+        devdir = tmp_path / "dev"
+        devdir.mkdir()
+        for i in range(8):
+            (devdir / f"accel{i}").write_text("")
+        monkeypatch.setenv("TPU_DEV_GLOBS", str(devdir / "accel*"))
+        self.monkeypatch = monkeypatch
+        self.config_path = tmp_path / "partitions.yaml"
+        self.config_path.write_text(PARTITIONS)
+
+        self.srv = MiniApiServer()
+        self.base = self.srv.start()
+        self.chaos = RestClient(base_url=self.base)
+        crash = CrashPointClient(RestClient(base_url=self.base), arm=arm)
+        self.crashpoints = [crash]
+        op_client = CachedClient(crash)
+        self.kubelet = KubeletSimulator(self.chaos, interval=0.05,
+                                        create_pods=True).start()
+        self.app = OperatorApp(op_client)
+        self.apps = [self.app]
+        self.clients = [op_client]
+        self.crashes = 0
+
+        node_dir = tmp_path / "tpu-a"
+        self.status = StatusFiles(str(node_dir / "status"))
+        self.status.write("workload", barrier(True))
+        self.handoff = str(node_dir / "handoff")
+        self.chaos.create({"apiVersion": "v1", "kind": "Node",
+                           "metadata": {"name": "tpu-a",
+                                        "labels": dict(TPU_LABELS)},
+                           "status": {}})
+
+    # -- crash/restart plumbing -----------------------------------------------
+    def maybe_restart(self):
+        """Cold-restart the operator if the live one just died at its
+        crash point: fresh RestClient, fresh informer cache, UNARMED
+        crash-point recorder (its sites still count toward coverage)."""
+        if not self.crashpoints[-1].dead:
+            return
+        self.apps[-1].stop()
+        self.clients[-1].stop()
+        crash = CrashPointClient(RestClient(base_url=self.base))
+        client = CachedClient(crash)
+        app = OperatorApp(client)
+        self.crashpoints.append(crash)
+        self.clients.append(client)
+        self.apps.append(app)
+        app.start()
+        self.crashes += 1
+
+    def wait(self, predicate, timeout=60.0, message="condition"):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            self.maybe_restart()
+            try:
+                if predicate():
+                    return
+            except (ApiError, requests.RequestException):
+                pass
+            time.sleep(0.02)
+        raise AssertionError(f"timed out waiting for {message}")
+
+    # -- cluster access (assertion client, never crash-injected) ---------------
+    def agent_pass(self):
+        self.monkeypatch.setenv("STATUS_DIR", self.status.directory)
+        sync_node_labels(self.chaos, "tpu-a", use_jax=False)
+        sync_once(self.chaos, "tpu-a", str(self.config_path), self.handoff,
+                  status_dir=self.status.directory, drain_deadline_s=120)
+
+    def node(self):
+        return self.chaos.get("v1", "Node", "tpu-a")
+
+    def health(self):
+        return node_health_state(self.node())
+
+    def slice_state(self):
+        return deep_get(self.node(), "metadata", "labels",
+                        consts.TPU_SLICE_STATE_LABEL)
+
+    def annotations(self):
+        return deep_get(self.node(), "metadata", "annotations",
+                        default={}) or {}
+
+    def event_count(self, reason):
+        """Occurrences of a node-scoped Event (aggregation bumps count, so
+        the sum is emissions, not objects). The ClusterPolicy rollup
+        re-uses some reasons for fleet summaries — only tpu-a's own
+        incident narration is pinned by the invariants."""
+        return sum(e.get("count", 1)
+                   for e in self.chaos.list("v1", "Event", "tpu-operator")
+                   if e.get("reason") == reason
+                   and deep_get(e, "involvedObject", "name") == "tpu-a")
+
+    def terminal_state(self):
+        node = self.node()
+        return {
+            "labels": dict(deep_get(node, "metadata", "labels",
+                                    default={}) or {}),
+            "annotations": {k: v for k, v in self.annotations().items()
+                            if k not in VOLATILE_ANNOTATIONS},
+            "unschedulable": bool(deep_get(node, "spec", "unschedulable")),
+        }
+
+    def all_sites(self):
+        out = set()
+        for crash in self.crashpoints:
+            out.update(crash.sites)
+        return out
+
+    # -- the episode -----------------------------------------------------------
+    def install(self):
+        self.chaos.create(new_cluster_policy())
+        self.app.start()
+        self.wait(lambda: deep_get(
+            self.chaos.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy"),
+            "status", "state") == "ready", message="initial install ready")
+        self.chaos.patch("v1", "Node", "tpu-a", {"metadata": {"labels": {
+            consts.TPU_SLICE_CONFIG_LABEL: "single-chip"}}})
+        self.agent_pass()
+        assert self.slice_state() == "success"
+        self.wait(lambda: self.health() == "",
+                  message="healthy in steady state")
+
+    def run(self):
+        """The full scripted episode. Returns the run's summary for the
+        matrix's invariant comparison."""
+        self.install()
+        original = read_handoff(self.handoff)["groups"]
+        assert len(original) == 8
+
+        job = SimulatedTrainingJob(self.chaos, "tpu-a", self.status)
+        for _ in range(5):
+            job.tick()
+
+        # -- chip 2 degrades mid-"training" -----------------------------------
+        self.status.write("workload", barrier(False, failed=[2]))
+        self.agent_pass()
+        self.wait(lambda: drain.node_plan(self.node()) is not None,
+                  message="RetilePlanned annotation published")
+        plan = drain.node_plan(self.node())
+
+        # -- the workload acks: checkpoint + barrier stamp ---------------------
+        job.tick()  # sees the plan, checkpoints, stamps the ack
+        ack_step = job.step
+        assert job.acked_plans == [plan.fingerprint]
+        for _ in range(2):
+            job.tick()  # in-window steps AFTER the checkpoint
+        self.agent_pass()  # FD mirrors the ack, the partitioner migrates
+        self.wait(lambda: self.slice_state() == "retiled",
+                  message="incremental re-tile")
+        self.wait(lambda: self.health() == REMEDIATING,
+                  message="ack released remediation")
+
+        # -- the recycle hits the job; it resumes from the checkpoint ----------
+        job.crash()
+        resume_step = job.resume()
+        job.tick()
+
+        # -- revalidation passes: recovery retires the episode -----------------
+        healthy = barrier(True)
+        healthy["drain_ack"] = drain.read_drain_ack(self.status)
+        self.status.write("workload", healthy)
+        self.agent_pass()
+        self.wait(lambda: self.health() == "", message="healthy again")
+        drain.maybe_ack_plan(self.chaos, "tpu-a", self.status)
+        assert drain.read_drain_ack(self.status) is None
+        self.agent_pass()
+        self.wait(lambda: not (set(self.annotations())
+                               & {consts.RETILE_PLAN_ANNOTATION,
+                                  consts.DRAIN_ACK_ANNOTATION,
+                                  consts.HEALTH_ATTEMPTS_ANNOTATION}),
+                  message="episode artifacts retired")
+        self.agent_pass()
+        self.wait(lambda: self.slice_state() == "success",
+                  message="configured layout restored")
+        self.wait(lambda: read_handoff(self.handoff)["groups"] == original,
+                  message="handoff restored")
+
+        return {
+            "terminal": self.terminal_state(),
+            "events": {r: self.event_count(r) for r in EVENT_REASONS},
+            "ack_step": ack_step,
+            "resume_step": resume_step,
+            "sites": list(self.crashpoints[0].sites),
+            "all_sites": self.all_sites(),
+            "fired": self.crashpoints[0].fired,
+            "crashes": self.crashes,
+        }
+
+    def teardown(self):
+        for app in self.apps:
+            app.stop()
+        for client in self.clients:
+            client.stop()
+        self.kubelet.stop()
+        self.srv.stop()
+
+
+def run_episode(tmp_path, monkeypatch, arm=None):
+    episode = CrashEpisode(tmp_path, monkeypatch, arm=arm)
+    try:
+        return episode.run()
+    finally:
+        episode.teardown()
+
+
+def check_invariants(summary, baseline):
+    """The convergence contract every crash replay must satisfy."""
+    assert summary["terminal"] == baseline["terminal"], \
+        "terminal node state diverged from the crash-free baseline"
+    assert summary["events"]["RetilePlanned"] == 1, \
+        f"RetilePlanned must fire exactly once, saw {summary['events']}"
+    assert summary["events"]["NodeHealthFlapping"] == 0
+    assert summary["events"]["NodeHealthRemediating"] == 1, \
+        "duplicate (or lost) remediation attempt"
+    assert summary["events"]["RetileDeadlineExpired"] == 0, \
+        "a crash must not burn the drain window"
+    # every other episode Event may be lost to a kill between the state
+    # label landing and its announcement, but never duplicated
+    for reason in ("NodeHealthDegraded", "NodeHealthQuarantined",
+                   "NodeHealthRecovered"):
+        assert summary["events"][reason] <= 1, f"duplicate {reason}"
+    assert summary["resume_step"] == summary["ack_step"], \
+        "resume must land exactly on the acked checkpoint"
+    assert summary["ack_step"] >= 5, "pre-plan steps were lost"
+
+
+# -- fast lane (tier-1): site-key semantics + a sampled kill -------------------
+
+def test_crash_site_normalizes_event_names():
+    event = {"apiVersion": "v1", "kind": "Event",
+             "metadata": {"name": "tpu-a.a1b2c3d4e5f6"},
+             "involvedObject": {"kind": "Node", "name": "tpu-a"},
+             "reason": "NodeHealthDegraded"}
+    site = crash_site("POST", None, None, None, obj=event)
+    assert site == "POST Event/Node:tpu-a:NodeHealthDegraded"
+    event2 = dict(event, metadata={"name": "tpu-a.ffffffffffff"})
+    assert crash_site("POST", None, None, None, obj=event2) == site
+
+
+def test_crash_site_patch_shape_not_values():
+    a = crash_site("PATCH", "v1", "Node", "tpu-a",
+                   patch={"metadata": {"labels": {"x": "1"},
+                                       "resourceVersion": "42"}})
+    b = crash_site("PATCH", "v1", "Node", "tpu-a",
+                   patch={"metadata": {"labels": {"x": "2"}}})
+    assert a == b  # same shape, different value + precondition: one site
+    c = crash_site("PATCH", "v1", "Node", "tpu-a",
+                   patch={"metadata": {"annotations": {"x": "1"}}})
+    assert a != c  # different shape: different site
+
+
+def test_crash_point_client_before_and_after():
+    site = crash_site("PATCH", "v1", "Node", "n1",
+                      patch={"metadata": {"labels": {"x": "1"}}})
+    for when, landed in (("before", False), ("after", True)):
+        fake = FakeClient()
+        fake.create({"apiVersion": "v1", "kind": "Node",
+                     "metadata": {"name": "n1"}})
+        client = CrashPointClient(fake, arm=(site, when))
+        with pytest.raises(OperatorCrashed):
+            client.patch("v1", "Node", "n1",
+                         {"metadata": {"labels": {"x": "1"}}})
+        assert client.fired and client.dead
+        got = deep_get(fake.get("v1", "Node", "n1"),
+                       "metadata", "labels", "x")
+        assert (got == "1") is landed
+        # dead client: nothing gets through any more, reads included
+        with pytest.raises(OperatorCrashed):
+            client.get("v1", "Node", "n1")
+        with pytest.raises(OperatorCrashed):
+            client.delete("v1", "Node", "n1")
+
+
+def test_crash_point_client_records_sites_in_order():
+    fake = FakeClient()
+    client = CrashPointClient(fake)
+    client.create({"apiVersion": "v1", "kind": "Node",
+                   "metadata": {"name": "n1"}})
+    client.patch("v1", "Node", "n1", {"metadata": {"labels": {"x": "1"}}})
+    client.patch("v1", "Node", "n1", {"metadata": {"labels": {"x": "2"}}})
+    client.delete("v1", "Node", "n1")
+    assert client.sites == [
+        "POST Node/n1",
+        "PATCH Node/n1 [metadata.labels.x]",
+        "DELETE Node/n1",
+    ]
+    assert not client.fired
+
+
+def test_crash_episode_baseline_and_sampled_kills(tmp_path, monkeypatch):
+    """Tier-1 smoke: the crash-free baseline satisfies its own invariants
+    and enumerates a non-trivial site set; one before-kill and one
+    after-kill on the drain protocol's most delicate write (the plan
+    annotation) both converge. The full matrix is the slow test below."""
+    baseline = run_episode(tmp_path / "baseline", monkeypatch)
+    check_invariants(baseline, baseline)
+    assert baseline["crashes"] == 0 and not baseline["fired"]
+    assert len(baseline["sites"]) >= 10, baseline["sites"]
+
+    plan_sites = [s for s in baseline["sites"]
+                  if consts.RETILE_PLAN_ANNOTATION in s and "PATCH" in s]
+    assert plan_sites, baseline["sites"]
+    for i, when in enumerate(("before", "after")):
+        summary = run_episode(tmp_path / f"kill{i}", monkeypatch,
+                              arm=(plan_sites[0], when))
+        assert summary["fired"], f"site {plan_sites[0]!r} never re-fired"
+        assert summary["crashes"] == 1
+        check_invariants(summary, baseline)
+
+
+# -- the full matrix (make crash-soak) -----------------------------------------
+
+@pytest.mark.slow
+def test_crash_point_matrix_full_episode(tmp_path, monkeypatch):
+    """Coverage-complete: every mutating site the episode exercises is
+    killed both before and after its write, and every replay converges."""
+    baseline = run_episode(tmp_path / "baseline", monkeypatch)
+    check_invariants(baseline, baseline)
+    sites = baseline["sites"]
+    assert len(sites) >= 10, sites
+
+    matrix = [(site, when) for site in sites for when in ("before", "after")]
+    rng = random.Random(int(os.environ.get("CRASH_SOAK_SEED", "20260805")))
+    rng.shuffle(matrix)  # replay order must not matter; the seed pins it
+
+    observed = set(sites)
+    failures = []
+    for i, (site, when) in enumerate(matrix):
+        summary = run_episode(tmp_path / f"ep{i}", monkeypatch,
+                              arm=(site, when))
+        observed |= summary["all_sites"]
+        if not summary["fired"]:
+            failures.append(f"uncovered crash site ({when}): {site}")
+            continue
+        try:
+            check_invariants(summary, baseline)
+        except AssertionError as e:
+            failures.append(f"kill {when} {site}: {e}")
+    # the self-audit: a STATE write pathway the record run never saw means
+    # the matrix is sampling, not covering — fail the whole run. Event
+    # emissions are excluded: their multiplicity is already pinned by the
+    # per-replay invariants, and which announcement *variant* a crashed
+    # run produces is a consequence of the injected kill itself (a benign
+    # post-restart not-ready dip mints a ReconcileFailed, re-announcing
+    # Ready aggregates into a PUT) — unreachable from any crash-free
+    # record run by construction.
+    uncovered = {s for s in observed - set(sites) if " Event/" not in s}
+    if uncovered:
+        failures.append(
+            "state-mutating sites outside the replay matrix (record run "
+            f"missed them): {sorted(uncovered)}")
+    assert not failures, "\n".join(failures)

@@ -1,0 +1,315 @@
+"""Unit coverage for the leader write fence and its supporting layers:
+FencedClient admission, the monotonic leader epoch on the Lease,
+resourceVersion-preconditioned patches, and the runtime's FencedError
+requeue discipline. The end-to-end proof lives in test_split_brain.py;
+these pin the individual contracts."""
+
+import threading
+import time
+
+import pytest
+
+from tpu_operator import consts
+from tpu_operator.client.cache import CachedClient
+from tpu_operator.client.errors import ConflictError, FencedError
+from tpu_operator.client.fake import FakeClient
+from tpu_operator.client.fenced import FencedClient, find_fenced
+from tpu_operator.client.preconditions import preconditioned_patch
+from tpu_operator.client.resilience import CircuitBreaker, RetryingClient
+from tpu_operator.controllers.leader import LeaderElector, lease_epoch
+from tpu_operator.controllers.runtime import (
+    Controller,
+    Reconciler,
+    Request,
+    Result,
+)
+from tpu_operator.utils import deep_get
+
+
+class Fence:
+    """Minimal elector live-view stub: current_epoch() -> Optional[int]."""
+
+    def __init__(self, epoch=None):
+        self.epoch = epoch
+
+    def current_epoch(self):
+        return self.epoch
+
+
+def _node(name="n1"):
+    return {"apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": name, "labels": {}}}
+
+
+# -- FencedClient admission ----------------------------------------------------
+
+def test_unbound_fence_is_a_passthrough():
+    inner = FakeClient()
+    client = FencedClient(inner)
+    client.create(_node())
+    client.patch("v1", "Node", "n1", {"metadata": {"labels": {"x": "1"}}})
+    assert deep_get(inner.get("v1", "Node", "n1"),
+                    "metadata", "labels", "x") == "1"
+    assert client.fenced_total == 0
+    # unbound: nothing is epoch-stamped either
+    assert client.last_dispatched_epoch is None
+
+
+def test_leader_writes_dispatch_with_epoch_stamped():
+    inner = FakeClient()
+    client = FencedClient(inner)
+    client.bind(Fence(epoch=3))
+    client.create(_node())
+    client.patch("v1", "Node", "n1", {"metadata": {"labels": {"x": "1"}}})
+    assert client.dispatched_total == 2
+    assert client.last_dispatched_epoch == 3
+    assert client.fenced_total == 0
+
+
+def test_deposed_replica_every_mutating_verb_fenced():
+    inner = FakeClient()
+    inner.create(_node())
+    before = inner.get("v1", "Node", "n1")
+    rejected = []
+    client = FencedClient(inner, fence=Fence(epoch=None),
+                          on_fenced=rejected.append)
+    attempts = [
+        ("POST", lambda: client.create(_node("n2"))),
+        ("PUT", lambda: client.update(dict(before))),
+        ("PATCH", lambda: client.patch("v1", "Node", "n1",
+                                       {"metadata": {"labels": {"x": "1"}}})),
+        ("DELETE", lambda: client.delete("v1", "Node", "n1")),
+        ("PUT", lambda: client.update_status(dict(before))),
+        ("EVICT", lambda: client.evict("p1", "ns")),
+    ]
+    for _, attempt in attempts:
+        with pytest.raises(FencedError):
+            attempt()
+    assert client.fenced_total == len(attempts)
+    assert rejected == [verb for verb, _ in attempts]
+    assert client.fenced_by_verb == {"POST": 1, "PUT": 2, "PATCH": 1,
+                                     "DELETE": 1, "EVICT": 1}
+    assert client.dispatched_total == 0
+    # nothing landed: the inner store is byte-identical
+    assert inner.get("v1", "Node", "n1") == before
+    with pytest.raises(Exception):
+        inner.get("v1", "Node", "n2")
+
+
+def test_lease_traffic_bypasses_the_fence():
+    """The elector must always be able to renew/release — fencing the
+    object that DEFINES leadership would deadlock re-acquisition."""
+    inner = FakeClient()
+    client = FencedClient(inner, fence=Fence(epoch=None))
+    lease = {"apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
+             "metadata": {"name": "l1", "namespace": "ns"},
+             "spec": {"holderIdentity": "a"}}
+    client.create(lease)
+    created = client.get("coordination.k8s.io/v1", "Lease", "l1", "ns")
+    created["spec"]["holderIdentity"] = "b"
+    client.update(created)
+    assert deep_get(inner.get("coordination.k8s.io/v1", "Lease", "l1", "ns"),
+                    "spec", "holderIdentity") == "b"
+    assert client.fenced_total == 0
+
+
+def test_reads_pass_through_when_deposed():
+    inner = FakeClient()
+    inner.create(_node())
+    client = FencedClient(inner, fence=Fence(epoch=None))
+    assert client.get("v1", "Node", "n1")["metadata"]["name"] == "n1"
+    assert [n["metadata"]["name"]
+            for n in client.list("v1", "Node")] == ["n1"]
+
+
+def test_fenced_error_not_retried_and_never_charges_breaker():
+    """FencedError is non-transient: the retry layer must raise it
+    immediately (retrying from a deposed replica IS the stale traffic the
+    fence exists to stop) and must not count it toward the breaker — the
+    server was never asked."""
+    calls = {"n": 0}
+
+    class CountingFake(FakeClient):
+        def patch(self, *a, **kw):
+            calls["n"] += 1
+            return super().patch(*a, **kw)
+
+    breaker = CircuitBreaker(threshold=2)
+    client = RetryingClient(FencedClient(CountingFake(), fence=Fence(None)),
+                            breaker=breaker)
+    for _ in range(5):
+        with pytest.raises(FencedError):
+            client.patch("v1", "Node", "n1",
+                         {"metadata": {"labels": {"x": "1"}}})
+    assert calls["n"] == 0, "a fenced write reached the transport"
+    assert breaker.snapshot()["state"] == "closed", \
+        "fenced rejections charged the breaker"
+
+
+def test_find_fenced_walks_the_production_chain():
+    fenced = FencedClient(FakeClient())
+    chain = CachedClient(RetryingClient(fenced))
+    try:
+        assert find_fenced(chain) is fenced
+    finally:
+        chain.stop()
+    assert find_fenced(FakeClient()) is None
+    assert find_fenced(None) is None
+
+
+# -- the leader epoch ----------------------------------------------------------
+
+def test_lease_epoch_parses_annotation():
+    assert lease_epoch({}) == 0
+    assert lease_epoch({"metadata": {"annotations": {
+        consts.LEADER_EPOCH_ANNOTATION: "7"}}}) == 7
+    assert lease_epoch({"metadata": {"annotations": {
+        consts.LEADER_EPOCH_ANNOTATION: "junk"}}}) == 0
+
+
+def _elector(client, ident, **kw):
+    defaults = dict(lease_duration=2.0, renew_period=0.1, retry_period=0.05)
+    defaults.update(kw)
+    return LeaderElector(client, "tpu-operator", identity=ident, **defaults)
+
+
+def test_first_acquisition_mints_epoch_one(fake_client):
+    e = _elector(fake_client, "a")
+    assert e.try_acquire_or_renew()
+    lease = fake_client.get("coordination.k8s.io/v1", "Lease",
+                            "tpu-operator-leader", "tpu-operator")
+    assert lease_epoch(lease) == 1
+    assert e.epoch == 1
+    # the live view answers only while leadership is actually held
+    assert e.current_epoch() is None
+    e.is_leader.set()
+    assert e.current_epoch() == 1
+
+
+def test_renewals_never_bump_the_epoch(fake_client):
+    e = _elector(fake_client, "a")
+    assert e.try_acquire_or_renew()
+    for _ in range(3):
+        assert e.try_acquire_or_renew()
+    lease = fake_client.get("coordination.k8s.io/v1", "Lease",
+                            "tpu-operator-leader", "tpu-operator")
+    assert lease_epoch(lease) == 1
+
+
+def test_takeover_bumps_epoch_exactly_once(fake_client):
+    a = _elector(fake_client, "a")
+    assert a.try_acquire_or_renew()
+    # expire a's lease without waiting out the wall clock
+    lease = fake_client.get("coordination.k8s.io/v1", "Lease",
+                            "tpu-operator-leader", "tpu-operator")
+    lease["spec"]["renewTime"] = "1970-01-01T00:00:00.000000Z"
+    fake_client.update(lease)
+    b = _elector(fake_client, "b")
+    assert b.try_acquire_or_renew()
+    lease = fake_client.get("coordination.k8s.io/v1", "Lease",
+                            "tpu-operator-leader", "tpu-operator")
+    assert lease["spec"]["holderIdentity"] == "b"
+    assert lease_epoch(lease) == 2
+    assert b.epoch == 2
+
+
+# -- preconditioned patches ----------------------------------------------------
+
+def test_preconditioned_patch_applies_and_stamps_rv(fake_client):
+    fake_client.create(_node())
+    rv_before = deep_get(fake_client.get("v1", "Node", "n1"),
+                         "metadata", "resourceVersion")
+    seen = []
+
+    def build(fresh):
+        seen.append(deep_get(fresh, "metadata", "resourceVersion"))
+        return {"metadata": {"labels": {"x": "1"}}}
+
+    out = preconditioned_patch(fake_client, "v1", "Node", "n1", build)
+    assert deep_get(out, "metadata", "labels", "x") == "1"
+    assert seen == [rv_before]
+
+
+def test_preconditioned_patch_rereads_and_reapplies_on_conflict(fake_client):
+    fake_client.create(_node())
+    real_patch = fake_client.patch
+    raced = {"done": False}
+
+    def racing_patch(api_version, kind, name, patch, namespace=None):
+        if not raced["done"]:
+            # a competing writer lands between the read and this patch
+            raced["done"] = True
+            real_patch("v1", "Node", "n1",
+                       {"metadata": {"labels": {"winner": "other"}}})
+        return real_patch(api_version, kind, name, patch, namespace)
+
+    fake_client.patch = racing_patch
+
+    def build(fresh):
+        # derived from the object: proves the retry recomputes, not replays
+        labels = deep_get(fresh, "metadata", "labels", default={}) or {}
+        return {"metadata": {"labels": {
+            "derived": "with-winner" if "winner" in labels else "alone"}}}
+
+    preconditioned_patch(fake_client, "v1", "Node", "n1", build,
+                         sleep=lambda s: None)
+    final = fake_client.get("v1", "Node", "n1")
+    assert deep_get(final, "metadata", "labels", "winner") == "other", \
+        "the competing write was clobbered"
+    assert deep_get(final, "metadata", "labels", "derived") == "with-winner", \
+        "the retry replayed the stale mutation instead of recomputing"
+
+
+def test_preconditioned_patch_decline_writes_nothing(fake_client):
+    fake_client.create(_node())
+    before = fake_client.get("v1", "Node", "n1")
+    out = preconditioned_patch(fake_client, "v1", "Node", "n1",
+                               lambda fresh: None)
+    assert out == before
+    assert fake_client.get("v1", "Node", "n1") == before
+
+
+def test_preconditioned_patch_bounded_conflict_budget(fake_client):
+    fake_client.create(_node())
+    attempts = {"n": 0}
+
+    def always_conflict(*a, **kw):
+        attempts["n"] += 1
+        raise ConflictError("busy", code=409)
+
+    fake_client.patch = always_conflict
+    with pytest.raises(ConflictError):
+        preconditioned_patch(fake_client, "v1", "Node", "n1",
+                             lambda fresh: {"metadata": {}},
+                             attempts=3, sleep=lambda s: None)
+    assert attempts["n"] == 3
+
+
+# -- runtime requeue discipline ------------------------------------------------
+
+def test_runtime_requeues_fenced_error_without_error_count(fake_client):
+    """A deposed replica's reconcile hitting the fence is split-brain
+    protection working, not a failure: no backoff growth, no error count,
+    plain requeue — so the sweep re-runs cleanly if leadership returns."""
+    calls = []
+    done = threading.Event()
+
+    class Deposed(Reconciler):
+        name = "deposed"
+
+        def reconcile(self, request: Request) -> Result:
+            calls.append(time.monotonic())
+            if len(calls) == 1:
+                raise FencedError("not the leader", epoch=1)
+            done.set()
+            return Result()
+
+    controller = Controller(Deposed())
+    controller.queue.add(Request("x"))
+    controller.start(fake_client)
+    try:
+        assert done.wait(timeout=5), "fenced request was never requeued"
+        assert controller.queue._failures == {}, \
+            "FencedError grew the error backoff"
+    finally:
+        controller.stop()

@@ -108,6 +108,32 @@ def test_span_log_tolerates_corruption(tmp_path):
     assert [r["i"] for r in logf.read()] == ["ok1"]
 
 
+def test_span_log_tolerates_torn_writes(tmp_path, caplog):
+    """A kill mid-append (or a racing non-atomic writer) can leave a
+    truncated or binary-garbage file behind. Reads must come back
+    empty-with-warning — never raise — and the next append must recover
+    the file wholesale."""
+    import logging
+
+    logf = SpanLog(str(tmp_path))
+    good = json.dumps([rec("ok1", 1.0), rec("ok2", 2.0)])
+    for torn in (good[: len(good) // 2],        # truncated mid-record
+                 good + "]",                    # trailing garbage
+                 "[",                           # cut at the opening byte
+                 ""):                           # zero-length file
+        (tmp_path / "trace-spans.json").write_text(torn)
+        with caplog.at_level(logging.WARNING, "tpu_operator.joinprofile.records"):
+            caplog.clear()
+            assert logf.read() == []
+        assert any("treating as empty" in r.message for r in caplog.records)
+    # invalid UTF-8: binary garbage where JSON should be
+    (tmp_path / "trace-spans.json").write_bytes(b"\xff\xfe\x00garbage\x80")
+    assert logf.read() == []
+    # the log self-heals: the next atomic append replaces the torn file
+    assert logf.append([rec("fresh", 3.0)])
+    assert [r["i"] for r in logf.read()] == ["fresh"]
+
+
 def test_flush_spans_checkpoints_long_loops(tmp_path):
     """A never-exiting loop's spans reach the log via flush_spans without
     waiting for a process exit that never comes."""

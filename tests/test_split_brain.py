@@ -1,0 +1,352 @@
+"""Split-brain soak: two live operator replicas, one asymmetric partition.
+
+The crash soak (test_crash_soak.py) proves a single operator survives
+dying at any write. This suite proves TWO operators cannot corrupt each
+other: replica A holds leadership, then loses access to the coordination
+API *only* (the classic asymmetric partition — A still reaches the
+apiserver for every other resource, so its reconcile workers keep
+computing writes from watch events it continues to receive). The contract
+under test is the full fencing chain built in run_operator:
+
+  - A deposes itself at its renew deadline, STRICTLY before the lease can
+    expire and replica B may legally take over (the client-go
+    renewDeadline < leaseDuration invariant, enforced end to end)
+  - B's acquisition bumps the monotonic ``tpu.ai/leader-epoch`` exactly
+    once: epoch(B) == epoch(A) + 1
+  - 100% of A's post-depose mutating calls are rejected by its
+    :class:`FencedClient` — ``fenced_total`` counts every attempt,
+    ``dispatched_total`` is frozen (zero landed writes), and the
+    ``tpu_operator_fenced_writes_total`` metric agrees with the client
+  - B drives a full degrade -> drain -> retile -> remediate -> recover
+    episode to convergence while A is still alive and fenced —
+    the deposed replica perturbs nothing
+
+Both replicas run the production stack from run_operator:
+``CachedClient -> RetryingClient -> FencedClient -> RestClient``, with the
+elector on its own direct client (leases bypass cache + resilience by
+design) and ``fenced.bind(elector)`` giving the fence the live view.
+"""
+
+import threading
+import time
+
+import pytest
+import requests
+
+from test_crash_soak import PARTITIONS, TPU_LABELS, barrier, default_images  # noqa: F401
+
+from tpu_operator import consts
+from tpu_operator.api.clusterpolicy import new_cluster_policy
+from tpu_operator.client.cache import CachedClient
+from tpu_operator.client.errors import ApiError, FencedError
+from tpu_operator.client.fenced import FencedClient
+from tpu_operator.client.resilience import (
+    CircuitBreaker,
+    RetryingClient,
+    TokenBucket,
+)
+from tpu_operator.client.rest import RestClient
+from tpu_operator.controllers.leader import LeaderElector
+from tpu_operator.controllers.manager import OperatorApp
+from tpu_operator.health import REMEDIATING, drain, node_health_state
+from tpu_operator.partitioner import sync_once
+from tpu_operator.testing import MiniApiServer, SimulatedTrainingJob
+from tpu_operator.testing.kubelet import KubeletSimulator
+from tpu_operator.utils import deep_get
+from tpu_operator.validator.feature_discovery import sync_node_labels
+from tpu_operator.validator.status import StatusFiles
+
+NAMESPACE = "tpu-operator"
+
+
+class LeasePartitionedClient:
+    """Asymmetric partition around the elector's direct client: once
+    :attr:`partitioned` is set, coordination-API calls fail with
+    ``ConnectionError`` while everything else still reaches the apiserver
+    (this wrapper carries ONLY lease traffic, so "everything else" flows
+    through the replica's separate fenced stack — exactly the production
+    topology where the elector borrows the raw transport)."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.partitioned = threading.Event()
+
+    def _gate(self, kind):
+        if self.partitioned.is_set() and kind == "Lease":
+            raise ConnectionError(
+                "asymmetric partition: coordination API unreachable")
+
+    def get(self, api_version, kind, name, namespace=None):
+        self._gate(kind)
+        return self.inner.get(api_version, kind, name, namespace)
+
+    def create(self, obj):
+        self._gate(obj.get("kind"))
+        return self.inner.create(obj)
+
+    def update(self, obj):
+        self._gate(obj.get("kind"))
+        return self.inner.update(obj)
+
+
+class Replica:
+    """One operator replica wired exactly like run_operator's composition
+    root, with controller start/stop driven by its elector."""
+
+    def __init__(self, base, ident):
+        self.direct = LeasePartitionedClient(RestClient(base_url=base))
+        self.fenced = FencedClient(RestClient(base_url=base))
+        self.client = CachedClient(RetryingClient(
+            self.fenced,
+            limiter=TokenBucket(qps=200.0, burst=400),
+            breaker=CircuitBreaker(threshold=5)))
+        self.app = OperatorApp(self.client)
+        self.elector = LeaderElector(
+            self.direct, NAMESPACE, identity=ident,
+            lease_duration=2.0, renew_period=0.1, retry_period=0.05)
+        self.app.elector = self.elector
+        self.fenced.bind(self.elector)
+        self.acquired_at = None
+        self.deposed_at = None
+        self.starts = 0
+
+    def start(self):
+        def on_started():
+            self.acquired_at = time.monotonic()
+            self.starts += 1
+            self.app.start_controllers()
+
+        def on_stopped():
+            # run_operator exits the process here; the soak deliberately
+            # keeps the deposed app ALIVE to model the window between
+            # lost leadership and the restart landing — the exact window
+            # the fence exists for
+            self.deposed_at = time.monotonic()
+
+        self.elector.run(on_started=on_started, on_stopped=on_stopped)
+
+    def stop(self):
+        self.elector.release()
+        self.app.stop()
+        self.client.stop()
+
+    def metric_fenced_total(self):
+        """Sum tpu_operator_fenced_writes_total across verbs from the
+        replica's own /metrics exposition."""
+        total = 0.0
+        for line in self.app.metrics.scrape().decode().splitlines():
+            if (line.startswith("tpu_operator_fenced_writes_total")
+                    and not line.startswith("#")):
+                total += float(line.rsplit(" ", 1)[1])
+        return int(total)
+
+
+class SplitBrainHarness:
+    """Shared cluster (one MiniApiServer, one node, one kubelet) plus two
+    replicas and the node-agent plumbing for driving a drain episode."""
+
+    def __init__(self, tmp_path, monkeypatch):
+        devdir = tmp_path / "dev"
+        devdir.mkdir(parents=True)
+        for i in range(8):
+            (devdir / f"accel{i}").write_text("")
+        monkeypatch.setenv("TPU_DEV_GLOBS", str(devdir / "accel*"))
+        self.monkeypatch = monkeypatch
+        self.config_path = tmp_path / "partitions.yaml"
+        self.config_path.write_text(PARTITIONS)
+
+        self.srv = MiniApiServer()
+        base = self.srv.start()
+        self.admin = RestClient(base_url=base)
+        self.kubelet = KubeletSimulator(self.admin, interval=0.05,
+                                        create_pods=True).start()
+        self.status = StatusFiles(str(tmp_path / "tpu-a" / "status"))
+        self.status.write("workload", barrier(True))
+        self.handoff = str(tmp_path / "tpu-a" / "handoff")
+        self.admin.create({"apiVersion": "v1", "kind": "Node",
+                           "metadata": {"name": "tpu-a",
+                                        "labels": dict(TPU_LABELS)},
+                           "status": {}})
+        self.a = Replica(base, "replica-a")
+        self.b = Replica(base, "replica-b")
+
+    def wait(self, predicate, timeout=60.0, message="condition"):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                if predicate():
+                    return
+            except (ApiError, requests.RequestException):
+                pass
+            time.sleep(0.02)
+        raise AssertionError(f"timed out waiting for {message}")
+
+    def agent_pass(self):
+        self.monkeypatch.setenv("STATUS_DIR", self.status.directory)
+        sync_node_labels(self.admin, "tpu-a", use_jax=False)
+        sync_once(self.admin, "tpu-a", str(self.config_path), self.handoff,
+                  status_dir=self.status.directory, drain_deadline_s=120)
+
+    def node(self):
+        return self.admin.get("v1", "Node", "tpu-a")
+
+    def health(self):
+        return node_health_state(self.node())
+
+    def slice_state(self):
+        return deep_get(self.node(), "metadata", "labels",
+                        consts.TPU_SLICE_STATE_LABEL)
+
+    def install(self):
+        """Bring the cluster to healthy steady state under A's leadership."""
+        self.admin.create(new_cluster_policy())
+        self.a.start()
+        assert self.a.elector.is_leader.wait(timeout=10), \
+            "replica A never acquired leadership"
+        self.b.start()  # stands by: blocked while A renews
+        self.wait(lambda: deep_get(
+            self.admin.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy"),
+            "status", "state") == "ready", message="initial install ready")
+        self.admin.patch("v1", "Node", "tpu-a", {"metadata": {"labels": {
+            consts.TPU_SLICE_CONFIG_LABEL: "single-chip"}}})
+        self.agent_pass()
+        assert self.slice_state() == "success"
+        self.wait(lambda: self.health() == "",
+                  message="healthy in steady state")
+
+    def drain_episode(self):
+        """The full degrade -> drain -> retile -> remediate -> recover
+        episode (driven through node agents; reconciled by whichever
+        replica currently leads)."""
+        job = SimulatedTrainingJob(self.admin, "tpu-a", self.status)
+        for _ in range(5):
+            job.tick()
+        self.status.write("workload", barrier(False, failed=[2]))
+        self.agent_pass()
+        self.wait(lambda: drain.node_plan(self.node()) is not None,
+                  message="RetilePlanned annotation published")
+        job.tick()  # sees the plan, checkpoints, stamps the ack
+        ack_step = job.step
+        self.agent_pass()
+        self.wait(lambda: self.slice_state() == "retiled",
+                  message="incremental re-tile")
+        self.wait(lambda: self.health() == REMEDIATING,
+                  message="ack released remediation")
+        job.crash()
+        assert job.resume() == ack_step, "resume must land on the ack"
+        healthy = barrier(True)
+        healthy["drain_ack"] = drain.read_drain_ack(self.status)
+        self.status.write("workload", healthy)
+        self.agent_pass()
+        self.wait(lambda: self.health() == "", message="healthy again")
+        drain.maybe_ack_plan(self.admin, "tpu-a", self.status)
+        self.agent_pass()
+        self.wait(lambda: not (set(deep_get(self.node(), "metadata",
+                                            "annotations", default={}) or {})
+                               & {consts.RETILE_PLAN_ANNOTATION,
+                                  consts.DRAIN_ACK_ANNOTATION,
+                                  consts.HEALTH_ATTEMPTS_ANNOTATION}),
+                  message="episode artifacts retired")
+        self.agent_pass()
+        self.wait(lambda: self.slice_state() == "success",
+                  message="configured layout restored")
+
+    def teardown(self):
+        self.a.stop()
+        self.b.stop()
+        self.kubelet.stop()
+        self.srv.stop()
+
+
+def test_split_brain_old_leader_fully_fenced(tmp_path, monkeypatch):
+    h = SplitBrainHarness(tmp_path, monkeypatch)
+    try:
+        h.install()
+        epoch_a = h.a.elector.current_epoch()
+        assert epoch_a == 1, "first acquisition must mint epoch 1"
+        assert h.a.fenced.fenced_total == 0, \
+            "nothing may be fenced while A leads uncontested"
+        assert h.a.fenced.dispatched_total > 0, \
+            "the install must have dispatched writes under A's epoch"
+        assert h.a.fenced.last_dispatched_epoch == epoch_a
+
+        # -- the partition: A loses the coordination API, nothing else ------
+        h.a.direct.partitioned.set()
+        h.wait(lambda: not h.a.elector.is_leader.is_set(), timeout=10,
+               message="A to depose itself at its renew deadline")
+        assert h.a.deposed_at is not None
+        # the ordering that prevents overlap: A stands down strictly
+        # before the lease can expire for B
+        assert not h.b.elector.is_leader.is_set(), \
+            "B took over before A's renew deadline ran out — overlap window"
+        assert h.b.elector.is_leader.wait(timeout=10), \
+            "B never took over the expired lease"
+        assert h.b.acquired_at > h.a.deposed_at
+        assert h.b.elector.current_epoch() == epoch_a + 1, \
+            "takeover must bump the leader epoch exactly once"
+
+        # -- A's fence: every post-depose write rejected, none landed -------
+        dispatched_frozen = h.a.fenced.dispatched_total
+        fenced_before = h.a.fenced.fenced_total
+        stale_policy = h.a.client.get("tpu.ai/v1", "ClusterPolicy",
+                                      "cluster-policy")
+        battery = [
+            lambda: h.a.client.patch(
+                "v1", "Node", "tpu-a",
+                {"metadata": {"labels": {"tpu.ai/stale-write": "1"}}}),
+            lambda: h.a.client.create(
+                {"apiVersion": "v1", "kind": "Event",
+                 "metadata": {"name": "stale-event", "namespace": NAMESPACE},
+                 "involvedObject": {"kind": "Node", "name": "tpu-a"},
+                 "reason": "StaleWrite", "message": "from the old leader"}),
+            lambda: h.a.client.update(stale_policy),
+            lambda: h.a.client.update_status(stale_policy),
+            lambda: h.a.client.delete("v1", "Pod", "some-pod", NAMESPACE),
+            lambda: h.a.client.evict("some-pod", NAMESPACE),
+        ]
+        for attempt in battery:
+            with pytest.raises(FencedError):
+                attempt()
+        # reads stay open: the deposed replica keeps its caches warm
+        assert h.a.client.get("v1", "Node", "tpu-a")
+
+        # -- B drives a full drain/retile episode with A still alive --------
+        h.drain_episode()
+
+        # -- accounting: 100% rejection, zero landed writes -----------------
+        h.a.app.stop()  # quiesce A's workers, then read the counters
+        assert h.a.fenced.dispatched_total == dispatched_frozen, \
+            "a deposed replica landed a write"
+        rejected = h.a.fenced.fenced_total - fenced_before
+        assert rejected >= len(battery), \
+            f"only {rejected} of >= {len(battery)} attempts were fenced"
+        assert h.a.metric_fenced_total() == h.a.fenced.fenced_total, \
+            "tpu_operator_fenced_writes_total disagrees with the client"
+        assert h.a.fenced.last_dispatched_epoch == epoch_a, \
+            "A dispatched under an epoch it never held"
+        # A's own stale-write never reached the node
+        assert "tpu.ai/stale-write" not in (
+            deep_get(h.node(), "metadata", "labels", default={}) or {})
+        # B stayed untouched by A's attempts: still leading, epoch stable
+        assert h.b.elector.is_leader.is_set()
+        assert h.b.elector.current_epoch() == epoch_a + 1
+        assert h.b.fenced.fenced_total == 0, \
+            "the live leader must never fence its own writes"
+    finally:
+        h.teardown()
+
+
+def test_lease_partition_blocks_only_coordination_api(fake_client):
+    """The harness's partition is asymmetric by construction: Lease calls
+    fail, everything else passes through."""
+    wrapped = LeasePartitionedClient(fake_client)
+    fake_client.create({"apiVersion": "v1", "kind": "Node",
+                        "metadata": {"name": "n1"}})
+    wrapped.partitioned.set()
+    with pytest.raises(ConnectionError):
+        wrapped.get("coordination.k8s.io/v1", "Lease", "x", NAMESPACE)
+    with pytest.raises(ConnectionError):
+        wrapped.update({"apiVersion": "coordination.k8s.io/v1",
+                        "kind": "Lease",
+                        "metadata": {"name": "x", "namespace": NAMESPACE}})
+    assert wrapped.get("v1", "Node", "n1")["metadata"]["name"] == "n1"

@@ -220,6 +220,71 @@ def test_restwatch_recovers_from_410_without_leaking_status(monkeypatch):
         srv.stop()
 
 
+def test_preconditioned_patch_applies_on_fresh_rv_after_410_relist(monkeypatch):
+    """The write-side contract of watch resume: a preconditioned patch
+    computed while the informer is recovering from a 410 (cache serving a
+    pre-relist snapshot) must conflict on the stale resourceVersion, re-read,
+    and land on the FRESH version — never clobber writes it raced, never
+    wedge. The cache itself must converge to the post-relist state with no
+    stale events surviving."""
+    from tpu_operator.client.cache import CachedClient
+    from tpu_operator.client.preconditions import preconditioned_patch
+    from tpu_operator.utils import deep_get
+
+    srv = MiniApiServer()
+    base = srv.start()
+    try:
+        writer = RestClient(base_url=base)
+        writer.create({"apiVersion": "v1", "kind": "Node",
+                       "metadata": {"name": "n1", "labels": {}}})
+        writer.patch("v1", "Node", "n1",
+                     {"metadata": {"labels": {"w": "seed"}}})
+
+        real_relist = _RestWatch._relist
+        forced = {"done": False}
+
+        def stale_relist(self):
+            rv = real_relist(self)
+            if not forced["done"]:
+                forced["done"] = True
+                return "1"  # provably ancient: the first connect eats a 410
+            return rv
+
+        monkeypatch.setattr(_RestWatch, "_relist", stale_relist)
+
+        cached = CachedClient(RestClient(base_url=base))
+        try:
+            # starts the informer through the forced-stale resume path
+            assert cached.get("v1", "Node", "n1")
+            assert _wait_for(lambda: forced["done"])
+            # concurrent writers advance the object past any cached snapshot
+            for i in range(5):
+                writer.patch("v1", "Node", "n1",
+                             {"metadata": {"labels": {"w": str(i)}}})
+
+            def build(fresh):
+                return {"metadata": {"annotations": {"tpu.ai/stamped": "yes"}}}
+
+            # conflict -> re-read -> reapply until the rv is current
+            preconditioned_patch(cached, "v1", "Node", "n1", build)
+
+            final = writer.get("v1", "Node", "n1")
+            assert deep_get(final, "metadata", "annotations",
+                            "tpu.ai/stamped") == "yes"
+            # the racing writer's last update survived (no lost update)
+            assert deep_get(final, "metadata", "labels", "w") == "4"
+            # and the relisted cache converges to the same view
+            assert _wait_for(lambda: deep_get(
+                cached.get("v1", "Node", "n1"),
+                "metadata", "annotations", "tpu.ai/stamped") == "yes")
+            assert deep_get(cached.get("v1", "Node", "n1"),
+                            "metadata", "labels", "w") == "4"
+        finally:
+            cached.stop()
+    finally:
+        srv.stop()
+
+
 def _chaotic_watch_run(truncate_mode, monkeypatch):
     """Shared body for the wire-fault watch tests: a ChaosSession chops
     every watch stream after 2 events (``truncate_mode`` decides how it

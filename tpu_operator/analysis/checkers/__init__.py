@@ -9,4 +9,5 @@ from . import (  # noqa: F401
     lock_discipline,
     metrics_discipline,
     span_discipline,
+    unfenced_write,
 )

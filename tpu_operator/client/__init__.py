@@ -3,6 +3,7 @@ from .errors import (
     BreakerOpenError,
     ConflictError,
     DeadlineExceededError,
+    FencedError,
     KindNotServedError,
     NotFoundError,
     TooManyRequestsError,
@@ -10,6 +11,7 @@ from .errors import (
 )
 from .interface import Client, WatchEvent
 from .fake import FakeClient
+from .preconditions import preconditioned_patch
 from .scheme import Scheme, default_scheme
 
 __all__ = [
@@ -17,6 +19,7 @@ __all__ = [
     "BreakerOpenError",
     "ConflictError",
     "DeadlineExceededError",
+    "FencedError",
     "KindNotServedError",
     "NotFoundError",
     "TooManyRequestsError",
@@ -24,6 +27,7 @@ __all__ = [
     "Client",
     "WatchEvent",
     "FakeClient",
+    "preconditioned_patch",
     "Scheme",
     "default_scheme",
 ]

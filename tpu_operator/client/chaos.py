@@ -18,6 +18,14 @@ points matching the two client stacks the tests run:
 
 Everything is driven by one seeded :class:`random.Random` so a failing
 chaos run replays exactly (`make chaos` pins ``CHAOS_SEED``).
+
+A third injector, :class:`CrashPointClient`, is deterministic rather than
+random: it enumerates every *mutating call site* an episode exercises and
+can be armed to simulate a process kill immediately before or after one
+specific write. The crash-point soak (`make crash-soak`) replays one full
+join→degrade→drain→retile→remediate→recover episode once per (site,
+before|after) pair and asserts the cold-restarted operator converges to
+the identical terminal state — coverage-complete, not sampled.
 """
 
 from __future__ import annotations
@@ -212,6 +220,178 @@ class _ChoppedResponse:
             yield line
             if line:
                 served += 1
+
+
+class OperatorCrashed(Exception):
+    """The simulated kill: raised at the armed crash point and from every
+    subsequent call on the now-dead client. Deliberately NOT an ApiError —
+    a killed process doesn't get to run per-object error handling, so the
+    operator's ``except ApiError`` recovery paths must never swallow it.
+    The test harness catches it (or polls :attr:`CrashPointClient.fired`)
+    and cold-restarts the operator on a fresh client stack."""
+
+
+def _patch_paths(patch: dict, prefix: str = "") -> List[str]:
+    """Sorted dotted leaf-key paths of a merge-patch body — the *shape* of
+    the write. ``metadata.resourceVersion`` is excluded: it is the
+    optimistic-concurrency precondition, not payload, and its presence
+    would split one logical site into preconditioned/blind twins."""
+    out = []
+    for key in sorted(patch):
+        path = f"{prefix}.{key}" if prefix else key
+        if path == "metadata.resourceVersion":
+            continue
+        value = patch[key]
+        if isinstance(value, dict) and value:
+            out.extend(_patch_paths(value, path))
+        else:
+            out.append(path)
+    return out
+
+
+def crash_site(verb: str, api_version: Optional[str], kind: Optional[str],
+               name: Optional[str], patch: Optional[dict] = None,
+               obj: Optional[dict] = None) -> str:
+    """A stable identifier for one mutating call site.
+
+    Stability across runs is the whole game — the record run's site set
+    IS the replay matrix, so anything run-dependent (Event names carry a
+    random suffix, patch values carry timestamps) must be normalized out:
+
+    * Events key on involved-object name + reason, never metadata.name
+    * PATCH sites carry the sorted leaf-key paths of the body (two
+      different annotations on the same node are different sites; two
+      writes of the same annotation with different values are one site)
+    """
+    if obj is not None:
+        kind = obj.get("kind") or kind
+        api_version = obj.get("apiVersion") or api_version
+        meta = obj.get("metadata", {})
+        if kind == "Event":
+            involved = obj.get("involvedObject", {})
+            return (f"{verb} Event/{involved.get('kind')}:"
+                    f"{involved.get('name')}:{obj.get('reason')}")
+        name = meta.get("name") or meta.get("generateName")
+    site = f"{verb} {kind}/{name}"
+    if patch:
+        site += " [" + ",".join(_patch_paths(patch)) + "]"
+    return site
+
+
+class CrashPointClient(Client):
+    """Deterministic kill-point injector for crash-recovery soaks.
+
+    Record mode (``arm=None``): every mutating call is dispatched normally
+    while its :func:`crash_site` key is collected (first-occurrence order)
+    in :attr:`sites` — one episode in record mode enumerates the replay
+    matrix.
+
+    Armed mode (``arm=(site, "before"|"after")``): the first call matching
+    ``site`` simulates a process kill — ``"before"`` drops the write (it
+    never reaches the apiserver), ``"after"`` lets it land first; either
+    way :class:`OperatorCrashed` is raised and the client goes *dead*:
+    every subsequent call (reads included) raises too, so the doomed
+    process cannot make progress between the kill and the harness noticing
+    :attr:`fired` and cold-restarting the operator. A replay whose armed
+    site never fires is an uncovered site — the soak fails on it.
+    """
+
+    MUTATING = ("POST", "PUT", "STATUS", "PATCH", "DELETE", "EVICT")
+
+    def __init__(self, inner: Client, arm: Optional[tuple] = None):
+        self.inner = inner
+        self.scheme = getattr(inner, "scheme", None)
+        self.arm = arm
+        #: mutating site keys, first-occurrence order
+        self.sites: List[str] = []
+        self._seen: set = set()
+        self.fired = False
+        self.dead = False
+        self._lock = threading.Lock()
+
+    # -- the gate --------------------------------------------------------------
+    def _alive(self) -> None:
+        if self.dead:
+            raise OperatorCrashed("crashed operator: client is dead")
+
+    def _gate(self, site: str, dispatch):
+        with self._lock:
+            self._alive()
+            if site not in self._seen:
+                self._seen.add(site)
+                self.sites.append(site)
+            armed = (self.arm is not None and not self.fired
+                     and self.arm[0] == site)
+            if armed:
+                self.fired = True
+                if self.arm[1] == "before":
+                    self.dead = True
+                    raise OperatorCrashed(f"killed before {site}")
+        if not armed:
+            return dispatch()
+        try:
+            # crash-after: the write reached the apiserver (even a 409
+            # counts as reached) and the process dies before observing
+            # the response
+            dispatch()
+        finally:
+            with self._lock:
+                self.dead = True
+        raise OperatorCrashed(f"killed after {site}")
+
+    # -- mutating verbs --------------------------------------------------------
+    def create(self, obj: dict) -> dict:
+        site = crash_site("POST", None, None, None, obj=obj)
+        return self._gate(site, lambda: self.inner.create(obj))
+
+    def update(self, obj: dict) -> dict:
+        site = crash_site("PUT", None, None, None, obj=obj)
+        return self._gate(site, lambda: self.inner.update(obj))
+
+    def update_status(self, obj: dict) -> dict:
+        site = crash_site("STATUS", None, None, None, obj=obj)
+        return self._gate(site, lambda: self.inner.update_status(obj))
+
+    def patch(self, api_version, kind, name, patch, namespace=None) -> dict:
+        site = crash_site("PATCH", api_version, kind, name, patch=patch)
+        return self._gate(
+            site,
+            lambda: self.inner.patch(api_version, kind, name, patch,
+                                     namespace))
+
+    def delete(self, api_version, kind, name, namespace=None) -> None:
+        site = crash_site("DELETE", api_version, kind, name)
+        return self._gate(
+            site, lambda: self.inner.delete(api_version, kind, name,
+                                            namespace))
+
+    def evict(self, name: str, namespace: Optional[str] = None) -> None:
+        site = crash_site("EVICT", "v1", "Pod", name)
+        return self._gate(site, lambda: self.inner.evict(name, namespace))
+
+    # -- reads / plumbing (die with the process, never crash-points) -----------
+    def get(self, api_version, kind, name, namespace=None) -> dict:
+        self._alive()
+        return self.inner.get(api_version, kind, name, namespace)
+
+    def list(self, api_version, kind, namespace=None, label_selector=None,
+             field_selector=None) -> List[dict]:
+        self._alive()
+        return self.inner.list(api_version, kind, namespace,
+                               label_selector, field_selector)
+
+    def watch(self, api_version, kind, namespace=None, handler=None,
+              relist_handler=None) -> WatchHandle:
+        self._alive()
+        return self.inner.watch(api_version, kind, namespace, handler,
+                                relist_handler=relist_handler)
+
+    def server_version(self) -> str:
+        self._alive()
+        return self.inner.server_version()
+
+    def stop(self) -> None:
+        self.inner.stop()
 
 
 class ChaosSession(requests.Session):

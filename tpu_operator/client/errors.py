@@ -84,12 +84,33 @@ class BreakerOpenError(ApiError):
         self.retry_in = retry_in
 
 
+class FencedError(ApiError):
+    """The local replica is no longer the leader: the write was rejected by
+    the fencing layer (``client/fenced.py``) before reaching the wire. Code
+    403 by analogy with an authorization failure — the *replica* lacks the
+    right to write, not the credential. Deliberately NOT transient: retrying
+    from this process cannot succeed until leadership is re-acquired, and
+    blind retries are exactly the stale-writer traffic the fence exists to
+    stop. Reconcilers treat it like ``BreakerOpenError``: requeue without
+    counting an error. ``epoch`` is the last leader epoch this replica held
+    (None if it never led); ``current_epoch`` is the elector's live view at
+    rejection time, when known."""
+
+    code = 403
+
+    def __init__(self, message: str, epoch: int | None = None,
+                 current_epoch: int | None = None):
+        super().__init__(message, 403)
+        self.epoch = epoch
+        self.current_epoch = current_epoch
+
+
 def is_transient(exc: BaseException) -> bool:
     """Would a retry plausibly succeed? True for apiserver overload (429),
     server-side 5xx, and transport-level failures; False for 4xx semantics
     (absent, conflicting, invalid — retrying cannot change the answer) and
     for the breaker's own short-circuit."""
-    if isinstance(exc, (BreakerOpenError, DeadlineExceededError)):
+    if isinstance(exc, (BreakerOpenError, DeadlineExceededError, FencedError)):
         return False
     if isinstance(exc, TooManyRequestsError):
         return True

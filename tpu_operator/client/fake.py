@@ -271,6 +271,16 @@ class FakeClient(Client):
     def patch(self, api_version, kind, name, patch, namespace=None) -> dict:
         with self._lock:
             current = self.get(api_version, kind, name, namespace)
+            # rv-preconditioned merge patch, matching the real apiserver: a
+            # patch carrying metadata.resourceVersion is rejected with 409
+            # unless it names the live version (client/preconditions.py
+            # builds on this); without one the patch applies blind
+            sent_rv = deep_get(patch, "metadata", "resourceVersion")
+            if (sent_rv is not None
+                    and sent_rv != current["metadata"]["resourceVersion"]):
+                raise ConflictError(
+                    f"resourceVersion conflict on {kind}/{name} (patch "
+                    f"precondition {sent_rv} != {current['metadata']['resourceVersion']})")
             json_merge_patch(current, patch)
             current["metadata"].pop("resourceVersion", None)
             return self.update(current)

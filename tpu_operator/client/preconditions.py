@@ -1,0 +1,78 @@
+"""resourceVersion-preconditioned read-modify-write for label state machines.
+
+The health machine, drain protocol, and partitioner all persist protocol
+state in node labels/annotations via JSON merge patches. A blind merge
+patch only writes the keys it names, but the *values* are computed from an
+earlier read — so two writers interleaving (a deposed leader racing the
+new one, or a health sweep racing feature discovery) can resurrect retired
+state: stale flap history, a re-announced drain plan, a double-counted
+remediation attempt.
+
+:func:`preconditioned_patch` closes the read→write window: the merge
+patch carries the ``metadata.resourceVersion`` of the object the mutation
+was computed from, the apiserver rejects it with 409 if anything wrote in
+between, and the helper re-reads and re-applies the mutation against the
+fresh object. The mutation callback therefore must be a pure function of
+the object it is handed — it may run several times.
+
+This is defense-in-depth *under* the leader fence (``client/fenced.py``):
+the fence stops a deposed replica's writes wholesale; the precondition
+stops the one write that races past the epoch check in the instant
+between admission and depose.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, Optional
+
+from .errors import ConflictError
+from .interface import Client
+
+log = logging.getLogger(__name__)
+
+#: bounded re-read-and-reapply budget: conflicts mean a live competing
+#: writer, and an unbounded loop against one would spin forever
+DEFAULT_ATTEMPTS = 6
+
+
+def preconditioned_patch(client: Client, api_version: str, kind: str,
+                         name: str,
+                         build: Callable[[dict], Optional[dict]],
+                         namespace: Optional[str] = None,
+                         attempts: int = DEFAULT_ATTEMPTS,
+                         sleep: Callable[[float], None] = time.sleep) -> dict:
+    """Read ``name``, let ``build(fresh_obj)`` compute a JSON merge patch
+    from it (return None for "nothing to do"), and apply it preconditioned
+    on the resourceVersion that was read. On 409, re-read and re-apply —
+    ``build`` sees the competing writer's state and recomputes, so the lost
+    write is re-derived, never replayed verbatim.
+
+    Returns the server's post-patch object (the fresh read when ``build``
+    declined). NotFoundError propagates to the caller — object lifecycle
+    is its policy, not this helper's.
+    """
+    last_exc: Optional[ConflictError] = None
+    for attempt in range(attempts):
+        if attempt:
+            # brief pause so an informer-backed read can observe the
+            # competing write before the re-read (write-through caches lag
+            # by one event delivery)
+            sleep(min(0.25, 0.02 * (2 ** attempt)))
+        obj = client.get(api_version, kind, name, namespace)
+        patch = build(obj)
+        if patch is None:
+            return obj
+        rv = obj.get("metadata", {}).get("resourceVersion")
+        if rv is not None:
+            patch.setdefault("metadata", {})["resourceVersion"] = rv
+        try:
+            return client.patch(api_version, kind, name, patch, namespace)
+        except ConflictError as e:
+            last_exc = e
+            log.debug("preconditioned patch of %s/%s conflicted at rv %s "
+                      "(attempt %d/%d); re-reading", kind, name, rv,
+                      attempt + 1, attempts)
+    raise last_exc if last_exc is not None else ConflictError(
+        f"preconditioned patch of {kind}/{name} never applied")

@@ -50,6 +50,7 @@ from .errors import (
     ApiError,
     BreakerOpenError,
     DeadlineExceededError,
+    FencedError,
     TooManyRequestsError,
     is_transient,
 )
@@ -321,7 +322,7 @@ class RetryingClient(Client):
                     elif transient:  # hard failures: 5xx, transport
                         self.breaker.record_failure()
                         settled = True
-                    elif not isinstance(e, BreakerOpenError):
+                    elif not isinstance(e, (BreakerOpenError, FencedError)):
                         self.breaker.record_success()  # the server answered
                         settled = True
                     if not transient or (not retry_429 and

@@ -118,6 +118,13 @@ DRAIN_ACK_ANNOTATION = "tpu.ai/drain-ack"
 #: step/RNG/compile-cache state into before acking a drain
 DRAIN_CHECKPOINT_FILE = "drain-checkpoint.json"
 
+# -- leader fencing ------------------------------------------------------------
+#: monotonic leader epoch on the election Lease (metadata.annotations).
+#: Bumped on every acquisition (create or takeover), never on renewal; the
+#: fencing layer (client/fenced.py) refuses to dispatch a mutating call
+#: unless the elector's live view still holds this epoch.
+LEADER_EPOCH_ANNOTATION = "tpu.ai/leader-epoch"
+
 # -- serving SLO validation ----------------------------------------------------
 #: the node's serving-barrier verdict, published by feature discovery from
 #: the serving barrier file: "passed" | "failed" | "corrupt" (label values

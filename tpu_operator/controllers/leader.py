@@ -15,12 +15,24 @@ import time
 import uuid
 from typing import Callable, Optional
 
+from .. import consts
 from ..client.errors import ApiError, ConflictError, NotFoundError
 from ..client.interface import Client
 
 log = logging.getLogger(__name__)
 
 LEASE_NAME = "tpu-operator-leader"
+
+
+def lease_epoch(lease: dict) -> int:
+    """The monotonic leader epoch recorded on a Lease (0 = pre-fencing
+    lease that has never carried one)."""
+    raw = (lease.get("metadata", {}).get("annotations") or {}).get(
+        consts.LEADER_EPOCH_ANNOTATION, "0")
+    try:
+        return int(raw)
+    except (TypeError, ValueError):
+        return 0
 
 
 def _now() -> str:
@@ -75,15 +87,33 @@ class LeaderElector:
                 f"{self.renew_deadline:.2f}s); raise lease_duration or "
                 f"lower the periods")
         self.is_leader = threading.Event()
+        #: the monotonic fencing token: the Lease epoch under which this
+        #: replica last ACQUIRED leadership. Written only by the elector
+        #: thread; racy reads are safe (monotonic int). Consumers must gate
+        #: on current_epoch() (epoch + is_leader together), never the raw
+        #: attribute — a deposed leader still remembers its old epoch.
+        self.epoch = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
+    # -- fencing view ---------------------------------------------------------
+    def current_epoch(self) -> Optional[int]:
+        """The live fencing token: the epoch this replica holds leadership
+        under, or None when not (or no longer) the leader. This is the
+        elector's LIVE view — it flips to None the moment the indeterminate
+        hold window expires, before any peer may legally take over."""
+        if not self.is_leader.is_set():
+            return None
+        return self.epoch
+
     # -- lease mechanics ------------------------------------------------------
-    def _lease_obj(self, transitions: int = 0) -> dict:
+    def _lease_obj(self, transitions: int = 0, epoch: int = 1) -> dict:
         return {
             "apiVersion": "coordination.k8s.io/v1",
             "kind": "Lease",
-            "metadata": {"name": self.lease_name, "namespace": self.namespace},
+            "metadata": {"name": self.lease_name, "namespace": self.namespace,
+                         "annotations": {
+                             consts.LEADER_EPOCH_ANNOTATION: str(epoch)}},
             "spec": {
                 "holderIdentity": self.identity,
                 "leaseDurationSeconds": max(1, int(self.lease_duration)),
@@ -103,15 +133,25 @@ class LeaderElector:
             lease = self.client.get("coordination.k8s.io/v1", "Lease",
                                     self.lease_name, self.namespace)
         except NotFoundError:
+            # epoch must outrun anything this process held before: a lease
+            # deleted out from under a former leader must not let it mint
+            # an epoch a newer leader already fenced against
+            new_epoch = self.epoch + 1
             try:
-                self.client.create(self._lease_obj())
+                self.client.create(self._lease_obj(epoch=new_epoch))
+                self.epoch = new_epoch
                 return True
             except ApiError:
                 return None  # racing another creator; retry resolves it
         spec = lease.get("spec", {})
         holder = spec.get("holderIdentity")
+        observed_epoch = lease_epoch(lease)
         if holder == self.identity:
             spec["renewTime"] = _now()
+            # renewal never bumps the epoch — only acquisition does. A
+            # pre-fencing lease (no annotation yet) gets stamped with the
+            # epoch this replica believes it holds.
+            new_epoch = observed_epoch or max(self.epoch, 1)
         else:
             expiry = _parse(spec.get("renewTime", "")) + spec.get(
                 "leaseDurationSeconds", self.lease_duration)
@@ -121,9 +161,14 @@ class LeaderElector:
             spec["acquireTime"] = _now()
             spec["renewTime"] = _now()
             spec["leaseTransitions"] = spec.get("leaseTransitions", 0) + 1
+            # takeover: fence out every write stamped with an older epoch
+            new_epoch = max(observed_epoch, self.epoch) + 1
         lease["spec"] = spec
+        lease.setdefault("metadata", {}).setdefault("annotations", {})[
+            consts.LEADER_EPOCH_ANNOTATION] = str(new_epoch)
         try:
             self.client.update(lease)
+            self.epoch = new_epoch
             return True
         except (ConflictError, NotFoundError):
             return None  # lost the write race; next attempt re-reads

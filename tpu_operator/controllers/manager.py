@@ -229,6 +229,11 @@ class OperatorApp:
         self.resilience = find_resilience(client)
         if self.resilience is not None:
             self.metrics.wire_resilience(self.resilience)
+        from ..client.fenced import find_fenced
+
+        self.fenced = find_fenced(client)
+        if self.fenced is not None:
+            self.metrics.wire_fencing(self.fenced)
         self._metrics_port = metrics_port
         self._health_port = health_port
         self._servers: list = []
@@ -335,8 +340,17 @@ def run_operator(args) -> int:
         TokenBucket,
     )
 
+    # leader write fence directly above the wire, UNDER the retry layer: a
+    # fenced rejection is non-transient (retrying from a deposed replica is
+    # the stale traffic the fence exists to stop) and must never charge the
+    # breaker. Unbound until the elector exists below; without
+    # --leader-elect it stays unbound and passes writes through
+    # (single-writer by construction).
+    from ..client.fenced import FencedClient
+
+    fenced_client = FencedClient(direct_client)
     client = RetryingClient(
-        direct_client,
+        fenced_client,
         limiter=TokenBucket(qps=getattr(args, "api_qps", 20.0),
                             burst=getattr(args, "api_burst", 40)),
         breaker=CircuitBreaker(
@@ -379,6 +393,11 @@ def run_operator(args) -> int:
         # apiserver brownout (degraded mode explicitly keeps leadership)
         elector = LeaderElector(direct_client, app.clusterpolicy_reconciler.namespace)
         app.elector = elector  # /readyz + /debug/state reflect leadership
+        # the fence gets the elector's LIVE view: every mutating call is
+        # epoch-checked against it immediately before dispatch; Lease
+        # traffic is exempt inside the fence (and the elector's own client
+        # bypasses the whole chain anyway — see the comment above)
+        fenced_client.bind(elector)
         app.start_servers()  # probes answer while standing by
         elector.run(on_started=app.start_controllers, on_stopped=on_lost)
         log.info("leader election enabled; waiting for leadership as %s", elector.identity)

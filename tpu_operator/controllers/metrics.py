@@ -188,6 +188,12 @@ class OperatorMetrics:
             "Cumulative time requests waited on the client-side token-bucket "
             "rate limiter (client-go flowcontrol analog)",
             registry=self.registry)
+        self.fenced_writes = Counter(
+            "tpu_operator_fenced_writes_total",
+            "Mutating apiserver calls rejected by the leader write fence "
+            "(FencedError: this replica attempted a write after losing — or "
+            "before holding — leadership), by verb",
+            ["verb"], registry=self.registry)
 
     def wire_tracing(self) -> None:
         """Mirror the tracing module's dropped-span counter into the
@@ -218,6 +224,13 @@ class OperatorMetrics:
             self.api_breaker_transitions.labels(state=new).inc()
 
         resilience.breaker.on_state_change = on_state_change
+
+    def wire_fencing(self, fenced) -> None:
+        """Attach the FencedClient's rejection hook: every fenced write
+        increments ``tpu_operator_fenced_writes_total`` — a nonzero rate is
+        the split-brain smoking gun (docs/operations.md runbook)."""
+        fenced.on_fenced = (
+            lambda verb: self.fenced_writes.labels(verb=verb).inc())
 
     def scrape(self) -> bytes:
         return generate_latest(self.registry)

@@ -17,7 +17,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
-from ..client.errors import BreakerOpenError
+from ..client.errors import BreakerOpenError, FencedError
 from ..client.interface import Client, WatchEvent
 
 log = logging.getLogger(__name__)
@@ -242,6 +242,12 @@ class Controller:
                                 "circuit open, retry in %.1fs); resync "
                                 "will recover", self.reconciler.name,
                                 e.retry_in or 0.0)
+                except FencedError:
+                    # a deposed replica's mapper tripped the write fence:
+                    # quiet skip — its controllers are being stopped, and
+                    # on re-election the resync re-derives the mapping
+                    log.warning("%s: watch mapper skipped (not leader)",
+                                self.reconciler.name)
                 except Exception:
                     log.exception("%s: watch mapper failed", self.reconciler.name)
             self._handles.append(client.watch(spec.api_version, spec.kind, spec.namespace, handler))
@@ -266,6 +272,9 @@ class Controller:
                 log.warning("%s: resync skipped (apiserver circuit open, "
                             "retry in %.1fs)", self.reconciler.name,
                             e.retry_in or 0.0)
+            except FencedError:
+                log.warning("%s: resync skipped (not leader)",
+                            self.reconciler.name)
             except Exception:
                 log.exception("%s: resync failed", self.reconciler.name)
 
@@ -317,6 +326,17 @@ class Controller:
                 log.warning("%s: apiserver circuit open; requeueing %s in "
                             "%.1fs", self.reconciler.name, request, delay)
                 self.queue.add(request, delay)
+                continue
+            except FencedError:
+                # this replica was deposed mid-sweep and the fence rejected
+                # a write. Same treatment as an open breaker: not an error
+                # (split-brain protection working as designed), no backoff
+                # growth — requeue so the sweep re-runs if leadership comes
+                # back, and sits harmlessly queued if it does not (the
+                # controllers are being stopped by on_stopped anyway).
+                log.warning("%s: write fenced (no longer leader); "
+                            "requeueing %s", self.reconciler.name, request)
+                self.queue.add(request, 1.0)
                 continue
             except Exception:
                 log.exception("%s: reconcile %s failed", self.reconciler.name, request)

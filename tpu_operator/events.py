@@ -18,6 +18,7 @@ trace in ``/debug/traces`` (and the log lines carrying the same ID).
 
 from __future__ import annotations
 
+import hashlib
 import logging
 import uuid
 from typing import Optional
@@ -109,5 +110,59 @@ def record(client: Client, namespace: str, involved: dict,
     try:
         return client.create(event)
     except Exception as e:  # ApiError or transport failure — both best-effort
+        log.debug("event write failed (%s %s): %s", reason, meta.get("name"), e)
+        return None
+
+
+def record_once(client: Client, namespace: str, involved: dict,
+                type_: str, reason: str, message: str, token: str,
+                component: str = "tpu-operator") -> Optional[dict]:
+    """Exactly-once Event emission for protocol announcements: the Event
+    name is content-addressed from (involved object, reason, ``token``), so
+    the create itself is the test-and-set — a second emitter (a crash-
+    repair re-emit whose existence probe read a lagging cache, a racing
+    sweep, a not-yet-fenced stale leader) collides with ``AlreadyExists``
+    and silently stands down. :func:`record`'s list-then-aggregate is
+    best-effort dedup; this is structural dedup for the announcements whose
+    multiplicity is part of the drain/remediation protocol (one
+    ``RetilePlanned`` per plan, one ``NodeHealthRemediating`` per attempt).
+    Returns None when the Event already existed or the write failed."""
+    from .client.errors import AlreadyExistsError
+
+    meta = involved.get("metadata", {})
+    now = rfc3339_now()
+    stem = meta.get("name", "unknown")[:50].rstrip("-.") or "unknown"
+    digest = hashlib.sha1(f"{reason}:{token}".encode()).hexdigest()[:12]
+    event = {
+        "apiVersion": "v1",
+        "kind": "Event",
+        "metadata": {
+            "name": f"{stem}.{digest}",
+            "namespace": namespace,
+        },
+        "involvedObject": {
+            "apiVersion": involved.get("apiVersion"),
+            "kind": involved.get("kind"),
+            "name": meta.get("name"),
+            "namespace": meta.get("namespace", ""),
+            "uid": meta.get("uid", ""),
+        },
+        "type": type_,
+        "reason": reason,
+        "message": message[:1024],
+        "source": {"component": component},
+        "firstTimestamp": now,
+        "lastTimestamp": now,
+        "count": 1,
+    }
+    trace_id = tracing.current_trace_id()
+    if trace_id:
+        event["metadata"]["annotations"] = {
+            tracing.TRACE_ID_ANNOTATION: trace_id}
+    try:
+        return client.create(event)
+    except AlreadyExistsError:
+        return None  # someone else announced this token first: by design
+    except Exception as e:
         log.debug("event write failed (%s %s): %s", reason, meta.get("name"), e)
         return None

@@ -39,8 +39,9 @@ import time
 from typing import Dict, List, Optional
 
 from .. import consts, events
-from ..client.errors import ApiError, NotFoundError
+from ..client.errors import ApiError, FencedError, NotFoundError
 from ..client.interface import Client
+from ..client.preconditions import preconditioned_patch
 from ..utils import deep_get
 from . import drain
 
@@ -170,12 +171,38 @@ class HealthStateMachine:
                         consts.TEMPLATE_HASH_LABEL) or template_fingerprint(tpl)
 
     # -- node writes ----------------------------------------------------------
+    # Every write goes through the rv-preconditioned helper: the patch
+    # carries the resourceVersion of the node it was computed from, a
+    # competing writer (a newer leader's sweep racing past the epoch fence,
+    # or feature discovery mirroring node-local state) surfaces as 409, and
+    # the mutation is re-derived against the fresh object instead of
+    # clobbering it. Transitions additionally re-validate the state label
+    # they were decided from and decline when another writer already
+    # advanced the machine.
+
+    def _mirror(self, node: dict, fresh: dict) -> None:
+        """Fold the server's post-write object back into the sweep's
+        snapshot so the rest of the sweep works against what actually
+        landed (the old code mirrored the patch; the helper gives us the
+        authoritative result instead)."""
+        meta = node.setdefault("metadata", {})
+        fresh_meta = fresh.get("metadata", {})
+        meta["labels"] = dict(fresh_meta.get("labels") or {})
+        meta["annotations"] = dict(fresh_meta.get("annotations") or {})
+        meta["resourceVersion"] = fresh_meta.get("resourceVersion")
+        if "spec" in fresh:
+            node["spec"] = dict(fresh["spec"])
+
     def _set_state(self, node: dict, state: str,
                    extra_annotations: Optional[Dict[str, Optional[str]]] = None
-                   ) -> None:
-        """Label + since-annotation in one patch, mirrored locally (the
-        sweep keeps working against its snapshot)."""
+                   ) -> bool:
+        """Label + since-annotation in one rv-preconditioned patch,
+        mirrored locally (the sweep keeps working against its snapshot).
+        Returns False when the transition was declined because a competing
+        writer already moved the node past the state this decision was
+        made from (the next sweep re-derives)."""
         name = node["metadata"]["name"]
+        expected = node_health_state(node)
         log.info("health: node %s -> %s", name, state or "healthy")
         since = time.strftime("%Y-%m-%dT%H:%M:%SZ",
                               time.gmtime(self._now())) if state else None
@@ -191,39 +218,53 @@ class HealthStateMachine:
             ann_patch[consts.RETILE_PLAN_ANNOTATION] = None
             ann_patch[consts.DRAIN_ACK_ANNOTATION] = None
         ann_patch.update(extra_annotations or {})
-        self.client.patch("v1", "Node", name, {"metadata": {
-            "labels": {consts.HEALTH_STATE_LABEL: state or None},
-            "annotations": ann_patch,
-        }})
-        meta = node.setdefault("metadata", {})
-        labels = meta.setdefault("labels", {})
-        if state:
-            labels[consts.HEALTH_STATE_LABEL] = state
-        else:
-            labels.pop(consts.HEALTH_STATE_LABEL, None)
-        anns = meta.setdefault("annotations", {})
-        for key, value in ann_patch.items():
-            if value is None:
-                anns.pop(key, None)
-            else:
-                anns[key] = value
+        declined = []
+
+        def build(fresh: dict) -> Optional[dict]:
+            if node_health_state(fresh) != expected:
+                # another writer advanced the machine since this sweep's
+                # snapshot: the transition is stale — drop it, don't clobber
+                declined.append(node_health_state(fresh))
+                return None
+            return {"metadata": {
+                "labels": {consts.HEALTH_STATE_LABEL: state or None},
+                "annotations": dict(ann_patch),
+            }}
+
+        fresh = preconditioned_patch(self.client, "v1", "Node", name, build)
+        self._mirror(node, fresh)
+        if declined:
+            log.warning("health: node %s transition %r -> %r declined "
+                        "(concurrent writer moved it to %r)", name,
+                        expected or "healthy", state or "healthy",
+                        declined[-1] or "healthy")
+            return False
+        return True
 
     def _annotate(self, node: dict, key: str, value: Optional[str]) -> None:
         current = deep_get(node, "metadata", "annotations", key)
         if current == value:
             return
-        self.client.patch("v1", "Node", node["metadata"]["name"],
-                          {"metadata": {"annotations": {key: value}}})
-        annotations = node.setdefault("metadata", {}).setdefault("annotations", {})
-        if value is None:
-            annotations.pop(key, None)
-        else:
-            annotations[key] = value
+
+        def build(fresh: dict) -> Optional[dict]:
+            if deep_get(fresh, "metadata", "annotations", key) == value:
+                return None  # someone already wrote it; drift-gate holds
+            return {"metadata": {"annotations": {key: value}}}
+
+        fresh = preconditioned_patch(self.client, "v1", "Node",
+                                     node["metadata"]["name"], build)
+        self._mirror(node, fresh)
 
     def _cordon(self, node: dict, unschedulable: bool) -> None:
-        self.client.patch("v1", "Node", node["metadata"]["name"],
-                          {"spec": {"unschedulable": unschedulable or None}})
-        node.setdefault("spec", {})["unschedulable"] = unschedulable or None
+        def build(fresh: dict) -> Optional[dict]:
+            if fresh.get("spec", {}).get("unschedulable") == (unschedulable or None):
+                return None
+            return {"spec": {"unschedulable": unschedulable or None}}
+
+        fresh = preconditioned_patch(self.client, "v1", "Node",
+                                     node["metadata"]["name"], build)
+        node.setdefault("spec", {})["unschedulable"] = (
+            fresh.get("spec", {}).get("unschedulable"))
 
     def _state_age(self, node: dict) -> float:
         """Seconds in the current state; absent/corrupt stamps now and
@@ -240,8 +281,42 @@ class HealthStateMachine:
         self._set_state(node, node_health_state(node))
         return 0.0
 
-    def _event(self, node: dict, type_: str, reason: str, message: str) -> None:
-        events.record(self.client, self.namespace, node, type_, reason, message)
+    def _event(self, node: dict, type_: str, reason: str, message: str,
+               token: Optional[str] = None) -> None:
+        """With ``token``, the announcement is content-addressed and
+        structurally exactly-once (see :func:`events.record_once`): the
+        protocol Events whose multiplicity the drain/remediation contract
+        pins (one RetilePlanned per plan fingerprint, one
+        NodeHealthRemediating per attempt) pass one, so a crash-repair
+        re-emit racing a lagging Event cache — or a deposed leader's
+        not-yet-fenced sweep — cannot mint a duplicate."""
+        if token is not None:
+            events.record_once(self.client, self.namespace, node, type_,
+                               reason, message, token=token)
+        else:
+            events.record(self.client, self.namespace, node, type_, reason,
+                          message)
+
+    def _event_exists(self, node: dict, reason: str, needle: str) -> bool:
+        """Crash-repair probe: is there a stored Event for this node with
+        ``reason`` whose message mentions ``needle``? Used by the write-
+        ahead patterns below — the annotation is the durable intent, the
+        Event its announcement; a crash between the two writes loses the
+        Event, and the resumed sweep re-emits it exactly once. Fails open
+        (True) on list errors: a re-emitted duplicate aggregates into a
+        count bump, but never blocking the sweep on Event reads matters
+        more."""
+        try:
+            for event in self.client.list("v1", "Event", self.namespace):
+                if (event.get("reason") == reason
+                        and deep_get(event, "involvedObject", "name")
+                        == node["metadata"]["name"]
+                        and needle in (event.get("message") or "")):
+                    return True
+        except ApiError as e:
+            log.debug("health: event-repair probe failed: %s", e)
+            return True
+        return False
 
     # -- flap damping ---------------------------------------------------------
     def _flap_history(self, node: dict) -> List[int]:
@@ -256,17 +331,48 @@ class HealthStateMachine:
         cutoff = self._now() - self.policy.flap_window_s
         return [t for t in out if t >= cutoff]
 
-    def _record_degraded_entry(self, node: dict) -> bool:
+    def _record_degraded_entry(self, node: dict, expected: str) -> bool:
         """Append a healthy->degraded transition to the flap history.
         Returns True when the damper tripped (threshold entries inside the
         window) — the caller then goes sticky-quarantined instead of
-        degraded."""
-        history = self._flap_history(node) + [int(self._now())]
-        self._annotate(node, consts.HEALTH_FLAP_HISTORY_ANNOTATION,
-                       ",".join(str(t) for t in history))
-        return len(history) >= self.policy.flap_threshold
+        degraded. The append is computed from the FRESH node inside the
+        preconditioned write, so two sweeps racing (crash-restart replay,
+        or a deposed leader's last write) cannot double-append or drop a
+        competing writer's entry; ``expected`` is the state this decision
+        was made from — a sweep working off a stale snapshot (the
+        transition already landed) must not inflate the history."""
+        stamp = int(self._now())
+
+        def build(fresh: dict) -> Optional[dict]:
+            if node_health_state(fresh) != expected:
+                return None  # stale snapshot: the transition already landed
+            history = self._flap_history(fresh)
+            if stamp not in history:
+                history = history + [stamp]
+            value = ",".join(str(t) for t in history)
+            if deep_get(fresh, "metadata", "annotations",
+                        consts.HEALTH_FLAP_HISTORY_ANNOTATION) == value:
+                return None  # replayed write (crash between patch and ack)
+            return {"metadata": {"annotations": {
+                consts.HEALTH_FLAP_HISTORY_ANNOTATION: value}}}
+
+        fresh = preconditioned_patch(self.client, "v1", "Node",
+                                     node["metadata"]["name"], build)
+        self._mirror(node, fresh)
+        return len(self._flap_history(node)) >= self.policy.flap_threshold
 
     # -- remediation ----------------------------------------------------------
+    def _attempt_message(self, name: str, attempt: int) -> str:
+        """The NodeHealthRemediating Event text — shared by the normal
+        attempt paths and the crash-repair re-emit so the messages match
+        byte-for-byte (Event aggregation keys on the message)."""
+        limit = self.policy.max_remediation_attempts
+        if attempt <= 1:
+            return (f"{name}: remediation attempt 1/{limit} "
+                    f"(validator recycle, forced revalidation)")
+        return (f"{name}: remediation attempt {attempt}/{limit}"
+                f" (driver restart + revalidation)")
+
     def _remediate(self, node: dict, attempt: int) -> None:
         """One bounded remediation attempt. Attempt 1: recycle the node's
         validator pods — the DS controller recreates them and the init
@@ -282,6 +388,16 @@ class HealthStateMachine:
             self._delete_pod(pod)
 
     # -- coordinated drain (planned re-tiles) ---------------------------------
+    @staticmethod
+    def _plan_message(name: str, plan, deadline_s: float) -> str:
+        """The RetilePlanned Event text — shared by the publish path and
+        the crash-repair re-emit so the two produce byte-identical
+        messages (Event aggregation keys on the message)."""
+        return (f"{name}: planned {plan.reason} (layout {plan.fingerprint}"
+                + (f", chips {plan.blocked} gated" if plan.blocked else "")
+                + f"); workloads have {deadline_s}s to checkpoint "
+                  f"and ack before the forced drain")
+
     def _drain_gate(self, node: dict) -> bool:
         """Coordination gate on the quarantined->remediating edge: returns
         True when remediation/re-tiling may proceed — no drain window
@@ -302,9 +418,9 @@ class HealthStateMachine:
         plan = drain.node_plan(node)
         if plan is None or plan.fingerprint != fingerprint:
             # publish (or supersede — more chips failed mid-drain). The
-            # Event fires ONLY here, where the annotation value actually
-            # changes: a restarted operator finds the matching annotation
-            # below and never double-announces.
+            # annotation is the write-ahead intent and lands FIRST; the
+            # Event is its announcement. A restarted operator finds the
+            # matching annotation below and never double-announces.
             reason = (drain.REASON_RETILE if partition and blocked
                       else drain.REASON_REMEDIATE)
             new_plan = drain.RetilePlan(
@@ -314,19 +430,27 @@ class HealthStateMachine:
             self._annotate(node, consts.RETILE_PLAN_ANNOTATION,
                            new_plan.to_json())
             self._event(node, events.NORMAL, "RetilePlanned",
-                        f"{name}: planned {reason} (layout {fingerprint}"
-                        + (f", chips {blocked} gated" if blocked else "")
-                        + f"); workloads have {deadline_s}s to checkpoint "
-                          f"and ack before the forced drain")
+                        self._plan_message(name, new_plan, deadline_s),
+                        token=fingerprint)
             self.plans_pending += 1
             return False
+        if not self._event_exists(node, "RetilePlanned", fingerprint):
+            # crash repair: a kill between the annotation landing and its
+            # Event leaves the plan announced to machines but not humans —
+            # and "exactly one RetilePlanned per episode" would read as
+            # zero. Re-emit against the stored plan (same deadline, so the
+            # message matches what the original would have said).
+            self._event(node, events.NORMAL, "RetilePlanned",
+                        self._plan_message(name, plan, deadline_s),
+                        token=plan.fingerprint)
         if drain.node_acked_plan(node) == fingerprint:
             return True
         if plan.expired(self._now()):
             self.deadline_misses += 1
             self._event(node, events.WARNING, "RetileDeadlineExpired",
                         f"{name}: drain deadline passed without a workload "
-                        f"ack for plan {fingerprint}; force-proceeding")
+                        f"ack for plan {fingerprint}; force-proceeding",
+                        token=fingerprint)
             return True
         self.plans_pending += 1
         return False
@@ -337,6 +461,12 @@ class HealthStateMachine:
         for node in nodes:
             try:
                 state = self._process_node(node)
+            except FencedError:
+                # deposed mid-sweep: propagate so the runtime requeues the
+                # whole sweep without counting an error (BreakerOpenError
+                # treatment) — swallowing it per-node would let a deposed
+                # leader keep iterating the fleet
+                raise
             except ApiError as e:
                 log.warning("health: node %s sweep error: %s",
                             node["metadata"]["name"], e)
@@ -367,15 +497,27 @@ class HealthStateMachine:
                          if k in anns]
             if leftovers and (consts.HEALTH_FLAP_STICKY_ANNOTATION in anns
                               or consts.HEALTH_FAILED_TEMPLATE_ANNOTATION in anns):
-                self.client.patch("v1", "Node", name, {"metadata": {
-                    "annotations": {k: None for k in leftovers}}})
-                for k in leftovers:
-                    anns.pop(k, None)
+                def build(fresh: dict) -> Optional[dict]:
+                    fresh_anns = deep_get(fresh, "metadata", "annotations",
+                                          default={}) or {}
+                    gone = [k for k in leftovers if k in fresh_anns]
+                    if not gone:
+                        return None  # another sweep already wiped them
+                    return {"metadata": {
+                        "annotations": {k: None for k in gone}}}
+
+                self._mirror(node, preconditioned_patch(
+                    self.client, "v1", "Node", name, build))
+                anns = deep_get(node, "metadata", "annotations",
+                                default={}) or {}
             if verdict is False:
-                if self._record_degraded_entry(node):
-                    self._set_state(node, QUARANTINED, extra_annotations={
-                        consts.HEALTH_FLAP_STICKY_ANNOTATION:
-                            self._template_fingerprint(self._driver_ds_for(node))})
+                if self._record_degraded_entry(node, HEALTHY):
+                    if not self._set_state(node, QUARANTINED,
+                                           extra_annotations={
+                            consts.HEALTH_FLAP_STICKY_ANNOTATION:
+                                self._template_fingerprint(
+                                    self._driver_ds_for(node))}):
+                        return node_health_state(node)
                     if self.policy.cordon_on_quarantine:
                         self._cordon(node, True)
                     # exactly ONE Event: the sticky branch below never
@@ -388,7 +530,11 @@ class HealthStateMachine:
                                 f"or the {consts.HEALTH_STATE_LABEL} label "
                                 f"is cleared")
                     return QUARANTINED
-                self._set_state(node, DEGRADED)
+                if not self._set_state(node, DEGRADED):
+                    # a concurrent sweep (or this one racing a stale
+                    # informer snapshot) already advanced the node: the
+                    # Event belongs to the writer whose transition landed
+                    return node_health_state(node)
                 self._event(node, events.WARNING, "NodeHealthDegraded",
                             f"{name}: workload barrier regressed "
                             f"({anns.get(consts.WORKLOAD_HEALTH_ANNOTATION)})")
@@ -404,7 +550,8 @@ class HealthStateMachine:
             if recorded is not None and recorded != fingerprint:
                 if self.policy.cordon_on_quarantine:
                     self._cordon(node, False)
-                self._set_state(node, HEALTHY)
+                if not self._set_state(node, HEALTHY):
+                    return node_health_state(node)
                 self._event(node, events.NORMAL, "NodeHealthReset",
                             f"{name}: driver template changed; retrying "
                             f"health remediation from scratch")
@@ -419,8 +566,9 @@ class HealthStateMachine:
             if recorded and recorded != fingerprint:
                 if self.policy.cordon_on_quarantine:
                     self._cordon(node, False)
-                self._set_state(node, HEALTHY, extra_annotations={
-                    consts.HEALTH_FLAP_HISTORY_ANNOTATION: None})
+                if not self._set_state(node, HEALTHY, extra_annotations={
+                        consts.HEALTH_FLAP_HISTORY_ANNOTATION: None}):
+                    return node_health_state(node)
                 self._event(node, events.NORMAL, "NodeHealthReset",
                             f"{name}: driver template changed; flap "
                             f"quarantine lifted")
@@ -431,13 +579,15 @@ class HealthStateMachine:
             if verdict is not False:
                 # one-sweep blip (or verdict withdrawn): back to healthy
                 # without the full recovery ceremony
-                self._set_state(node, HEALTHY)
+                if not self._set_state(node, HEALTHY):
+                    return node_health_state(node)
                 self._event(node, events.NORMAL, "NodeHealthRecovered",
                             f"{name}: workload barrier recovered before "
                             f"quarantine")
                 return HEALTHY
             # still failing on a later sweep: confirmed, quarantine
-            self._set_state(node, QUARANTINED)
+            if not self._set_state(node, QUARANTINED):
+                return node_health_state(node)
             if self.policy.cordon_on_quarantine:
                 self._cordon(node, True)
             self._event(node, events.WARNING, "NodeHealthQuarantined",
@@ -455,30 +605,50 @@ class HealthStateMachine:
                 # partitioner holds the layout and we hold the pods until
                 # ack or deadline (re-checked every sweep, never wedged)
                 return QUARANTINED
-            self._set_state(node, REMEDIATING, extra_annotations={
-                consts.HEALTH_ATTEMPTS_ANNOTATION: "1"})
+            if not self._set_state(node, REMEDIATING, extra_annotations={
+                    consts.HEALTH_ATTEMPTS_ANNOTATION: "1"}):
+                # the transition didn't land — firing the recycle anyway
+                # would be a remediation attempt with no durable record
+                return node_health_state(node)
             self._remediate(node, 1)
             self._event(node, events.NORMAL, "NodeHealthRemediating",
-                        f"{name}: remediation attempt 1/"
-                        f"{self.policy.max_remediation_attempts} "
-                        f"(validator recycle, forced revalidation)")
+                        self._attempt_message(name, 1), token="attempt-1")
             return REMEDIATING
 
         if state == REMEDIATING:
-            if verdict is True:
-                return self._recover(node)
             attempts = 1
             try:
                 attempts = int(anns.get(consts.HEALTH_ATTEMPTS_ANNOTATION, "1"))
             except ValueError:
                 pass
+            if not self._event_exists(node, "NodeHealthRemediating",
+                                      f"remediation attempt {attempts}/"):
+                # crash repair — BEFORE the recovery transition below, or a
+                # node that revalidated while the operator was down exits
+                # the machine with the attempt unannounced forever. The
+                # attempts annotation is the write-ahead record of attempt
+                # N: a kill between it landing and the pod recycle (or its
+                # Event) leaves the attempt recorded but never fired, and
+                # the node would sit out the whole wait budget for a
+                # recycle that never happened. Re-fire the idempotent
+                # recycle (only while the verdict still fails — recycling
+                # a node that already revalidated is pointless disruption)
+                # and emit the missing announcement either way.
+                if verdict is not True:
+                    self._remediate(node, attempts)
+                self._event(node, events.NORMAL, "NodeHealthRemediating",
+                            self._attempt_message(name, attempts),
+                            token=f"attempt-{attempts}")
+            if verdict is True:
+                return self._recover(node)
             if self._state_age(node) < self.policy.remediation_wait_s:
                 return REMEDIATING  # give the attempt time to produce a verdict
             if attempts >= self.policy.max_remediation_attempts:
                 ds = self._driver_ds_for(node)
-                self._set_state(node, FAILED, extra_annotations={
-                    consts.HEALTH_FAILED_TEMPLATE_ANNOTATION:
-                        self._template_fingerprint(ds)})
+                if not self._set_state(node, FAILED, extra_annotations={
+                        consts.HEALTH_FAILED_TEMPLATE_ANNOTATION:
+                            self._template_fingerprint(ds)}):
+                    return node_health_state(node)
                 self._event(node, events.WARNING, "NodeHealthFailed",
                             f"{name}: {attempts} remediation attempt(s) "
                             f"exhausted; sticky failed until the driver "
@@ -487,14 +657,13 @@ class HealthStateMachine:
                 return FAILED
             attempts += 1
             # restamp since (fresh budget) + bump attempts in one patch
-            self._set_state(node, REMEDIATING, extra_annotations={
-                consts.HEALTH_ATTEMPTS_ANNOTATION: str(attempts)})
+            if not self._set_state(node, REMEDIATING, extra_annotations={
+                    consts.HEALTH_ATTEMPTS_ANNOTATION: str(attempts)}):
+                return node_health_state(node)
             self._remediate(node, attempts)
             self._event(node, events.NORMAL, "NodeHealthRemediating",
-                        f"{name}: remediation attempt {attempts}/"
-                        f"{self.policy.max_remediation_attempts}"
-                        + (" (driver restart + revalidation)"
-                           if attempts >= 2 else ""))
+                        self._attempt_message(name, attempts),
+                        token=f"attempt-{attempts}")
             return REMEDIATING
 
         if state == RECOVERED:
@@ -503,23 +672,27 @@ class HealthStateMachine:
                 # it via the next healthy->degraded entry... but this IS a
                 # flap — record it here so recover/relapse cycles trip the
                 # damper even though the label never touched healthy)
-                if self._record_degraded_entry(node):
-                    self._set_state(node, QUARANTINED, extra_annotations={
-                        consts.HEALTH_FLAP_STICKY_ANNOTATION:
-                            self._template_fingerprint(self._driver_ds_for(node))})
+                if self._record_degraded_entry(node, RECOVERED):
+                    if not self._set_state(node, QUARANTINED,
+                                           extra_annotations={
+                            consts.HEALTH_FLAP_STICKY_ANNOTATION:
+                                self._template_fingerprint(
+                                    self._driver_ds_for(node))}):
+                        return node_health_state(node)
                     if self.policy.cordon_on_quarantine:
                         self._cordon(node, True)
                     self._event(node, events.WARNING, "NodeHealthFlapping",
                                 f"{name}: relapse after recovery tripped "
                                 f"flap damping; sticky quarantine")
                     return QUARANTINED
-                self._set_state(node, DEGRADED)
+                if not self._set_state(node, DEGRADED):
+                    return node_health_state(node)
                 self._event(node, events.WARNING, "NodeHealthDegraded",
                             f"{name}: relapsed after recovery")
                 return DEGRADED
             # settled: leave the machine (label cleared, flap history kept)
             self._set_state(node, HEALTHY)
-            return HEALTHY
+            return node_health_state(node)
 
         # unknown label value (manual edit): treat as degraded-equivalent
         # input and let the verdict route it
@@ -531,13 +704,14 @@ class HealthStateMachine:
         name = node["metadata"]["name"]
         if self.policy.cordon_on_quarantine:
             self._cordon(node, False)
-        self._set_state(node, RECOVERED, extra_annotations={
-            consts.HEALTH_ATTEMPTS_ANNOTATION: None,
-            # episode over: retire the drain-protocol artifacts (the plan
-            # is never cleared MID-episode — a partitioner still waiting
-            # on it would otherwise wedge pending forever)
-            consts.RETILE_PLAN_ANNOTATION: None,
-            consts.DRAIN_ACK_ANNOTATION: None})
+        if not self._set_state(node, RECOVERED, extra_annotations={
+                consts.HEALTH_ATTEMPTS_ANNOTATION: None,
+                # episode over: retire the drain-protocol artifacts (the
+                # plan is never cleared MID-episode — a partitioner still
+                # waiting on it would otherwise wedge pending forever)
+                consts.RETILE_PLAN_ANNOTATION: None,
+                consts.DRAIN_ACK_ANNOTATION: None}):
+            return node_health_state(node)
         self._event(node, events.NORMAL, "NodeHealthRecovered",
                     f"{name}: workload barrier passing again; restoring "
                     f"configured layout")

@@ -88,11 +88,21 @@ class SpanLog:
 
     def read(self) -> List[dict]:
         try:
-            with open(self.path) as f:
+            with open(self.path, encoding="utf-8", errors="replace") as f:
                 raw = json.load(f)
-        except (FileNotFoundError, json.JSONDecodeError, OSError):
+        except FileNotFoundError:
+            return []
+        except (json.JSONDecodeError, UnicodeDecodeError, OSError) as e:
+            # torn write / truncation / binary garbage: a crash mid-append
+            # (or a racing operand) may leave a half-written file behind.
+            # Empty-with-warning, never raise — span history is advisory
+            # and the next atomic append replaces the file wholesale.
+            log.warning("span log %s unreadable (%s: %s); treating as empty",
+                        self.path, type(e).__name__, e)
             return []
         if not isinstance(raw, list):
+            log.warning("span log %s is not a JSON list; treating as empty",
+                        self.path)
             return []
         return [r for r in raw if valid_record(r)]
 

@@ -27,6 +27,7 @@ from typing import Dict, List, Optional
 import yaml
 
 from .. import consts
+from ..client.preconditions import preconditioned_patch
 from ..health import drain as drainproto
 from ..utils import deep_get, pod_requests_resource
 from ..validator.driver import discover_devices
@@ -161,6 +162,28 @@ def health_gated_chips(status_dir: Optional[str],
     return failed_local_chips(info, total_chips) or frozenset()
 
 
+def _set_state_label(client, node_name: str, value: Optional[str],
+                     expected_config: Optional[str]) -> None:
+    """rv-preconditioned write of the slice-state label. The patch is
+    re-derived against the fresh node on 409, and it re-validates the
+    desired-config label the verdict was computed from: a pass whose input
+    went stale mid-flight (admin re-labeled, operator's health sweep wiped
+    protocol state) declines instead of clobbering the newer writer."""
+    def build(fresh: dict) -> Optional[dict]:
+        fresh_labels = deep_get(fresh, "metadata", "labels", default={}) or {}
+        if fresh_labels.get(consts.TPU_SLICE_CONFIG_LABEL) != expected_config:
+            log.warning("slice state write on %s declined: desired "
+                        "partition changed mid-pass (was %r)", node_name,
+                        expected_config)
+            return None
+        if fresh_labels.get(consts.TPU_SLICE_STATE_LABEL) == value:
+            return None  # already there (replayed pass): no write, no event
+        return {"metadata": {
+            "labels": {consts.TPU_SLICE_STATE_LABEL: value}}}
+
+    preconditioned_patch(client, "v1", "Node", node_name, build)
+
+
 def sync_once(client, node_name: str, config_path: str,
               handoff_dir: str = DEFAULT_HANDOFF_DIR,
               total_chips: Optional[int] = None,
@@ -198,12 +221,10 @@ def sync_once(client, node_name: str, config_path: str,
                 log.warning("partition removal on %s deferred: TPU "
                             "consumer(s) still running", node_name)
                 if state != STATE_PENDING:
-                    client.patch("v1", "Node", node_name, {"metadata": {
-                        "labels": {consts.TPU_SLICE_STATE_LABEL:
-                                   STATE_PENDING}}})
+                    _set_state_label(client, node_name, STATE_PENDING,
+                                     expected_config=None)
                 return STATE_PENDING
-            client.patch("v1", "Node", node_name,
-                         {"metadata": {"labels": {consts.TPU_SLICE_STATE_LABEL: None}}})
+            _set_state_label(client, node_name, None, expected_config=None)
             try:
                 os.remove(os.path.join(handoff_dir, HANDOFF_FILE))
             except FileNotFoundError:
@@ -213,8 +234,7 @@ def sync_once(client, node_name: str, config_path: str,
     current = read_handoff(handoff_dir)
 
     def set_state(value: str) -> None:
-        client.patch("v1", "Node", node_name,
-                     {"metadata": {"labels": {consts.TPU_SLICE_STATE_LABEL: value}}})
+        _set_state_label(client, node_name, value, expected_config=desired)
 
     try:
         table = load_config(config_path)

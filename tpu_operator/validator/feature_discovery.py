@@ -14,6 +14,7 @@ import time
 from typing import Dict, Optional
 
 from .. import consts, tracing
+from ..client.preconditions import preconditioned_patch
 from ..utils import deep_get
 from .driver import discover_devices
 
@@ -171,7 +172,11 @@ def sync_node_labels(client, node_name: str, use_jax: bool = True) -> Dict[str, 
     # drain gate reads acks from annotations; the barrier stays the
     # node-local source of truth the partitioner consults directly).
     # Cleared when the stamp disappears — a revalidation rewrite of the
-    # barrier retires the ack along with the episode.
+    # barrier retires the ack along with the episode. rv-preconditioned
+    # (the stale-stamp janitor path included): this mirror races the
+    # health sweep's episode-retirement write, and a blind patch computed
+    # from a pre-retirement read would resurrect the retired ack or lose
+    # the sweep's concurrent wipe.
     from ..health import drain as drainproto
     from .status import StatusFiles
     status_dir = os.environ.get("STATUS_DIR", consts.VALIDATION_STATUS_DIR)
@@ -180,8 +185,15 @@ def sync_node_labels(client, node_name: str, use_jax: bool = True) -> Dict[str, 
     current_ack = deep_get(node, "metadata", "annotations",
                            consts.DRAIN_ACK_ANNOTATION)
     if ack_value != current_ack:
-        client.patch("v1", "Node", node_name, {"metadata": {
-            "annotations": {consts.DRAIN_ACK_ANNOTATION: ack_value}}})
+        def build_ack(fresh: dict) -> Optional[dict]:
+            fresh_ack = deep_get(fresh, "metadata", "annotations",
+                                 consts.DRAIN_ACK_ANNOTATION)
+            if fresh_ack == ack_value:
+                return None  # already mirrored (or janitor-cleared) by now
+            return {"metadata": {
+                "annotations": {consts.DRAIN_ACK_ANNOTATION: ack_value}}}
+
+        preconditioned_patch(client, "v1", "Node", node_name, build_ack)
         if ack_value:
             log.info("feature discovery: %s drain ack -> %s",
                      node_name, ack_value)
