@@ -58,6 +58,12 @@ serving-bench:  ## serving SLO probe (healthy + quarantined fail-closed) + seede
 join-bench:  ## one-node end-to-end join trace + critical-path attribution; fails unless attribution covers >=95% of the join window with zero orphan spans. Trace id pinned by construction (sha256 of the policy identity); JAX on CPU for run-to-run comparability.
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --join-only
 
+SCALE_BENCH_SEED ?= 20260805
+
+.PHONY: scale-bench
+scale-bench:  ## 5,000-node join + label-churn envelope through the latency-injected simulator; fails unless churn traffic is O(events) (fleet-size-independent per-event request budget) and reconcile p99 stays under the gate
+	SCALE_BENCH_SEED=$(SCALE_BENCH_SEED) JAX_PLATFORMS=cpu $(PYTHON) bench.py --scale-only
+
 .PHONY: generate
 generate:  ## regenerate CRDs into all install channels (reference: make manifests)
 	$(PYTHON) hack/gen-crds.py

@@ -562,6 +562,92 @@ def test_unfenced_write_suppressed():
     assert kept == [] and dropped == 1
 
 
+# -- unbatched-sweep-write ----------------------------------------------------
+
+SWEEP_LOOP_WRITE = """
+    def label_fleet(client, nodes):
+        for node in nodes:
+            client.patch("v1", "Node", node["metadata"]["name"],
+                         {"metadata": {"labels": {"tpu.ai/tpu.present": "true"}}})
+"""
+
+
+def test_unbatched_sweep_write_positive_loop_patch():
+    kept, _ = lint(SWEEP_LOOP_WRITE, "tpu_operator/nodeinfo/labeler.py",
+                   "unbatched-sweep-write")
+    assert rules_of(kept) == ["unbatched-sweep-write"]
+    assert "write batcher" in kept[0].message
+
+
+def test_unbatched_sweep_write_positive_while_update_status():
+    src = """
+        def drain(client, queue):
+            while queue:
+                obj = queue.pop()
+                client.update_status("tpu.ai/v1", "TPUDriver",
+                                     obj["metadata"]["name"], obj)
+    """
+    kept, _ = lint(src, "tpu_operator/state/manager.py",
+                   "unbatched-sweep-write")
+    assert rules_of(kept) == ["unbatched-sweep-write"]
+
+
+def test_unbatched_sweep_write_negative_batched_routes():
+    # the sanctioned routes: coalesced_patch / preconditioned_patch are
+    # plain-name calls, defer_patch is the batcher's own entry point
+    src = """
+        from tpu_operator.client.batch import coalesced_patch
+        from tpu_operator.client.preconditions import preconditioned_patch
+
+        def label_fleet(client, batcher, nodes):
+            for node in nodes:
+                name = node["metadata"]["name"]
+                coalesced_patch(client, "v1", "Node", name,
+                                {"metadata": {"labels": {"a": "b"}}})
+                preconditioned_patch(client, "v1", "Node", name,
+                                     lambda cur: {"metadata": {}})
+                batcher.defer_patch("v1", "Node", name,
+                                    lambda cur: {"metadata": {}})
+    """
+    kept, _ = lint(src, "tpu_operator/nodeinfo/labeler.py",
+                   "unbatched-sweep-write")
+    assert kept == []
+
+
+def test_unbatched_sweep_write_negative_outside_loop_and_barrier_verbs():
+    # a single patch outside any loop is one round-trip, not a sweep; and
+    # barrier verbs (create/delete/evict) deliberately flush, not coalesce
+    src = """
+        def reconcile(client, pods):
+            client.patch("v1", "Node", "tpu-0", {"metadata": {}})
+            for pod in pods:
+                client.evict("v1", "Pod", pod["metadata"]["name"])
+                client.delete("v1", "Pod", pod["metadata"]["name"])
+    """
+    kept, _ = lint(src, "tpu_operator/upgrade/machine.py",
+                   "unbatched-sweep-write")
+    assert kept == []
+
+
+def test_unbatched_sweep_write_out_of_scope_dirs_skipped():
+    # the batcher itself loops over its deferred writes; the validator is
+    # a node agent with no sweep loop over the fleet
+    for rel in ("tpu_operator/client/batch.py",
+                "tpu_operator/validator/main.py"):
+        kept, _ = lint(SWEEP_LOOP_WRITE, rel, "unbatched-sweep-write")
+        assert kept == [], rel
+
+
+def test_unbatched_sweep_write_suppressed():
+    src = SWEEP_LOOP_WRITE.replace(
+        'client.patch("v1", "Node", node["metadata"]["name"],',
+        'client.patch("v1", "Node", node["metadata"]["name"],  '
+        '# opalint: disable=unbatched-sweep-write — bootstrap path, fleet of 1')
+    kept, dropped = lint(src, "tpu_operator/nodeinfo/labeler.py",
+                         "unbatched-sweep-write")
+    assert kept == [] and dropped == 1
+
+
 # -- CLI ----------------------------------------------------------------------
 
 POSITIVE_FIXTURES = {
@@ -592,6 +678,8 @@ POSITIVE_FIXTURES = {
             return sp
     """),
     "unfenced-write": ("tpu_operator/controllers/manager.py", UNFENCED_CHAIN),
+    "unbatched-sweep-write": ("tpu_operator/nodeinfo/labeler.py",
+                              SWEEP_LOOP_WRITE),
 }
 
 

@@ -8,6 +8,8 @@ tests pin the whole chain: an impossible fraction must come out of
 which half of the validator caught it.
 """
 
+import pytest
+
 from bench import perf_summary  # repo root is on sys.path via conftest
 
 
@@ -134,3 +136,32 @@ def test_run_perf_peak_overshoot_invalidates_measurement(monkeypatch):
     assert len(report.failures) == 1
     assert "exceeds chip peak" in report.failures[0]
     assert perf_summary(report.to_dict())["perf_measurement_valid"] is False
+
+
+# -- single-node join request budget (docs/design.md §13) ---------------------
+
+#: hard regression budget for a cached+batched single-node join through the
+#: latency-injected simulator. History: 183 requests before the event-driven
+#: refactor (per-sweep LISTs + per-node writes), 18-21 after (informer
+#: caches, write coalescing, change-skip status writes). The budget leaves
+#: headroom for scheduling noise but fails long before a relist or an
+#: unbatched sweep can hide: any O(nodes·sweeps) regression re-adds
+#: requests by the dozen.
+JOIN_REQUEST_BUDGET = 50
+
+
+@pytest.mark.slow
+def test_single_node_join_request_budget():
+    import bench
+
+    join_s, join_requests, _ = bench.bench_control_plane(
+        n_nodes=1, timeout=115.0, **bench.INJECTED)
+    assert join_s is not None, "1-node join did not converge"
+    assert join_requests < 100, (
+        f"join cost {join_requests} requests — triple digits means the "
+        "event-driven contract broke (was 183 before the informer+batcher "
+        "refactor)")
+    assert join_requests <= JOIN_REQUEST_BUDGET, (
+        f"join cost {join_requests} requests (budget "
+        f"{JOIN_REQUEST_BUDGET}); check for per-sweep LISTs or per-node "
+        "writes bypassing the WriteBatcher")

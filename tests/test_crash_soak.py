@@ -37,6 +37,7 @@ import requests
 
 from tpu_operator import consts
 from tpu_operator.api.clusterpolicy import new_cluster_policy
+from tpu_operator.client.batch import WriteBatcher
 from tpu_operator.client.cache import CachedClient
 from tpu_operator.client.chaos import (
     CrashPointClient,
@@ -96,7 +97,10 @@ def barrier(passed, failed=None):
 class CrashEpisode:
     """One full drain/retile episode with an optional armed crash point.
 
-    The operator runs on ``CachedClient(CrashPointClient(RestClient))``;
+    The operator runs on
+    ``CachedClient(WriteBatcher(CrashPointClient(RestClient)))`` — the
+    coalescer flushes *into* the crash-point recorder, so a merged batch
+    is one enumerable mutating site;
     node agents and assertions use a separate plain client (agents are
     separate processes — a dying operator cannot take them down). Every
     wait loop polls :meth:`maybe_restart`, so the kill is followed by a
@@ -119,7 +123,7 @@ class CrashEpisode:
         self.chaos = RestClient(base_url=self.base)
         crash = CrashPointClient(RestClient(base_url=self.base), arm=arm)
         self.crashpoints = [crash]
-        op_client = CachedClient(crash)
+        op_client = CachedClient(WriteBatcher(crash))
         self.kubelet = KubeletSimulator(self.chaos, interval=0.05,
                                         create_pods=True).start()
         self.app = OperatorApp(op_client)
@@ -146,7 +150,7 @@ class CrashEpisode:
         self.apps[-1].stop()
         self.clients[-1].stop()
         crash = CrashPointClient(RestClient(base_url=self.base))
-        client = CachedClient(crash)
+        client = CachedClient(WriteBatcher(crash))
         app = OperatorApp(client)
         self.crashpoints.append(crash)
         self.clients.append(client)

@@ -100,3 +100,41 @@ def test_periodic_resync_reenqueues_lost_work():
         time.sleep(0.01)
     controller.stop()
     assert len(seen) >= 3
+
+
+def test_resync_delay_full_jitter_on_back_half():
+    """Every cycle draws a fresh uniform(period/2, period): replicas (or
+    controllers) started in lockstep must never LIST in lockstep forever —
+    at 5,000 nodes a phase-aligned resync is an apiserver spike per
+    period."""
+    from tpu_operator.controllers.runtime import Controller, Reconciler
+
+    class Rec(Reconciler):
+        name = "jitter-test"
+
+        def reconcile(self, request):  # pragma: no cover — never started
+            raise AssertionError
+
+    controller = Controller(Rec())
+    controller.resyncs(lambda: [], period=10.0)
+    draws = {controller._resync_delay() for _ in range(200)}
+    assert all(5.0 <= d <= 10.0 for d in draws)
+    assert len(draws) > 1  # fresh draw per cycle, not one pinned offset
+
+    controller.resyncs(lambda: [], period=10.0, jitter=False)
+    assert controller._resync_delay() == 10.0
+
+
+def test_all_three_controllers_resync_jittered_with_env_default():
+    """The safety-net resync is demoted to TPU_OPERATOR_RESYNC_S (default
+    300s) on all three controllers, jitter on — event delivery is the
+    primary trigger, the resync only catches missed events."""
+    from tpu_operator.controllers import (
+        clusterpolicy_controller,
+        tpudriver_controller,
+        upgrade_controller,
+    )
+
+    for mod in (clusterpolicy_controller, tpudriver_controller,
+                upgrade_controller):
+        assert mod.RESYNC_PERIOD_S == 300.0, mod.__name__

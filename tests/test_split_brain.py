@@ -37,6 +37,7 @@ from test_crash_soak import PARTITIONS, TPU_LABELS, barrier, default_images  # n
 
 from tpu_operator import consts
 from tpu_operator.api.clusterpolicy import new_cluster_policy
+from tpu_operator.client.batch import WriteBatcher
 from tpu_operator.client.cache import CachedClient
 from tpu_operator.client.errors import ApiError, FencedError
 from tpu_operator.client.fenced import FencedClient
@@ -96,10 +97,12 @@ class Replica:
     def __init__(self, base, ident):
         self.direct = LeasePartitionedClient(RestClient(base_url=base))
         self.fenced = FencedClient(RestClient(base_url=base))
-        self.client = CachedClient(RetryingClient(
+        # coalescer above retry/fencing, as in run_operator: a flushed
+        # batch rides the limiter and every merged PATCH passes the fence
+        self.client = CachedClient(WriteBatcher(RetryingClient(
             self.fenced,
             limiter=TokenBucket(qps=200.0, burst=400),
-            breaker=CircuitBreaker(threshold=5)))
+            breaker=CircuitBreaker(threshold=5))))
         self.app = OperatorApp(self.client)
         self.elector = LeaderElector(
             self.direct, NAMESPACE, identity=ident,

@@ -9,5 +9,6 @@ from . import (  # noqa: F401
     lock_discipline,
     metrics_discipline,
     span_discipline,
+    unbatched_sweep_write,
     unfenced_write,
 )

@@ -11,6 +11,7 @@ from .errors import (
 )
 from .interface import Client, WatchEvent
 from .fake import FakeClient
+from .batch import WriteBatcher, batch_window, coalesced_patch, find_batcher
 from .preconditions import preconditioned_patch
 from .scheme import Scheme, default_scheme
 
@@ -27,6 +28,10 @@ __all__ = [
     "Client",
     "WatchEvent",
     "FakeClient",
+    "WriteBatcher",
+    "batch_window",
+    "coalesced_patch",
+    "find_batcher",
     "preconditioned_patch",
     "Scheme",
     "default_scheme",

@@ -27,6 +27,7 @@ import logging
 import time
 from typing import Callable, Optional
 
+from .batch import find_batcher
 from .errors import ConflictError
 from .interface import Client
 
@@ -52,7 +53,17 @@ def preconditioned_patch(client: Client, api_version: str, kind: str,
     Returns the server's post-patch object (the fresh read when ``build``
     declined). NotFoundError propagates to the caller — object lifecycle
     is its policy, not this helper's.
+
+    When the client chain carries a :class:`~.batch.WriteBatcher` with an
+    open flush window, the write is deferred instead: ``build`` is queued
+    and re-run at flush against the read the merged patch is preconditioned
+    on, and the returned object is an optimistic local projection of the
+    patch (callers mirror it into sweep snapshots; the flush's own
+    recompute-reapply loop preserves the 409 contract).
     """
+    batcher = find_batcher(client)
+    if batcher is not None and batcher.window_active:
+        return batcher.defer_patch(api_version, kind, name, build, namespace)
     last_exc: Optional[ConflictError] = None
     for attempt in range(attempts):
         if attempt:

@@ -10,13 +10,16 @@ re-applies everything (hash-skips make that cheap).
 
 from __future__ import annotations
 
+import copy
 import logging
 import os
 import time
-from typing import List, Optional
+from concurrent import futures
+from typing import Callable, List, Optional
 
 from .. import consts, events, tracing
 from ..api.clusterpolicy import ClusterPolicy, State
+from ..client.batch import batch_window
 from ..client.errors import ConflictError, NotFoundError
 from ..client.interface import Client, WatchEvent
 from ..conditions import (
@@ -39,15 +42,18 @@ from ..conditions import (
     set_condition,
 )
 from ..health import HealthCounts, HealthStateMachine
+from ..health import drain as drain_protocol
 from ..nodeinfo import label_tpu_nodes
 from ..state.manager import (
     INFO_CLUSTER_INFO,
     INFO_CLUSTER_POLICY,
     INFO_NAMESPACE,
+    INFO_NODE_POOLS,
     INFO_NODES,
     InfoCatalog,
     Manager,
 )
+from ..state.nodepool import NodePool, get_node_pools, shard_by_pools
 from ..state.operands import cluster_policy_states
 from ..utils import deep_get
 from .metrics import OperatorMetrics
@@ -58,6 +64,16 @@ log = logging.getLogger(__name__)
 
 #: reference requeues 5 s on NotReady (clusterpolicy_controller.go:165,193)
 NOT_READY_REQUEUE = 5.0
+
+#: watch events drive reconciles now; the periodic LIST-resync is a lost-
+#: event safety net, not the cadence (jittered uniform(period/2, period))
+RESYNC_PERIOD_S = float(os.environ.get("TPU_OPERATOR_RESYNC_S", "300"))
+
+#: parallel workers for the pool-sharded node sweeps (health, serving):
+#: pools reconcile independently, so one slow/degraded pool never
+#: serializes the rest of the fleet behind it
+POOL_SWEEP_WORKERS = max(1, int(os.environ.get("TPU_OPERATOR_POOL_WORKERS",
+                                               "4")))
 
 
 class ClusterPolicyReconciler(Reconciler):
@@ -109,7 +125,14 @@ class ClusterPolicyReconciler(Reconciler):
             return None  # reconcile of a non-primary instance: nothing to do
         return ClusterPolicy.from_obj(primary)
 
-    def _write_status(self, obj: dict) -> None:
+    def _write_status(self, obj: dict,
+                      unchanged_from: Optional[dict] = None) -> None:
+        if unchanged_from is not None and obj.get("status") == unchanged_from:
+            # O(events) discipline: an identical status is not written, so
+            # a ready steady-state sweep generates zero status traffic —
+            # set_condition keeps lastTransitionTime stable on unchanged
+            # conditions precisely so this comparison can work
+            return
         with tracing.phase_span("status-update") as sp:
             try:
                 self.client.update_status(obj)
@@ -145,11 +168,29 @@ class ClusterPolicyReconciler(Reconciler):
     def reconcile(self, request: Request) -> Result:
         self.metrics.reconciliation_total.inc()
         try:
-            return self._reconcile(request)
+            # one flush window per sweep: every deferred per-node write the
+            # sweep generates merges into one PATCH per object, dispatched
+            # at window exit (or by the batcher's deadline safety net)
+            with batch_window(self.client):
+                return self._reconcile(request)
         except Exception:
             self.metrics.reconciliation_failed.inc()
             self.metrics.reconciliation_status.set(0)
             raise
+
+    def _pool_parallel(self, jobs: List[Callable[[], object]]) -> list:
+        """Run one job per pool shard. Sequential for a single shard (or
+        workers=1); otherwise a bounded thread pool. Results in job order;
+        the first job exception re-raises after all complete (FencedError/
+        BreakerOpenError then reach the runtime worker's handlers)."""
+        if len(jobs) <= 1 or POOL_SWEEP_WORKERS <= 1:
+            return [job() for job in jobs]
+        workers = min(POOL_SWEEP_WORKERS, len(jobs))
+        with futures.ThreadPoolExecutor(
+                max_workers=workers,
+                thread_name_prefix="pool-sweep") as pool:
+            return [f.result() for f in
+                    [pool.submit(job) for job in jobs]]
 
     def _surface_slice_failures(self, policy: ClusterPolicy,
                                 nodes: List[dict]) -> None:
@@ -182,23 +223,15 @@ class ClusterPolicyReconciler(Reconciler):
             set_condition(conditions, make_condition(
                 SLICE_PARTITION_FAILED, "False", REASON_READY, ""))
 
-    def _sweep_serving(self, policy: ClusterPolicy,
-                       nodes: List[dict]) -> None:
-        """Roll the per-node serving-SLO verdicts up to the CR. Feature
-        discovery publishes each node's verdict as the ``tpu.ai/serving-slo``
-        label with measured numbers in the detail annotation; this sweep
-        republishes them as operator gauges and maintains a
-        ``ServingValidated`` condition + transition-gated Warning Event.
-        Nodes with no verdict (serving validation disabled, or not yet
-        probed) are no-information: they neither fail nor certify."""
+    def _scan_serving_shard(self, shard: List[dict]) -> tuple:
+        """Per-pool serving scan: publish per-node gauges, return this
+        shard's (failing, reporting). Touches only its own pool's nodes —
+        gauge label sets are per-node, so parallel shards never collide."""
         from ..validator.serving import parse_serving_detail
 
         failing: List[str] = []
         reporting = 0
-        self.metrics.serving_decode_p99.clear()
-        self.metrics.serving_throughput.clear()
-        self.metrics.serving_slo_attainment.clear()
-        for node in nodes:
+        for node in shard:
             name = node["metadata"]["name"]
             verdict = deep_get(node, "metadata", "labels",
                                consts.SERVING_SLO_LABEL)
@@ -219,6 +252,29 @@ class ClusterPolicyReconciler(Reconciler):
             if "attainment" in detail:
                 self.metrics.serving_slo_attainment.labels(node=name).set(
                     detail["attainment"])
+        return failing, reporting
+
+    def _sweep_serving(self, policy: ClusterPolicy, nodes: List[dict],
+                       pools: Optional[List[NodePool]] = None) -> None:
+        """Roll the per-node serving-SLO verdicts up to the CR. Feature
+        discovery publishes each node's verdict as the ``tpu.ai/serving-slo``
+        label with measured numbers in the detail annotation; this sweep
+        republishes them as operator gauges and maintains a
+        ``ServingValidated`` condition + transition-gated Warning Event.
+        Nodes with no verdict (serving validation disabled, or not yet
+        probed) are no-information: they neither fail nor certify. The scan
+        is sharded by node pool and runs pools in parallel workers."""
+        self.metrics.serving_decode_p99.clear()
+        self.metrics.serving_throughput.clear()
+        self.metrics.serving_slo_attainment.clear()
+        shards = shard_by_pools(nodes, pools if pools is not None
+                                else get_node_pools(nodes))
+        scans = self._pool_parallel(
+            [lambda shard=shard: self._scan_serving_shard(shard)
+             for shard in shards])
+        failing = [name for shard_failing, _ in scans
+                   for name in shard_failing]
+        reporting = sum(n for _, n in scans)
         self.metrics.serving_slo_failing_nodes.set(len(failing))
         self._last_serving_failing = sorted(failing)
         conditions = policy.obj.setdefault("status", {}).setdefault(
@@ -247,15 +303,38 @@ class ClusterPolicyReconciler(Reconciler):
                 SERVING_VALIDATED, "Unknown", REASON_SERVING_NOT_REPORTING,
                 "no nodes reporting a serving-SLO verdict"))
 
-    def _sweep_health(self, policy: ClusterPolicy,
-                      nodes: List[dict]) -> None:
+    @staticmethod
+    def _next_drain_deadline(nodes: List[dict]) -> Optional[float]:
+        """Seconds until the nearest open drain-plan deadline, or None when
+        no window is open. An expiring deadline changes nothing on the
+        apiserver, so the reconciler schedules its own wakeup for it
+        instead of leaning on the (now 300s-class) safety-net resync."""
+        now = time.time()
+        soonest: Optional[float] = None
+        for node in nodes:
+            plan = drain_protocol.node_plan(node)
+            if plan is None:
+                continue
+            delay = plan.deadline - now
+            if soonest is None or delay < soonest:
+                soonest = delay
+        if soonest is None:
+            return None
+        # past-due plans force-release on the very next sweep; the floor
+        # keeps a herd of expired plans from busy-looping the worker
+        return max(0.25, soonest + 0.1)
+
+    def _sweep_health(self, policy: ClusterPolicy, nodes: List[dict],
+                      pools: Optional[List[NodePool]] = None) -> None:
         """Drive the per-node chip-health machine and publish its rollup:
         per-state gauges, the remediation-attempts counter, the retile
         counter (transitions into tpu.ai/slice.config.state=retiled), and
         a cluster-level NodeHealthDegraded condition + transition-gated
         Event. Driven from THIS sweep (not a separate controller) so the
         machine resumes mid-remediation on the same cadence that re-renders
-        the operands it recycles."""
+        the operands it recycles. Sharded by node pool: each shard gets its
+        own machine (no cross-pool state) and pools run in parallel
+        workers, so a pool mid-drain never stalls the others' sweeps."""
         # retile transitions are counted regardless of health.enabled: the
         # partitioner re-tiles from the barrier on its own
         for node in nodes:
@@ -266,23 +345,36 @@ class ClusterPolicyReconciler(Reconciler):
                 self.metrics.partition_retile_total.inc()
             self._last_slice_state[name] = state
 
-        machine = HealthStateMachine(self.client, self.namespace,
-                                     policy.spec.health)
         if not policy.spec.health.enabled:
-            machine.clear_all(nodes)
+            machines = [HealthStateMachine(self.client, self.namespace,
+                                           policy.spec.health)]
+            machines[0].clear_all(nodes)
             counts = HealthCounts(healthy=len(nodes))
         else:
+            shards = shard_by_pools(nodes, pools if pools is not None
+                                    else get_node_pools(nodes))
+            machines = [HealthStateMachine(self.client, self.namespace,
+                                           policy.spec.health)
+                        for _ in shards]
             with tracing.phase_span("health-sweep") as sp:
-                counts = machine.process(nodes)
-                sp.set_attributes(**counts.as_dict())
+                shard_counts = self._pool_parallel(
+                    [lambda m=machine, s=shard: m.process(s)
+                     for machine, shard in zip(machines, shards)])
+                counts = HealthCounts()
+                for c in shard_counts:
+                    counts = counts.merged(c)
+                sp.set_attributes(shards=len(machines), **counts.as_dict())
         self._last_health_counts = counts.as_dict()
         for state, value in counts.as_dict().items():
             self.metrics.node_health_state.labels(state=state).set(value)
-        if machine.attempts_fired:
-            self.metrics.remediation_attempts.inc(machine.attempts_fired)
-        if machine.deadline_misses:
-            self.metrics.drain_deadline_missed.inc(machine.deadline_misses)
-        self.metrics.drains_in_progress.set(machine.plans_pending)
+        attempts_fired = sum(m.attempts_fired for m in machines)
+        deadline_misses = sum(m.deadline_misses for m in machines)
+        if attempts_fired:
+            self.metrics.remediation_attempts.inc(attempts_fired)
+        if deadline_misses:
+            self.metrics.drain_deadline_missed.inc(deadline_misses)
+        self.metrics.drains_in_progress.set(
+            sum(m.plans_pending for m in machines))
 
         unhealthy = {s: v for s, v in counts.as_dict().items()
                      if s not in ("healthy", "recovered") and v}
@@ -312,6 +404,9 @@ class ClusterPolicyReconciler(Reconciler):
             policy = None
         if policy is None:
             return Result()
+        # status as read this sweep: the pre-write comparison that keeps a
+        # no-op sweep from writing an identical status (O(events) traffic)
+        status_as_read = copy.deepcopy(policy.obj.get("status"))
 
         self._ensure_psa_labels(policy)
 
@@ -320,12 +415,16 @@ class ClusterPolicyReconciler(Reconciler):
             label_result = label_tpu_nodes(self.client, policy, self.namespace)
             sp.set_attribute("tpu_nodes", label_result.tpu_nodes)
         self.metrics.tpu_nodes_total.set(label_result.tpu_nodes)
+        # one pool computation per sweep: the sharding source for the
+        # node-facing sweeps below and for any state that fans out per pool
+        pools = get_node_pools(label_result.nodes)
 
         catalog = InfoCatalog()
         catalog[INFO_CLUSTER_POLICY] = policy
         catalog[INFO_NAMESPACE] = self.namespace
         catalog[INFO_CLUSTER_INFO] = self.cluster_info
         catalog[INFO_NODES] = label_result.nodes
+        catalog[INFO_NODE_POOLS] = pools
 
         with tracing.phase_span("sync-state") as sp:
             results = self.state_manager.sync_state(catalog)
@@ -341,8 +440,8 @@ class ClusterPolicyReconciler(Reconciler):
         # writes: an exception between the Warning Event and the condition
         # landing on the CR would re-emit the event every backoff retry
         self._surface_slice_failures(policy, label_result.nodes)
-        self._sweep_health(policy, label_result.nodes)
-        self._sweep_serving(policy, label_result.nodes)
+        self._sweep_health(policy, label_result.nodes, pools)
+        self._sweep_serving(policy, label_result.nodes, pools)
         previous_state = deep_get(policy.obj, "status", "state")
 
         if results.ready:
@@ -351,11 +450,18 @@ class ClusterPolicyReconciler(Reconciler):
                               events.NORMAL, "Ready", "all operand states are ready")
             policy.set_state(State.READY, self.namespace)
             mark_ready(policy.obj)
-            self._write_status(policy.obj)  # state + conditions atomically
+            # state + conditions atomically; skipped when nothing changed
+            self._write_status(policy.obj, unchanged_from=status_as_read)
             self.metrics.reconciliation_status.set(1)
             self.metrics.reconciliation_last_success.set_to_current_time()
             log.info("ClusterPolicy %s ready (%.3fs, %d TPU nodes)",
                      policy.name, time.monotonic() - start, label_result.tpu_nodes)
+            # time-based work must schedule its own wakeup: a drain-plan
+            # deadline expiring produces no watch event, and the resync is
+            # now a 300s-class safety net, not a 10s poll
+            wake = self._next_drain_deadline(label_result.nodes)
+            if wake is not None:
+                return Result(requeue_after=wake)
             return Result()
 
         blocker = results.first_not_ready()
@@ -372,7 +478,8 @@ class ClusterPolicyReconciler(Reconciler):
             events.record(self.client, self.namespace, policy.obj,
                           events.WARNING, reason, message)
         mark_error(policy.obj, reason, message)
-        self._write_status(policy.obj)  # state + conditions atomically
+        # state + conditions atomically; skipped when nothing changed
+        self._write_status(policy.obj, unchanged_from=status_as_read)
         self.metrics.reconciliation_status.set(0)
         log.info("ClusterPolicy %s not ready: %s", policy.name, message)
         return Result(requeue_after=self.requeue_after)
@@ -425,8 +532,22 @@ def setup_clusterpolicy_controller(client: Client,
     # watch against a real apiserver is a cluster-wide pod firehose
     controller.watches("apps/v1", "DaemonSet", map_owned,
                        namespace=reconciler.namespace)
+    # the other state-labeled operand kinds: out-of-band drift (a kubectl
+    # edit of a rendered Service port, a wiped ConfigMap) must trigger the
+    # heal sweep as an event — the jittered safety-net resync is too slow
+    # to be the drift-repair path
+    controller.watches("v1", "Service", map_owned,
+                       namespace=reconciler.namespace)
+    controller.watches("v1", "ConfigMap", map_owned,
+                       namespace=reconciler.namespace)
+    controller.watches("v1", "ServiceAccount", map_owned,
+                       namespace=reconciler.namespace)
     controller.watches("tpu.ai/v1alpha1", "TPUDriver", map_tpudriver)
     controller.watches("v1", "Pod", map_validation_pod,
                        namespace=reconciler.namespace)
-    controller.resyncs(lambda: _all_policy_requests(client), period=10.0)
+    # demoted to a safety net: watch events (nodes, owned DaemonSets,
+    # TPUDriver CRs, validation pods) drive reconciles; the jittered LIST
+    # only recovers mappings lost to a watch-stream gap
+    controller.resyncs(lambda: _all_policy_requests(client),
+                       period=RESYNC_PERIOD_S)
     return controller

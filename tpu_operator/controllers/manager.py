@@ -234,6 +234,14 @@ class OperatorApp:
         self.fenced = find_fenced(client)
         if self.fenced is not None:
             self.metrics.wire_fencing(self.fenced)
+        # write coalescer: flush re-reads ride the full chain (cache-first
+        # when CachedClient sits on top), batch-size/total counters exported
+        from ..client.batch import find_batcher
+
+        self.batcher = find_batcher(client)
+        if self.batcher is not None:
+            self.batcher.bind_read_client(client)
+            self.metrics.wire_batching(self.batcher)
         self._metrics_port = metrics_port
         self._health_port = health_port
         self._servers: list = []
@@ -310,6 +318,8 @@ class OperatorApp:
 
     def stop(self) -> None:
         self.manager.stop()
+        if self.batcher is not None:
+            self.batcher.stop()  # best-effort flush of any deferred writes
         for s in self._servers:
             s.shutdown()
         self._servers = []  # a later start_servers() must re-create them
@@ -355,6 +365,14 @@ def run_operator(args) -> int:
                             burst=getattr(args, "api_burst", 40)),
         breaker=CircuitBreaker(
             threshold=getattr(args, "breaker_threshold", 5)))
+    # write coalescer ABOVE retry/fencing: deferred per-node label/
+    # annotation/condition writes merge into one preconditioned PATCH per
+    # object per reconcile window, and every flushed patch still rides the
+    # retry limiter and the leader fence (a deposed replica's whole batch
+    # fences, none of it half-applies)
+    from ..client.batch import WriteBatcher
+
+    client = WriteBatcher(client)
     if getattr(args, "cache_reads", True):
         # reconcile reads come from informer caches, as in controller-runtime
         # (the reference never GETs in its hot loop; main.go:111-117) —

@@ -13,6 +13,7 @@ import contextlib
 import dataclasses
 import heapq
 import logging
+import random
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
@@ -205,6 +206,7 @@ class Controller:
         self._thread: Optional[threading.Thread] = None
         self._resync_fn: Optional[Callable[[], List[Request]]] = None
         self._resync_period: float = 0.0
+        self._resync_jitter: bool = True
         self._stop_event = threading.Event()
 
     def watches(self, api_version: str, kind: str,
@@ -214,12 +216,19 @@ class Controller:
         return self
 
     def resyncs(self, fn: Callable[[], List[Request]],
-                period: float = 30.0) -> "Controller":
+                period: float = 30.0, jitter: bool = True) -> "Controller":
         """Informer-style periodic resync: a level-driven controller must
         converge even if a watch event is lost (stream reconnect gap, mapper
-        error), so re-enqueue everything every ``period`` seconds."""
+        error), so re-enqueue everything roughly every ``period`` seconds.
+
+        With ``jitter`` (the default) each cycle waits a fresh
+        ``uniform(period/2, period)`` — full jitter on the back half, so
+        replicas started in lockstep (a rolling Deployment restart) never
+        LIST in lockstep forever, the thundering herd a 5,000-node fleet
+        amplifies into an apiserver spike per period."""
         self._resync_fn = fn
         self._resync_period = period
+        self._resync_jitter = jitter
         return self
 
     def start(self, client: Client) -> None:
@@ -259,8 +268,13 @@ class Controller:
                              daemon=True,
                              name=f"{self.reconciler.name}-resync").start()
 
+    def _resync_delay(self) -> float:
+        if not self._resync_jitter:
+            return self._resync_period
+        return random.uniform(self._resync_period / 2.0, self._resync_period)
+
     def _resync_loop(self, stop_event: threading.Event) -> None:
-        while not stop_event.wait(self._resync_period):
+        while not stop_event.wait(self._resync_delay()):
             try:
                 for request in self._resync_fn():
                     self.queue.add(request)

@@ -11,6 +11,7 @@ are garbage-collected when pools disappear.
 
 from __future__ import annotations
 
+import copy
 import logging
 import os
 from typing import Dict, List, Optional
@@ -18,6 +19,7 @@ from typing import Dict, List, Optional
 from .. import consts, events, tracing
 from ..api.clusterpolicy import ClusterPolicy, State
 from ..api.tpudriver import TPUDriver
+from ..client.batch import batch_window
 from ..client.errors import ConflictError, NotFoundError
 from ..client.interface import Client, WatchEvent
 from ..conditions import (
@@ -41,6 +43,10 @@ log = logging.getLogger(__name__)
 INSTANCE_LABEL = "tpu.ai/driver-instance"
 
 NOT_READY_REQUEUE = 5.0
+
+#: lost-event safety net, not the reconcile cadence (watch-driven now);
+#: jittered by the runtime so replicas never LIST in lockstep
+RESYNC_PERIOD_S = float(os.environ.get("TPU_OPERATOR_RESYNC_S", "300"))
 
 
 def find_selector_conflicts(instances: List[TPUDriver], nodes: List[dict]) -> Dict[str, List[str]]:
@@ -74,7 +80,10 @@ class TPUDriverReconciler(Reconciler):
                                      p["metadata"]["name"]))
         return ClusterPolicy.from_obj(policies[0])
 
-    def _write_status(self, obj: dict) -> None:
+    def _write_status(self, obj: dict,
+                      unchanged_from: Optional[dict] = None) -> None:
+        if unchanged_from is not None and obj.get("status") == unchanged_from:
+            return  # identical status: no write (O(events) discipline)
         with tracing.phase_span("status-update") as sp:
             try:
                 self.client.update_status(obj)
@@ -87,11 +96,16 @@ class TPUDriverReconciler(Reconciler):
 
     # -- reconcile ------------------------------------------------------------
     def reconcile(self, request: Request) -> Result:
+        with batch_window(self.client):
+            return self._reconcile(request)
+
+    def _reconcile(self, request: Request) -> Result:
         try:
             obj = self.client.get("tpu.ai/v1alpha1", "TPUDriver", request.name)
         except NotFoundError:
             return Result()  # deleted; owned DSes go via ownerRef GC
         driver = TPUDriver.from_obj(obj)
+        status_as_read = copy.deepcopy(driver.obj.get("status"))
 
         policy = self._cluster_policy()
         if policy is None:
@@ -161,13 +175,13 @@ class TPUDriverReconciler(Reconciler):
             driver.status["state"] = State.READY
             driver.status["pools"] = {p.name: p.size for p in pools}
             mark_ready(driver.obj, f"{len(pools)} pool(s) ready")
-            self._write_status(driver.obj)
+            self._write_status(driver.obj, unchanged_from=status_as_read)
             log.info("TPUDriver %s ready (%d pools, %d nodes)",
                      driver.name, len(pools), len(selected))
             return Result()
         driver.status["state"] = State.NOT_READY
         mark_error(driver.obj, "DriverNotReady", "per-pool driver DaemonSets not ready")
-        self._write_status(driver.obj)
+        self._write_status(driver.obj, unchanged_from=status_as_read)
         return Result(requeue_after=self.requeue_after)
 
     def _cleanup_stale(self, skel: StateSkel, desired_names: set) -> None:
@@ -202,5 +216,5 @@ def setup_tpudriver_controller(client: Client, reconciler: TPUDriverReconciler) 
     controller.watches("v1", "Node", filtered_node_mapper(all_instances))
     controller.watches("apps/v1", "DaemonSet", map_owned,
                        namespace=reconciler.namespace)
-    controller.resyncs(lambda: all_instances(None), period=10.0)
+    controller.resyncs(lambda: all_instances(None), period=RESYNC_PERIOD_S)
     return controller

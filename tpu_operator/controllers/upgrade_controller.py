@@ -11,6 +11,7 @@ from typing import List, Optional
 
 from .. import consts, tracing
 from ..api.clusterpolicy import ClusterPolicy
+from ..client.batch import batch_window
 from ..client.interface import Client, WatchEvent
 from ..nodeinfo import is_tpu_node
 from ..upgrade import UpgradeStateMachine
@@ -24,6 +25,10 @@ log = logging.getLogger(__name__)
 
 #: reference plans a requeue every 2 min (upgrade_controller.go:59,197)
 PLANNED_REQUEUE = 120.0
+
+#: lost-event safety net (watch events + the planned requeue drive the
+#: machine); jittered by the runtime so replicas never LIST in lockstep
+RESYNC_PERIOD_S = float(os.environ.get("TPU_OPERATOR_RESYNC_S", "300"))
 
 SINGLETON_REQUEST = Request(name="driver-upgrade")
 
@@ -83,6 +88,10 @@ class UpgradeReconciler(Reconciler):
         return groups, rest
 
     def reconcile(self, request: Request) -> Result:
+        with batch_window(self.client):
+            return self._reconcile(request)
+
+    def _reconcile(self, request: Request) -> Result:
         with tracing.phase_span("plan") as sp:
             policy = self._policy()
             nodes = self._tpu_nodes()
@@ -167,5 +176,5 @@ def setup_upgrade_controller(client: Client, reconciler: UpgradeReconciler) -> C
     # an unscoped pod watch on a real apiserver is a cluster-wide firehose
     controller.watches("v1", "Pod", map_pod,
                        namespace=reconciler.namespace)
-    controller.resyncs(lambda: [SINGLETON_REQUEST], period=30.0)
+    controller.resyncs(lambda: [SINGLETON_REQUEST], period=RESYNC_PERIOD_S)
     return controller

@@ -16,6 +16,7 @@ from typing import Dict, List, Optional
 
 from .. import consts, events
 from ..api.clusterpolicy import ClusterPolicy
+from ..client.batch import coalesced_patch
 from ..client.interface import Client
 from ..utils import deep_get
 from .node_info import is_tpu_node
@@ -160,7 +161,8 @@ def label_tpu_nodes(client: Client, policy: ClusterPolicy,
                     patch[key] = None
             if patch:
                 log.info("labeling TPU node %s: %s", name, patch)
-                client.patch("v1", "Node", name, {"metadata": {"labels": patch}})
+                coalesced_patch(client, "v1", "Node", name,
+                                {"metadata": {"labels": patch}})
                 _apply_label_patch(node, patch)  # keep the snapshot current
                 result.labeled += 1
                 if patch.get(consts.PLUGIN_STACK_LABEL) == "host":
@@ -182,7 +184,8 @@ def label_tpu_nodes(client: Client, policy: ClusterPolicy,
                               consts.PLUGIN_STACK_LABEL)]
             if stale:
                 log.info("cleaning TPU labels from node %s", name)
-                client.patch("v1", "Node", name, {"metadata": {"labels": {k: None for k in stale}}})
+                coalesced_patch(client, "v1", "Node", name,
+                                {"metadata": {"labels": {k: None for k in stale}}})
                 _apply_label_patch(node, {k: None for k in stale})
                 result.cleaned += 1
     return result

@@ -24,6 +24,9 @@ INFO_CLUSTER_INFO = "cluster-info"
 INFO_NAMESPACE = "namespace"
 #: per-sweep Node snapshot, shared so states don't each re-LIST the cluster
 INFO_NODES = "nodes"
+#: per-sweep List[nodepool.NodePool] computed once from INFO_NODES, the
+#: single sharding source for pool-parallel sweeps and per-pool fan-out
+INFO_NODE_POOLS = "node-pools"
 
 
 class InfoCatalog(dict):

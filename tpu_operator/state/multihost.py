@@ -29,6 +29,7 @@ from typing import Dict, List
 
 from .. import consts, events
 from ..api.clusterpolicy import ClusterPolicy
+from ..client.batch import coalesced_patch
 from ..client.errors import NotFoundError
 from ..client.interface import Client
 from ..utils import deep_get, object_hash
@@ -168,9 +169,11 @@ class MultihostValidationState:
 
     def _stamp(self, members: List[dict], config_hash: str) -> None:
         for node in members:
-            self.client.patch("v1", "Node", node["metadata"]["name"], {
-                "metadata": {"annotations": {
-                    consts.MULTIHOST_VALIDATED_ANNOTATION: config_hash}}})
+            coalesced_patch(self.client, "v1", "Node",
+                            node["metadata"]["name"], {
+                                "metadata": {"annotations": {
+                                    consts.MULTIHOST_VALIDATED_ANNOTATION:
+                                        config_hash}}})
 
     def _teardown(self, slice_id: str, namespace: str, n_hint: int = 64) -> None:
         for pod in self.client.list("v1", "Pod", namespace,

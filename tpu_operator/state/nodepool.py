@@ -67,3 +67,27 @@ def get_node_pools(nodes: List[dict]) -> List[NodePool]:
     for pool in out:
         pool.node_names.sort()
     return out
+
+
+def shard_by_pools(nodes: List[dict], pools: List[NodePool]) -> List[List[dict]]:
+    """Partition ``nodes`` into per-pool shards (same order as ``pools``)
+    so node-facing sweeps reconcile pools in parallel workers with no
+    cross-pool cross-talk — a re-tile in one pool never serializes behind
+    the health sweep of another (Tenplex's per-pool independence argument).
+    Every node lands in exactly one shard; nodes absent from every pool
+    (shouldn't happen — :func:`get_node_pools` covers all inputs) form a
+    trailing leftover shard so no node escapes its sweep."""
+    by_name: Dict[str, dict] = {
+        deep_get(n, "metadata", "name", default=""): n for n in nodes}
+    shards: List[List[dict]] = []
+    pooled: set = set()
+    for pool in pools:
+        shard = [by_name[name] for name in pool.node_names if name in by_name]
+        pooled.update(pool.node_names)
+        if shard:
+            shards.append(shard)
+    leftover = [node for name, node in sorted(by_name.items())
+                if name not in pooled]
+    if leftover:
+        shards.append(leftover)
+    return shards

@@ -214,9 +214,15 @@ class KubeletSimulator:
 
     def _register_tpus(self, node: dict) -> None:
         name = node["metadata"]["name"]
+        want = str(self.chips_per_node)
+        # the tick's LIST already told us whether this node is registered;
+        # skipping the per-node GET keeps steady-state traffic O(DS), not
+        # O(nodes·ticks) — a real kubelet only writes its own node once too
+        if deep_get(node, "status", "capacity",
+                    consts.TPU_RESOURCE_NAME) == want:
+            return
         live = self.client.get("v1", "Node", name)
         capacity = live.setdefault("status", {}).setdefault("capacity", {})
-        want = str(self.chips_per_node)
         if capacity.get(consts.TPU_RESOURCE_NAME) != want:
             capacity[consts.TPU_RESOURCE_NAME] = want
             live["status"].setdefault("allocatable", {})[consts.TPU_RESOURCE_NAME] = want

@@ -32,6 +32,7 @@ from typing import Dict, List, Optional
 
 from .. import consts
 from ..api.common import UpgradePolicySpec
+from ..client.batch import coalesced_patch
 from ..client.errors import ApiError, NotFoundError, TooManyRequestsError
 from ..client.interface import Client
 from ..utils import deep_get, pod_requests_resource
@@ -199,7 +200,7 @@ class UpgradeStateMachine:
             ann_patch[consts.UPGRADE_FAILED_TEMPLATE_ANNOTATION] = None
             ann_patch[consts.UPGRADE_REVALIDATED_ANNOTATION] = None
         ann_patch.update(extra_annotations or {})
-        self.client.patch("v1", "Node", name, {"metadata": {
+        coalesced_patch(self.client, "v1", "Node", name, {"metadata": {
             "labels": {consts.UPGRADE_STATE_LABEL: state or None},
             "annotations": ann_patch,
         }})
@@ -255,8 +256,10 @@ class UpgradeStateMachine:
         return 0.0
 
     def _cordon(self, node: dict, unschedulable: bool) -> None:
-        self.client.patch("v1", "Node", node["metadata"]["name"],
-                          {"spec": {"unschedulable": unschedulable or None}})
+        # coalesced: evict() is a flush barrier, so cordon always lands
+        # on the apiserver before any eviction it gates
+        coalesced_patch(self.client, "v1", "Node", node["metadata"]["name"],
+                        {"spec": {"unschedulable": unschedulable or None}})
 
     @staticmethod
     def _daemonset_owned(pod: dict) -> bool:
@@ -334,8 +337,8 @@ class UpgradeStateMachine:
         current = deep_get(node, "metadata", "annotations", key)
         if current == value:
             return
-        self.client.patch("v1", "Node", node["metadata"]["name"],
-                          {"metadata": {"annotations": {key: value}}})
+        coalesced_patch(self.client, "v1", "Node", node["metadata"]["name"],
+                        {"metadata": {"annotations": {key: value}}})
         annotations = node.setdefault("metadata", {}).setdefault("annotations", {})
         if value is None:
             annotations.pop(key, None)
