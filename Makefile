@@ -64,6 +64,12 @@ SCALE_BENCH_SEED ?= 20260805
 scale-bench:  ## 5,000-node join + label-churn envelope through the latency-injected simulator; fails unless churn traffic is O(events) (fleet-size-independent per-event request budget) and reconcile p99 stays under the gate
 	SCALE_BENCH_SEED=$(SCALE_BENCH_SEED) JAX_PLATFORMS=cpu $(PYTHON) bench.py --scale-only
 
+AUTOSCALE_BENCH_SEED ?= 20260805
+
+.PHONY: autoscale-bench
+autoscale-bench:  ## closed-loop autoscaler episode (seeded diurnal curve + mid-episode preemptible revocation) through the latency-injected simulator; fails unless SLO attainment >= target at strictly fewer node-hours than a static peak-sized fleet, with zero bare deletes and revoked capacity replaced in-window
+	AUTOSCALE_BENCH_SEED=$(AUTOSCALE_BENCH_SEED) JAX_PLATFORMS=cpu $(PYTHON) bench.py --autoscale
+
 .PHONY: generate
 generate:  ## regenerate CRDs into all install channels (reference: make manifests)
 	$(PYTHON) hack/gen-crds.py

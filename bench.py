@@ -378,7 +378,262 @@ def bench_serving_traffic(seed: int = SERVING_TRAFFIC_SEED) -> dict:
         groups, seed=seed, duration_s=120.0, arrival_rate_per_s=3.0,
         per_token_ms=25.0, queue_slo_s=1.0,
         retile={"at": 60.0, "blocked": [1], "drain_window_s": 10.0,
-                "planned": True})
+                "planned": True},
+        # per-tick queue depth / backlog chips / rolling attainment: the
+        # autoscaler's input signal, published alongside the summary
+        sample_interval_s=5.0)
+
+
+#: seed for `make autoscale-bench` (overridable via $AUTOSCALE_BENCH_SEED):
+#: pins the diurnal curve's noise and the revocation victim choice
+AUTOSCALE_BENCH_SEED = 20260805
+#: simulated seconds per tick and episode length: two 24-min "days"
+#: (compressed diurnal periods), 30 s ticks
+AUTOSCALE_TICK_S = 30.0
+AUTOSCALE_PERIOD_TICKS = 48
+AUTOSCALE_TICKS = 96
+#: ticks between node registration and serving (the join path: label,
+#: render, validate) — the latency the forecast horizon must lead
+AUTOSCALE_JOIN_DELAY_TICKS = 2
+#: preemptible revocation lands on the second day's demand plateau;
+#: capacity must be back within the replacement window
+AUTOSCALE_REVOKE_TICK = 70
+AUTOSCALE_REPLACEMENT_WINDOW_TICKS = 4
+
+
+class _ScaleDownAuditor:
+    """Client wrapper for the autoscale bench: every operator Node delete
+    is audited against the in-process backend BEFORE it executes — a
+    delete without a published drain plan is a bare delete (gate: zero),
+    and a planned delete without a matching drain-ack is a deadline miss
+    (gate: zero, since the bench acks every plan within the window).
+    Backend reads are direct, so the audit neither rides the injected
+    latency nor shows up in request accounting."""
+
+    def __init__(self, inner, backend):
+        self._inner = inner
+        self._backend = backend
+        self.node_deletes = 0
+        self.bare_deletes = 0
+        self.unacked_deletes = 0
+
+    def delete(self, api_version, kind, name, namespace=None):
+        if kind == "Node":
+            from tpu_operator import consts
+            from tpu_operator.utils import deep_get
+
+            self.node_deletes += 1
+            try:
+                node = self._backend.get("v1", "Node", name)
+            except Exception:
+                node = None
+            ann = deep_get(node or {}, "metadata", "annotations",
+                           default={}) or {}
+            raw_plan = ann.get(consts.RETILE_PLAN_ANNOTATION)
+            if not raw_plan:
+                self.bare_deletes += 1
+            else:
+                try:
+                    fp = json.loads(raw_plan).get("fingerprint")
+                    ack = json.loads(
+                        ann.get(consts.DRAIN_ACK_ANNOTATION) or "{}")
+                except ValueError:
+                    fp, ack = None, {}
+                if not fp or ack.get("plan") != fp:
+                    self.unacked_deletes += 1
+        return self._inner.delete(api_version, kind, name, namespace)
+
+    def __getattr__(self, attr):
+        return getattr(self._inner, attr)
+
+
+def bench_autoscale(seed: int = None) -> dict:
+    """Closed-loop autoscaler episode through the latency-injected
+    simulator (`make autoscale-bench`): a seeded diurnal load curve feeds
+    per-tick traffic snapshots onto the ClusterPolicy, the REAL
+    AutoscaleReconciler (behind WriteBatcher -> RetryingClient ->
+    FencedClient, deletes audited) resizes the fleet, and a service-queue
+    model turns the capacity it provisions back into the SLO attainment
+    it reads next tick. A preemptible node is revoked spot-style on the
+    second day's plateau. Simulated clock throughout — the episode is
+    bit-for-bit reproducible under the pinned seed."""
+    import math
+    import random as _random
+
+    from tpu_operator import consts
+    from tpu_operator.api.clusterpolicy import new_cluster_policy
+    from tpu_operator.autoscale import AutoscaleReconciler
+    from tpu_operator.client.batch import WriteBatcher
+    from tpu_operator.client.fenced import FencedClient
+    from tpu_operator.client.resilience import RetryingClient
+    from tpu_operator.client.rest import RestClient
+    from tpu_operator.controllers.runtime import Request
+    from tpu_operator.health import drain as drain_protocol
+    from tpu_operator.testing import MiniApiServer, NodeChaos
+    from tpu_operator.testing.kubelet import KubeletSimulator
+    from tpu_operator.utils import deep_get
+
+    seed = int(os.environ.get("AUTOSCALE_BENCH_SEED",
+                              AUTOSCALE_BENCH_SEED)) if seed is None else seed
+    rng = _random.Random(seed)
+    chips = 4
+    pool = "v5-lite-podslice-4x4"
+    target_attainment = 0.95
+    headroom_pct = 20.0
+
+    srv = MiniApiServer(latency_s=0.002)
+    base = srv.start()
+    feeder = RestClient(base_url=base)  # traffic feed + acking workload
+    policy = new_cluster_policy(spec={
+        "autoscale": {
+            "enabled": True,
+            "targetSloAttainment": target_attainment,
+            "headroomPct": headroom_pct,
+            "scaleDownDelayS": 150,         # 5 ticks of sustained trough
+            "cooldownS": 30,                # one tick
+            "windowS": 300,                 # 10-tick forecast window
+            "minNodes": {"default": 1},
+            "maxNodes": {"default": 12},
+            "preemptiblePools": [pool],
+        },
+        "health": {"drainDeadlineS": 90},   # acks land next tick, < 3 ticks
+    })
+    feeder.create(policy)
+    for i in range(2):
+        feeder.create({
+            "apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": f"tpu-{i}", "labels": {
+                consts.GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice",
+                consts.GKE_TPU_TOPOLOGY_LABEL: "4x4"}},
+            "status": {"capacity": {consts.TPU_RESOURCE_NAME: str(chips)}}})
+
+    clock = [0.0]
+    audit = _ScaleDownAuditor(RestClient(base_url=base), srv.backend)
+    # production chain shape minus the informer cache (the bench drives
+    # sweeps synchronously on a simulated clock; the fence is unbound —
+    # single replica, no elector — exactly the agent-passthrough mode)
+    op_client = WriteBatcher(RetryingClient(FencedClient(audit)))
+    reconciler = AutoscaleReconciler(
+        op_client, chips_per_node=chips,
+        horizon_s=AUTOSCALE_JOIN_DELAY_TICKS * AUTOSCALE_TICK_S,
+        now=lambda: clock[0])
+    chaos = NodeChaos(KubeletSimulator(feeder), seed=seed)
+
+    def demand_at(tick: int) -> float:
+        """Two compressed diurnal periods: trough 4 chips, peak ~32, with
+        seeded jitter — the curve the static baseline must size to."""
+        phase = 2.0 * math.pi * tick / AUTOSCALE_PERIOD_TICKS
+        return max(0.0, 4.0 + 28.0 * (0.5 - 0.5 * math.cos(phase))
+                   + rng.uniform(-1.5, 1.5))
+
+    try:
+        first_seen: dict = {}
+        queue = 0.0
+        attainments = []
+        node_counts = []
+        peak_demand_nodes = 0
+        revoked_at = None
+        replaced_at = None
+        pre_revoke_count = None
+        last_target = None
+        for tick in range(AUTOSCALE_TICKS):
+            clock[0] = tick * AUTOSCALE_TICK_S
+            if tick == AUTOSCALE_REVOKE_TICK:
+                pre_revoke_count = len(srv.backend.list("v1", "Node")) - 1
+                if chaos.revoke_one() is None:
+                    pre_revoke_count = None
+                else:
+                    revoked_at = tick
+            nodes = srv.backend.list("v1", "Node")
+            names = {n["metadata"]["name"] for n in nodes}
+            for name in names:
+                first_seen.setdefault(name, tick)
+            # re-capacitated: the fleet is back to what demand requires —
+            # the decided target, or the pre-revocation size if demand
+            # was already shrinking the fleet through it
+            if (revoked_at is not None and replaced_at is None
+                    and last_target is not None
+                    and len(names) >= min(pre_revoke_count + 1,
+                                          last_target)):
+                replaced_at = tick
+            # joined capacity: seeded nodes serve at once, registered
+            # nodes only after the join delay
+            serving = [n for n in names
+                       if first_seen[n] == 0
+                       or tick - first_seen[n] >= AUTOSCALE_JOIN_DELAY_TICKS]
+            capacity = len(serving) * chips
+            demand = demand_at(tick)
+            peak_demand_nodes = max(peak_demand_nodes,
+                                    math.ceil(demand / chips))
+            outstanding = queue + demand
+            served = min(outstanding, capacity)
+            attain = served / outstanding if outstanding > 0 else 1.0
+            queue = outstanding - served
+            attainments.append(attain)
+            node_counts.append(len(names))
+            # the traffic feed: per-tick snapshot annotation (the patch
+            # doubles as the reconciler's watch wake in production)
+            feeder.patch("tpu.ai/v1", "ClusterPolicy", "cluster-policy", {
+                "metadata": {"annotations": {
+                    consts.TRAFFIC_SNAPSHOT_ANNOTATION: json.dumps({
+                        "ts": clock[0],
+                        "queue_depth": round(queue / chips, 3),
+                        "backlog_chips": round(outstanding, 3),
+                        "attainment": round(attain, 4)})}}})
+            # the acking workload: checkpoint + drain-ack for every open
+            # plan, mirrored to the annotation the operator reads
+            for n in nodes:
+                plan = drain_protocol.node_plan(n)
+                if plan is None:
+                    continue
+                if drain_protocol.node_acked_plan(n) == plan.fingerprint:
+                    continue
+                feeder.patch("v1", "Node", n["metadata"]["name"], {
+                    "metadata": {"annotations": {
+                        consts.DRAIN_ACK_ANNOTATION: json.dumps(
+                            {"plan": plan.fingerprint, "step": tick})}}})
+            reconciler.reconcile(Request(name="cluster-policy"))
+            decisions = reconciler.debug_state()["autoscale"]["decisions"]
+            if decisions:
+                last_target = sum(d["target"] for d in decisions)
+        ups = sum(1 for name, t in first_seen.items() if t > 0)
+        hours = AUTOSCALE_TICK_S / 3600.0
+        node_hours = sum(node_counts) * hours
+        static_node_hours = peak_demand_nodes * AUTOSCALE_TICKS * hours
+        mean_attainment = sum(attainments) / len(attainments)
+        return {
+            "simulated": True,
+            "seed": seed,
+            "ticks": AUTOSCALE_TICKS,
+            "tick_s": AUTOSCALE_TICK_S,
+            "target_slo_attainment": target_attainment,
+            "mean_slo_attainment": round(mean_attainment, 4),
+            "min_slo_attainment": round(min(attainments), 4),
+            "node_hours": round(node_hours, 3),
+            "static_fleet_nodes": peak_demand_nodes,
+            "static_fleet_node_hours": round(static_node_hours, 3),
+            "node_hours_saved_pct": round(
+                100.0 * (1.0 - node_hours / static_node_hours), 1)
+                if static_node_hours else 0.0,
+            "fleet_min": min(node_counts),
+            "fleet_max": max(node_counts),
+            "scale_ups": ups,
+            "scale_downs": audit.node_deletes,
+            "bare_deletes": audit.bare_deletes,
+            "unacked_deletes": audit.unacked_deletes,
+            "revocation": {
+                "revoked": chaos.revoked,
+                "revoked_at_tick": revoked_at,
+                "replaced_at_tick": replaced_at,
+                "replacement_window_ticks":
+                    AUTOSCALE_REPLACEMENT_WINDOW_TICKS,
+            },
+            "final_queue_chips": round(queue, 3),
+            "debug": reconciler.debug_state()["autoscale"],
+        }
+    finally:
+        op_client.stop()
+        srv.stop()
 
 
 #: matrix dim for the join bench's real node-side ICI sweep: small enough
@@ -871,6 +1126,38 @@ def scale_bench_main() -> int:
     return 0 if all(gates.values()) else 1
 
 
+def autoscale_bench_main() -> int:
+    """`make autoscale-bench`: the closed-loop autoscaler episode, one
+    JSON line. Exit 0 iff SLO attainment held at or above the policy
+    target, the elastic fleet spent strictly fewer node-hours than the
+    static fleet sized for the same peak, every scale-down went through
+    the planned-drain protocol (zero bare deletes, zero removals without
+    an ack — no steps lost beyond the drain window), the episode
+    actually exercised both directions, and the mid-episode preemptible
+    revocation was re-capacitated within the replacement window."""
+    out = bench_autoscale()
+    rev = out["revocation"]
+    gates = {
+        "attainment_met": (out["mean_slo_attainment"]
+                           >= out["target_slo_attainment"]),
+        "node_hours_under_static": (out["node_hours"]
+                                    < out["static_fleet_node_hours"]),
+        "zero_bare_deletes": out["bare_deletes"] == 0,
+        "all_drains_acked": out["unacked_deletes"] == 0,
+        "scaled_both_ways": out["scale_ups"] > 0 and out["scale_downs"] > 0,
+        "revocation_struck": rev["revoked_at_tick"] is not None,
+        "revocation_replaced_in_window": (
+            rev["replaced_at_tick"] is not None
+            and rev["revoked_at_tick"] is not None
+            and rev["replaced_at_tick"] - rev["revoked_at_tick"]
+            <= rev["replacement_window_ticks"]),
+    }
+    line = {"metric": "autoscale_episode", "autoscale": out,
+            "gates": gates}
+    print(json.dumps(line))
+    return 0 if all(gates.values()) else 1
+
+
 def join_bench_main() -> int:
     """`make join-bench`: the end-to-end join-attribution bench alone, one
     JSON line; exit 0 iff the stitched trace is complete, node-side spans
@@ -895,4 +1182,6 @@ if __name__ == "__main__":
         sys.exit(join_bench_main())
     if "--scale-only" in _argv:
         sys.exit(scale_bench_main())
+    if "--autoscale" in _argv:
+        sys.exit(autoscale_bench_main())
     sys.exit(main())

@@ -1,0 +1,540 @@
+"""SLO-driven fleet autoscaler (tpu_operator/autoscale/).
+
+Three layers, mirroring the subsystem's own split:
+
+* the pure pieces driven directly — TrendPredictor (EWMA level + linear
+  trend) and the decision engine (bounds, cooldown, scale-down delay,
+  preemptible-revocation bypass, waterfill spread);
+* the controller against a FakeClient — scale-up registering labeled
+  nodes, victim selection, the full planned-drain scale-down episode,
+  fenced-write propagation, and the NodeChaos revocation/replacement
+  loop (the satellite assertion that the health machine and autoscaler
+  jointly recover revoked capacity);
+* the crash-point soak — the operator killed before AND after every
+  mutating call of a scale-down episode, each replay cold-restarted and
+  asserted to converge to exactly ONE completed re-tile (one node
+  removed, one RetilePlanned Event, resize record retired).
+"""
+
+import json
+
+import pytest
+
+from tpu_operator import consts
+from tpu_operator.api.clusterpolicy import AutoscaleSpec, new_cluster_policy
+from tpu_operator.autoscale.controller import (
+    AutoscaleReconciler,
+    REASON_PLANNED,
+    parse_snapshot,
+)
+from tpu_operator.autoscale.engine import (
+    PoolState,
+    decide,
+    nodes_needed,
+    spread_targets,
+)
+from tpu_operator.autoscale.predictor import TrendPredictor
+from tpu_operator.client.chaos import CrashPointClient, OperatorCrashed
+from tpu_operator.client.errors import FencedError
+from tpu_operator.client.fake import FakeClient
+from tpu_operator.client.fenced import FencedClient
+from tpu_operator.controllers.runtime import Request
+from tpu_operator.health import drain as drain_protocol
+
+NS = "tpu-operator"
+#: pool name state.nodepool derives from the labels in mk_node
+POOL = "v5-lite-podslice-2x2"
+
+
+class Clock:
+    def __init__(self, t=1_000_000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture
+def clock():
+    return Clock()
+
+
+def mk_node(name, managed=False, preemptible=False):
+    labels = {
+        consts.TPU_PRESENT_LABEL: "true",
+        consts.GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice",
+        consts.GKE_TPU_TOPOLOGY_LABEL: "2x2",
+    }
+    if managed:
+        labels[consts.AUTOSCALE_MANAGED_LABEL] = POOL
+    if preemptible:
+        labels[consts.PREEMPTIBLE_POOL_LABEL] = "true"
+    return {"apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": name, "labels": labels},
+            "status": {"capacity": {consts.TPU_RESOURCE_NAME: "4"}}}
+
+
+def mk_pod(name, node):
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "namespace": "tenant-a"},
+            "spec": {"nodeName": node},
+            "status": {"phase": "Running"}}
+
+
+def setup_cluster(client, n=2, autoscale=None, drain_deadline_s=60,
+                  preemptible=False):
+    spec = {"enabled": True, "scaleDownDelayS": 0, "cooldownS": 0,
+            "minNodes": {"default": 1}, "maxNodes": {"default": 8}}
+    spec.update(autoscale or {})
+    client.create(new_cluster_policy(spec={
+        "autoscale": spec,
+        "health": {"drainDeadlineS": drain_deadline_s}}))
+    for i in range(n):
+        client.create(mk_node(f"tpu-{i}", preemptible=preemptible))
+
+
+def publish_snapshot(client, ts, backlog_chips, attainment=1.0,
+                     queue_depth=0):
+    client.patch("tpu.ai/v1", "ClusterPolicy", "cluster-policy",
+                 {"metadata": {"annotations": {
+                     consts.TRAFFIC_SNAPSHOT_ANNOTATION: json.dumps({
+                         "ts": ts, "queue_depth": queue_depth,
+                         "backlog_chips": backlog_chips,
+                         "attainment": attainment})}}})
+
+
+def mk_reconciler(client, clock):
+    return AutoscaleReconciler(client, namespace=NS, now=clock)
+
+
+def sweep(rec):
+    return rec.reconcile(Request(name="cluster-policy"))
+
+
+def tpu_nodes(client):
+    return sorted(n["metadata"]["name"] for n in client.list("v1", "Node")
+                  if consts.GKE_TPU_ACCELERATOR_LABEL
+                  in (n["metadata"].get("labels") or {}))
+
+
+def persisted_states(client):
+    policy = client.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy")
+    raw = (policy["metadata"].get("annotations") or {}).get(
+        consts.AUTOSCALE_STATE_ANNOTATION)
+    return json.loads(raw) if raw else {}
+
+
+def ack_open_plans(client, step=7):
+    """The simulated workload: checkpoint + ack every published drain
+    plan (through its OWN client — the workload is not the operator)."""
+    for node in client.list("v1", "Node"):
+        plan = drain_protocol.node_plan(node)
+        if plan is None:
+            continue
+        if drain_protocol.node_acked_plan(node) == plan.fingerprint:
+            continue
+        client.patch("v1", "Node", node["metadata"]["name"],
+                     {"metadata": {"annotations": {
+                         consts.DRAIN_ACK_ANNOTATION: json.dumps(
+                             {"plan": plan.fingerprint, "step": step})}}})
+
+
+def events_with_reason(client, reason):
+    return [e for e in client.list("v1", "Event", NS)
+            if e.get("reason") == reason]
+
+
+# -- predictor ----------------------------------------------------------------
+
+def test_predictor_empty_then_single_sample():
+    p = TrendPredictor()
+    assert p.forecast(60.0) == 0.0  # no samples must never invent demand
+    p.observe(10.0, 8.0)
+    assert p.level == 8.0
+    # one sample: no trend evidence, forecast degenerates to the level
+    assert p.forecast(600.0) == 8.0
+
+
+def test_predictor_forecast_leads_a_linear_ramp():
+    p = TrendPredictor(alpha=1.0)  # raw values: the ramp is noise-free
+    for i in range(10):
+        p.observe(30.0 * i, 4.0 * i)  # +4 chips every 30s
+    assert p.slope() == pytest.approx(4.0 / 30.0)
+    # the forecast 60s out reads where demand WILL be, not where it was
+    assert p.forecast(60.0) == pytest.approx(36.0 + 8.0)
+
+
+def test_predictor_ignores_out_of_order_samples():
+    p = TrendPredictor()
+    p.observe(100.0, 5.0)
+    p.observe(50.0, 500.0)  # restarted feeder replaying an old tick
+    assert len(p.samples) == 1
+    assert p.level == 5.0
+
+
+def test_predictor_window_prunes_stale_samples():
+    p = TrendPredictor(window_s=100.0)
+    for t in (0.0, 50.0, 140.0):
+        p.observe(t, 1.0)
+    assert [t for t, _ in p.samples] == [50.0, 140.0]
+
+
+def test_predictor_forecast_floors_at_zero():
+    p = TrendPredictor(alpha=1.0)
+    p.observe(0.0, 100.0)
+    p.observe(10.0, 10.0)  # cliff: slope -9/s
+    assert p.forecast(600.0) == 0.0  # never negative capacity need
+
+
+# -- decision engine ----------------------------------------------------------
+
+def spec_of(**kw):
+    return AutoscaleSpec.from_dict(dict({"enabled": True}, **kw))
+
+
+def test_nodes_needed_headroom_and_breach_floor():
+    spec = spec_of(headroomPct=20.0)
+    # 10 chips * 1.2 headroom / 4 chips-per-node = 3 nodes
+    assert nodes_needed(spec, 10.0, 4, False, 3) == 3
+    assert nodes_needed(spec, 0.0, 4, False, 3) == 0
+    # an SLO breach overrides a quiet queue: grow by at least one node
+    assert nodes_needed(spec, 0.0, 4, True, 3) == 4
+
+
+def test_spread_targets_waterfills_in_sorted_order():
+    spec = spec_of(minNodes={"default": 1}, maxNodes={"a": 2, "default": 4})
+    targets = spread_targets(spec, {"b": 1, "a": 1}, 5)
+    assert targets == {"a": 2, "b": 3}
+    # saturation: every pool at its ceiling, demand beyond it unmet
+    assert spread_targets(spec, {"b": 1, "a": 1}, 99) == {"a": 2, "b": 4}
+
+
+def test_decide_scales_up_toward_target():
+    spec = spec_of(maxNodes={"default": 8})
+    states = {}
+    [d] = decide(spec, {POOL: 2}, 20.0, 4, False, states, now=100.0)
+    assert (d.action, d.target) == ("up", 6)  # ceil(20*1.2/4)
+    assert states[POOL].target == 6
+
+
+def test_decide_holds_in_cooldown():
+    spec = spec_of(cooldownS=60)
+    states = {POOL: PoolState(target=2, cooldown_until=150.0)}
+    [d] = decide(spec, {POOL: 2}, 20.0, 4, False, states, now=100.0)
+    assert d.action is None and d.hold_reason == "cooldown"
+
+
+def test_decide_revoked_preemptible_bypasses_cooldown():
+    spec = spec_of(cooldownS=600, preemptiblePools=[POOL])
+    # the pool WAS at 3 (previous target); a revocation dropped it to 2
+    states = {POOL: PoolState(target=3, cooldown_until=10_000.0)}
+    [d] = decide(spec, {POOL: 2}, 8.0, 4, False, states, now=100.0)
+    assert d.action == "up"  # replacement cannot wait out the cooldown
+    # a NON-preemptible pool in the same shape holds: the shrink was ours
+    states = {POOL: PoolState(target=3, cooldown_until=10_000.0)}
+    [d] = decide(spec_of(cooldownS=600), {POOL: 2}, 8.0, 4, False,
+                 states, now=100.0)
+    assert d.hold_reason == "cooldown"
+
+
+def test_decide_scale_down_needs_sustained_deficit():
+    spec = spec_of(scaleDownDelayS=300)
+    states = {}
+    [d] = decide(spec, {POOL: 4}, 4.0, 4, False, states, now=100.0)
+    assert d.action is None and d.hold_reason == "scale-down-delay"
+    # still below, delay not yet served
+    [d] = decide(spec, {POOL: 4}, 4.0, 4, False, states, now=250.0)
+    assert d.hold_reason == "scale-down-delay"
+    [d] = decide(spec, {POOL: 4}, 4.0, 4, False, states, now=401.0)
+    assert d.action == "down"
+    # a demand recovery mid-delay resets the timer
+    states = {}
+    decide(spec, {POOL: 4}, 4.0, 4, False, states, now=100.0)
+    decide(spec, {POOL: 4}, 40.0, 4, False, states, now=200.0)
+    [d] = decide(spec, {POOL: 4}, 4.0, 4, False, states, now=401.0)
+    assert d.hold_reason == "scale-down-delay"
+
+
+def test_decide_resize_in_flight_holds_everything():
+    spec = spec_of(scaleDownDelayS=0, cooldownS=0)
+    states = {POOL: PoolState(target=2, resize={
+        "node": "tpu-1", "fingerprint": "f", "direction": "down",
+        "deadline": 0.0})}
+    [d] = decide(spec, {POOL: 4}, 400.0, 4, False, states, now=100.0)
+    assert d.action is None and d.hold_reason == "resize-in-flight"
+
+
+def test_parse_snapshot_rejects_corrupt_payloads():
+    assert parse_snapshot(None) is None
+    assert parse_snapshot("{not json") is None
+    assert parse_snapshot('["list"]') is None
+    assert parse_snapshot('{"no_ts": 1}') is None
+    assert parse_snapshot('{"ts": 5, "backlog_chips": 2}') == {
+        "ts": 5, "backlog_chips": 2}
+
+
+# -- controller: scale-up -----------------------------------------------------
+
+def test_scale_up_registers_nodes_with_pool_template(fake_client, clock):
+    setup_cluster(fake_client, n=1,
+                  autoscale={"preemptiblePools": [POOL]})
+    publish_snapshot(fake_client, clock.t, backlog_chips=20.0)
+    rec = mk_reconciler(fake_client, clock)
+    sweep(rec)
+    names = tpu_nodes(fake_client)
+    assert len(names) == 6  # ceil(20*1.2/4)
+    created = [n for n in names if n != "tpu-0"]
+    assert created == [f"{POOL}-a{i}" for i in range(5)]
+    for name in created:
+        labels = fake_client.get("v1", "Node", name)["metadata"]["labels"]
+        # the pool selector labels ride along so the join path and the
+        # next census both claim the node for this pool
+        assert labels[consts.GKE_TPU_ACCELERATOR_LABEL] == \
+            "tpu-v5-lite-podslice"
+        assert labels[consts.GKE_TPU_TOPOLOGY_LABEL] == "2x2"
+        assert labels[consts.AUTOSCALE_MANAGED_LABEL] == POOL
+        assert labels[consts.PREEMPTIBLE_POOL_LABEL] == "true"
+    # decision state persisted: a restarted operator resumes from it
+    assert persisted_states(fake_client)[POOL]["target"] == 6
+
+
+def test_targets_clamp_to_max_nodes(fake_client, clock):
+    setup_cluster(fake_client, n=1, autoscale={"maxNodes": {"default": 3}})
+    publish_snapshot(fake_client, clock.t, backlog_chips=500.0)
+    rec = mk_reconciler(fake_client, clock)
+    sweep(rec)
+    assert len(tpu_nodes(fake_client)) == 3
+    assert events_with_reason(fake_client, "AutoscaleSaturated")
+
+
+# -- controller: scale-down through the drain protocol ------------------------
+
+def test_scale_down_is_a_planned_drain_never_a_bare_delete(
+        fake_client, clock):
+    setup_cluster(fake_client, n=3)
+    publish_snapshot(fake_client, clock.t, backlog_chips=6.0)  # wants 2
+    rec = mk_reconciler(fake_client, clock)
+    result = sweep(rec)
+    # the node survives the first sweep: only the plan was published
+    assert len(tpu_nodes(fake_client)) == 3
+    planned = [n for n in fake_client.list("v1", "Node")
+               if drain_protocol.node_plan(n) is not None]
+    assert len(planned) == 1
+    plan = drain_protocol.node_plan(planned[0])
+    assert plan.reason == drain_protocol.REASON_SCALE_DOWN
+    assert result.requeue_after is not None  # the drain window is open
+    assert len(events_with_reason(fake_client, REASON_PLANNED)) == 1
+
+    # unacked + deadline open: the node holds
+    clock.t += 5.0
+    sweep(rec)
+    assert len(tpu_nodes(fake_client)) == 3
+
+    # the workload acks; the next sweep completes the re-tile
+    ack_open_plans(fake_client)
+    clock.t += 5.0
+    sweep(rec)
+    assert len(tpu_nodes(fake_client)) == 2
+    assert persisted_states(fake_client)[POOL].get("resize") is None
+    # the announcement stayed exactly-once across the whole episode
+    assert len(events_with_reason(fake_client, REASON_PLANNED)) == 1
+
+
+def test_scale_down_deadline_expiry_forces_removal(fake_client, clock):
+    setup_cluster(fake_client, n=3, drain_deadline_s=30)
+    publish_snapshot(fake_client, clock.t, backlog_chips=6.0)
+    rec = mk_reconciler(fake_client, clock)
+    sweep(rec)
+    assert len(tpu_nodes(fake_client)) == 3
+    clock.t += 31.0  # never acked: fail-safe removal, counted as a miss
+    sweep(rec)
+    assert len(tpu_nodes(fake_client)) == 2
+    assert rec.metrics.drain_deadline_missed._value.get() == 1
+
+
+def test_victim_is_the_emptiest_managed_node(fake_client, clock):
+    setup_cluster(fake_client, n=2)
+    fake_client.create(mk_node(f"{POOL}-a0", managed=True))
+    # static nodes carry workloads; the managed node is drain-clean
+    fake_client.create(mk_pod("w-0", "tpu-0"))
+    fake_client.create(mk_pod("w-1", "tpu-1"))
+    publish_snapshot(fake_client, clock.t, backlog_chips=6.0)
+    rec = mk_reconciler(fake_client, clock)
+    sweep(rec)
+    planned = [n["metadata"]["name"] for n in fake_client.list("v1", "Node")
+               if drain_protocol.node_plan(n) is not None]
+    assert planned == [f"{POOL}-a0"]
+
+
+def test_scale_down_holds_when_every_node_is_busy(fake_client, clock):
+    setup_cluster(fake_client, n=3)
+    for i in range(3):
+        fake_client.create(mk_pod(f"w-{i}", f"tpu-{i}"))
+    publish_snapshot(fake_client, clock.t, backlog_chips=6.0)
+    rec = mk_reconciler(fake_client, clock)
+    sweep(rec)
+    assert len(tpu_nodes(fake_client)) == 3
+    assert not [n for n in fake_client.list("v1", "Node")
+                if drain_protocol.node_plan(n) is not None]
+
+
+# -- controller: fencing ------------------------------------------------------
+
+class DeposedFence:
+    """Elector live-view of a replica that lost leadership."""
+
+    epoch = 3
+
+    def current_epoch(self):
+        return None
+
+
+def test_fenced_write_propagates_for_runtime_requeue(fake_client, clock):
+    """A deposed replica's sweep dies on the first mutating call and the
+    FencedError reaches the runtime intact (which requeues it — the
+    not-an-error path exercised in test_fencing); nothing lands."""
+    setup_cluster(fake_client, n=1)
+    publish_snapshot(fake_client, clock.t, backlog_chips=20.0)
+    fenced = FencedClient(fake_client, fence=DeposedFence())
+    rec = mk_reconciler(fenced, clock)
+    with pytest.raises(FencedError):
+        sweep(rec)
+    assert tpu_nodes(fake_client) == ["tpu-0"]  # the scale-up was rejected
+    assert persisted_states(fake_client) == {}
+    assert fenced.fenced_total == 1 and fenced.dispatched_total == 0
+
+
+# -- controller + NodeChaos: the revocation/replacement loop ------------------
+
+def test_revoked_preemptible_capacity_is_jointly_replaced(
+        fake_client, clock):
+    """The satellite-2 assertion: NodeChaos revokes a whole preemptible
+    node (no drain plan, pods and Node vanish together); the health
+    machine stays quiet (nothing to remediate — the hardware is GONE,
+    not degraded) and the autoscaler replaces the capacity on its next
+    sweep, cooldown notwithstanding."""
+    from tpu_operator.api.clusterpolicy import HealthSpec
+    from tpu_operator.health import HealthStateMachine
+    from tpu_operator.testing import NodeChaos
+    from tpu_operator.testing.kubelet import KubeletSimulator
+
+    # 2 seed nodes + demand for 3: the scale-up resize arms the 600s
+    # cooldown, so the replacement below provably bypasses it
+    setup_cluster(fake_client, n=2, preemptible=True,
+                  autoscale={"cooldownS": 600,
+                             "preemptiblePools": [POOL]})
+    publish_snapshot(fake_client, clock.t, backlog_chips=8.0)  # wants 3
+    rec = mk_reconciler(fake_client, clock)
+    sweep(rec)
+    assert len(tpu_nodes(fake_client)) == 3
+    assert persisted_states(fake_client)[POOL]["cooldown_until"] > clock.t
+
+    chaos = NodeChaos(KubeletSimulator(fake_client, namespace=NS), seed=7)
+    victim = chaos.revoke_one()
+    assert victim is not None and chaos.revoked == [victim]
+    assert len(tpu_nodes(fake_client)) == 2
+    # revocation is exactly the path the drain protocol cannot cover:
+    # the capacity vanished with no plan published anywhere
+    assert not [n for n in fake_client.list("v1", "Node")
+                if drain_protocol.node_plan(n) is not None]
+
+    # the health machine sees only surviving (healthy) nodes: no
+    # quarantine, no remediation — capacity recovery is not its job
+    hsm = HealthStateMachine(fake_client, NS,
+                             HealthSpec.from_dict({"drainDeadlineS": 0}),
+                             now=clock)
+    counts = hsm.process(fake_client.list("v1", "Node"))
+    assert counts.quarantined == 0 and counts.remediating == 0
+
+    clock.t += 1.0  # deep inside the 600s cooldown
+    sweep(rec)
+    names = tpu_nodes(fake_client)
+    assert len(names) == 3  # replaced immediately, cooldown bypassed
+    assert any(n.startswith(f"{POOL}-a") for n in names)
+    replacement = [n for n in names if n.startswith(f"{POOL}-a")][0]
+    labels = fake_client.get("v1", "Node",
+                             replacement)["metadata"]["labels"]
+    assert labels[consts.PREEMPTIBLE_POOL_LABEL] == "true"
+
+
+# -- crash-point soak: kill mid-resize ----------------------------------------
+
+class _NodeDeleteCounter:
+    """Counts Node deletions across operator incarnations — the evidence
+    that every replay completed exactly ONE re-tile."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.scheme = getattr(inner, "scheme", None)
+        self.node_deletes = []
+
+    def delete(self, api_version, kind, name, namespace=None):
+        if kind == "Node":
+            self.node_deletes.append(name)
+        return self.inner.delete(api_version, kind, name, namespace)
+
+    def __getattr__(self, attr):
+        return getattr(self.inner, attr)
+
+
+def _drive_scale_down(backend, clock, arm=None, max_steps=30):
+    """Run reconcile+ack steps until the fleet converges at 2 nodes with
+    the resize record retired; on the armed kill, cold-restart the
+    operator on a FRESH (unarmed) client over the same cluster state.
+    Returns the recording incarnation's site list. An armed replay whose
+    site never fires is an uncovered site — fail on it."""
+    first = CrashPointClient(backend, arm=arm)
+    cpc = first
+    rec = mk_reconciler(cpc, clock)
+    for _ in range(max_steps):
+        clock.t += 5.0
+        try:
+            sweep(rec)
+        except OperatorCrashed:
+            cpc = CrashPointClient(backend, arm=None)
+            rec = mk_reconciler(cpc, clock)
+            continue
+        ack_open_plans(backend)
+        states = persisted_states(backend)
+        if (len(tpu_nodes(backend)) == 2
+                and states.get(POOL, {}).get("resize") is None
+                and states.get(POOL, {}).get("target") == 2):
+            if arm is not None:
+                assert first.fired, f"armed site never fired: {arm}"
+            return first.sites
+    raise AssertionError(f"scale-down episode did not converge (arm={arm})")
+
+
+def _fresh_scale_down_cluster(clock):
+    backend = _NodeDeleteCounter(FakeClient())
+    setup_cluster(backend, n=3)
+    publish_snapshot(backend, clock.t, backlog_chips=6.0)  # wants 2 nodes
+    return backend
+
+
+def test_kill_mid_resize_converges_to_exactly_one_retile(clock):
+    """Coverage-complete kill matrix over the scale-down episode: the
+    operator dies immediately before and after EVERY mutating apiserver
+    call (durable-intent write, plan publish, RetilePlanned Event, the
+    Node delete, completion Event...), and each cold-restarted replay
+    must converge to exactly one completed re-tile — one node removed,
+    one RetilePlanned Event, no second victim ever planned."""
+    # record run enumerates the matrix
+    backend = _fresh_scale_down_cluster(clock)
+    sites = _drive_scale_down(backend, clock)
+    assert backend.node_deletes == ["tpu-0"]
+    assert any("planned-retile" in s for s in sites)
+    assert any(s.startswith("DELETE Node/") for s in sites)
+    assert len(sites) >= 4
+
+    for site in sites:
+        for mode in ("before", "after"):
+            replay_clock = Clock()
+            backend = _fresh_scale_down_cluster(replay_clock)
+            _drive_scale_down(backend, replay_clock, arm=(site, mode))
+            assert len(backend.node_deletes) == 1, (site, mode)
+            assert len(events_with_reason(backend, REASON_PLANNED)) == 1, \
+                (site, mode)
+            states = persisted_states(backend)
+            assert states[POOL].get("resize") is None, (site, mode)
+            assert len(tpu_nodes(backend)) == 2, (site, mode)

@@ -203,6 +203,71 @@ def test_status_against_live_harness(capsys):
         srv.stop()
 
 
+def test_status_autoscale_column(capsys):
+    """The AUTOSCALE column renders each node's pool posture from the
+    durable decision state: current/target against the spec bounds, the
+    in-flight resize direction, and the cooldown remaining — and stays
+    '-' when the autoscaler is disabled."""
+    import json
+    import time
+
+    from tpu_operator import consts
+    from tpu_operator.api.clusterpolicy import new_cluster_policy
+    from tpu_operator.client.rest import RestClient
+    from tpu_operator.testing import MiniApiServer
+
+    srv = MiniApiServer()
+    base = srv.start()
+    try:
+        client = RestClient(base_url=base)
+        policy = new_cluster_policy(spec={"autoscale": {
+            "enabled": True,
+            "minNodes": {"default": 1},
+            "maxNodes": {"default": 8}}})
+        client.create(policy)
+        for i in range(2):
+            client.create({"apiVersion": "v1", "kind": "Node",
+                           "metadata": {"name": f"tpu-{i}", "labels": {
+                               consts.TPU_PRESENT_LABEL: "true",
+                               consts.GKE_TPU_ACCELERATOR_LABEL:
+                                   "tpu-v5-lite-podslice",
+                               consts.GKE_TPU_TOPOLOGY_LABEL: "2x2"}},
+                           "status": {"capacity": {
+                               consts.TPU_RESOURCE_NAME: "4"}}})
+        # pool name per state.nodepool grouping: accelerator sans "tpu-"
+        # prefix + topology
+        pool = "v5-lite-podslice-2x2"
+        cp = client.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy")
+        cp["metadata"].setdefault("annotations", {})[
+            consts.AUTOSCALE_STATE_ANNOTATION] = json.dumps({pool: {
+                "target": 5, "seq": 3,
+                "cooldown_until": time.time() + 42.0,
+                "resize": {"node": "tpu-1", "direction": "down",
+                           "fingerprint": "abc", "deadline": 0.0}}})
+        client.update(cp)
+
+        run(["status", "--base-url", base])
+        out = capsys.readouterr().out
+        assert "AUTOSCALE" in out
+        # current 2, durable target 5, spec bounds 1-8
+        assert "2/5[1-8]" in out
+        assert "resizing:down" in out
+        assert "cd=" in out  # cooldown remaining is live-computed
+
+        # disabled autoscaler: the column renders but every cell is '-'
+        cp = client.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy")
+        cp["spec"]["autoscale"]["enabled"] = False
+        client.update(cp)
+        run(["status", "--base-url", base])
+        out = capsys.readouterr().out
+        assert "2/5[1-8]" not in out
+        for line in out.splitlines():
+            if line.startswith("tpu-"):
+                assert line.rstrip().endswith("-")
+    finally:
+        srv.stop()
+
+
 def test_status_unreachable_cluster_fails_cleanly(capsys):
     assert run(["status", "--base-url", "http://127.0.0.1:1"]) == 2
     err = capsys.readouterr().err

@@ -54,7 +54,8 @@ class LintConfig:
     #: documented-metric check — registration/cardinality still apply
     docs_text: Optional[str] = None
     #: directory names that mark a file as part of a reconcile path
-    reconcile_dirs: Tuple[str, ...] = ("controllers", "state", "upgrade")
+    reconcile_dirs: Tuple[str, ...] = ("controllers", "state", "upgrade",
+                                       "autoscale")
     #: directory names allowed to touch raw HTTP / RestClient
     client_dirs: Tuple[str, ...] = ("client",)
     #: composition roots additionally allowed to construct RestClient

@@ -393,6 +393,56 @@ def _serving_cell(labels: dict, annotations: dict) -> str:
     return verdict
 
 
+def _autoscale_cells(policy_obj, tpu_nodes, now=None) -> dict:
+    """AUTOSCALE column, keyed by node name: the node's pool posture —
+    current/target size against the spec bounds, the in-flight resize
+    direction, and the cooldown remaining while the pool is held. Read
+    from the same durable decision state the controller resumes from
+    (``tpu.ai/autoscale-state``), so the table shows exactly what the
+    next sweep will act on — the row the TPUAutoscaleSaturated runbook
+    sends a support case here to read."""
+    import json
+    import time
+
+    from .. import consts
+    from ..api.clusterpolicy import ClusterPolicy
+    from ..api.common import SpecValidationError
+    from ..state.nodepool import get_node_pools
+    from ..utils import deep_get
+
+    if not policy_obj:
+        return {}
+    try:
+        spec = ClusterPolicy.from_obj(policy_obj).spec.autoscale
+    except SpecValidationError:
+        return {}  # triage must render the rest of the table regardless
+    if not spec.is_enabled():
+        return {}
+    try:
+        states = json.loads(deep_get(
+            policy_obj, "metadata", "annotations",
+            consts.AUTOSCALE_STATE_ANNOTATION) or "{}")
+    except ValueError:
+        states = {}
+    if not isinstance(states, dict):
+        states = {}
+    now = time.time() if now is None else now
+    cells = {}
+    for pool in get_node_pools(tpu_nodes):
+        st = states.get(pool.name) or {}
+        cell = (f"{pool.size}/{st.get('target', pool.size)}"
+                f"[{spec.pool_min(pool.name)}-{spec.pool_max(pool.name)}]")
+        resize = st.get("resize") or {}
+        if resize.get("direction"):
+            cell += f" resizing:{resize['direction']}"
+        cooldown = float(st.get("cooldown_until") or 0.0) - now
+        if cooldown > 0:
+            cell += f" cd={cooldown:.0f}s"
+        for name in pool.node_names:
+            cells[name] = cell
+    return cells
+
+
 def _status(client, namespace, out) -> int:
     from .. import consts
     from ..utils import deep_get
@@ -402,6 +452,9 @@ def _status(client, namespace, out) -> int:
     policies = client.list("tpu.ai/v1", "ClusterPolicy")
     if not policies:
         print("ClusterPolicy: none found", file=out)
+    # same singleton rule as the controllers: first by sorted name
+    autoscale_policy = min(
+        policies, key=lambda p: p["metadata"]["name"]) if policies else None
     for policy in policies:
         state = deep_get(policy, "status", "state") or "unknown"
         ready = ready or state == "ready"
@@ -418,12 +471,15 @@ def _status(client, namespace, out) -> int:
               f"pools={pools}", file=out)
 
     # TPU nodes only — presence is the row filter, so no column for it
+    tpu_nodes = [n for n in client.list("v1", "Node")
+                 if (n.get("metadata", {}).get("labels", {}) or {})
+                 .get(consts.TPU_PRESENT_LABEL) == "true"]
+    autoscale_cells = _autoscale_cells(autoscale_policy, tpu_nodes)
     print("\nNODE            CAPACITY  HEALTHY  HEALTH-STATE     "
-          "UPGRADE-STATE    SLICE-PARTITION   SERVING", file=out)
-    for node in client.list("v1", "Node"):
+          "UPGRADE-STATE    SLICE-PARTITION   SERVING             "
+          "AUTOSCALE", file=out)
+    for node in tpu_nodes:
         labels = node.get("metadata", {}).get("labels", {}) or {}
-        if labels.get(consts.TPU_PRESENT_LABEL) != "true":
-            continue
         name = node["metadata"]["name"]
         capacity = deep_get(node, "status", "capacity",
                             consts.TPU_RESOURCE_NAME) or "0"
@@ -454,8 +510,10 @@ def _status(client, namespace, out) -> int:
             partition = "-"
         serving = _serving_cell(labels, node.get("metadata", {})
                                 .get("annotations", {}) or {})
+        autoscale = autoscale_cells.get(name, "-")
         print(f"{name:<15} {capacity:<9} {healthy:<8} {health_state:<16} "
-              f"{upgrade:<16} {partition:<17} {serving}", file=out)
+              f"{upgrade:<16} {partition:<17} {serving:<19} {autoscale}",
+              file=out)
 
     print("\nDAEMONSET                 DESIRED  AVAILABLE  UPDATED", file=out)
     for ds in client.list("apps/v1", "DaemonSet", namespace):

@@ -118,6 +118,29 @@ DRAIN_ACK_ANNOTATION = "tpu.ai/drain-ack"
 #: step/RNG/compile-cache state into before acking a drain
 DRAIN_CHECKPOINT_FILE = "drain-checkpoint.json"
 
+# -- SLO-driven fleet autoscaler ----------------------------------------------
+#: live traffic signal published onto the ClusterPolicy (JSON: ts,
+#: queue_depth, backlog_chips, attainment — the newest per-tick sample of
+#: serving/traffic.py's timeseries). The annotation patch doubles as the
+#: watch event that wakes the autoscale reconciler, so capacity reacts to
+#: load without polling.
+TRAFFIC_SNAPSHOT_ANNOTATION = "tpu.ai/traffic-snapshot"
+#: the autoscaler's crash-durable decision state, persisted on the
+#: ClusterPolicy (JSON per pool: target, cooldown_until, below_since, and
+#: the in-flight resize record {node, fingerprint, direction}). Written
+#: fenced + preconditioned BEFORE any actuation, so a restarted (or
+#: deposed-then-restarted) operator resumes exactly one in-flight resize
+#: per pool from cluster state alone.
+AUTOSCALE_STATE_ANNOTATION = "tpu.ai/autoscale-state"
+#: marks nodes the autoscaler registered itself (value = pool name), so
+#: scale-down prefers surrendering autoscaler-born capacity and status
+#: displays can attribute fleet growth.
+AUTOSCALE_MANAGED_LABEL = "tpu.ai/autoscale.managed"
+#: pools whose nodes the platform may revoke without warning (spot);
+#: mirrored from spec.autoscale.preemptiblePools onto member nodes so the
+#: kubelet simulator / chaos layer can target them without reading the CR.
+PREEMPTIBLE_POOL_LABEL = "tpu.ai/preemptible"
+
 # -- leader fencing ------------------------------------------------------------
 #: monotonic leader epoch on the election Lease (metadata.annotations).
 #: Bumped on every acquisition (create or takeover), never on renewal; the

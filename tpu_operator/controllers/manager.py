@@ -213,6 +213,12 @@ class OperatorApp:
                                                     metrics=self.metrics)
         self.upgrade_controller = self.manager.add(
             setup_upgrade_controller(client, self.upgrade_reconciler))
+        from ..autoscale import AutoscaleReconciler, setup_autoscale_controller
+
+        self.autoscale_reconciler = AutoscaleReconciler(
+            client, namespace=namespace, metrics=self.metrics)
+        self.autoscale_controller = self.manager.add(
+            setup_autoscale_controller(client, self.autoscale_reconciler))
         for controller in self.manager.controllers:
             controller.instrument(self.metrics, self.tracer)
         # rest_client_requests_total rides the innermost RestClient (the

@@ -107,6 +107,24 @@ class OperatorMetrics:
             "per-step latency SLO (min over batch rungs)",
             ["node"], registry=self.registry)
 
+        # SLO-driven fleet autoscaler (autoscale.AutoscaleReconciler)
+        self.autoscale_target_nodes = Gauge(
+            "tpu_operator_autoscale_target_nodes",
+            "Node count the autoscaler is steering each pool toward "
+            "(clamped to spec.autoscale minNodes/maxNodes)",
+            ["pool"], registry=self.registry)
+        self.autoscale_resizes = Counter(
+            "tpu_operator_autoscale_resizes",
+            "Pool resizes the autoscaler actuated, by direction (up = node "
+            "registered onto the join path, down = planned drain/re-tile)",
+            ["pool", "direction"], registry=self.registry)
+        self.autoscale_headroom_ratio = Gauge(
+            "tpu_operator_autoscale_headroom_ratio",
+            "Fleet chip capacity divided by forecast chip demand (1.0 = no "
+            "headroom; below 1.0 the fleet is under-provisioned and pools "
+            "are saturating at maxNodes or awaiting joins)",
+            registry=self.registry)
+
         # fleet join profiler (joinprofile.JoinProfiler feeds these from
         # the stitched operator+node join traces)
         self.join_phase_seconds = Histogram(

@@ -42,6 +42,9 @@ log = logging.getLogger(__name__)
 #: remediation attempt (an unattributed failure remediates without re-tiling)
 REASON_RETILE = "retile"
 REASON_REMEDIATE = "remediate"
+#: the autoscaler surrendering a node: same protocol (plan -> ack/deadline
+#: -> act), but the act is node removal, so workloads re-place off-node
+REASON_SCALE_DOWN = "scale-down"
 
 
 @dataclasses.dataclass(frozen=True)

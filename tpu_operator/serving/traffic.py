@@ -99,7 +99,8 @@ def run_scenario(groups: Sequence[dict],
                  arrival_rate_per_s: float = 2.0,
                  per_token_ms: float = 2.0,
                  queue_slo_s: float = 1.0,
-                 retile: Optional[dict] = None) -> Dict:
+                 retile: Optional[dict] = None,
+                 sample_interval_s: Optional[float] = None) -> Dict:
     """Run the multi-tenant scenario against a slice layout.
 
     ``groups`` is the partitioner handoff's ``groups`` list (each entry
@@ -108,6 +109,14 @@ def run_scenario(groups: Sequence[dict],
     ``{"at": <sim seconds>, "blocked": [group index, ...],
     "drain_window_s": <float>}`` — at that moment the named slices go
     unhealthy, tenants running there drain and re-place.
+
+    ``sample_interval_s``, when given, adds a ``timeseries`` list to the
+    result: the scenario's live state sampled every that-many simulated
+    seconds — queue depth, backlog chips requested (waiting + running =
+    the chips the fleet would need to serve everything now), and rolling
+    SLO attainment over recent completions. This is the autoscaler's
+    input signal: bench.py replays it tick by tick into the
+    ``tpu.ai/traffic-snapshot`` annotation.
 
     ``retile["planned"] = True`` models the coordinated drain protocol:
     the ``RetilePlanned`` signal fires at ``at`` — the named slices stop
@@ -160,6 +169,28 @@ def run_scenario(groups: Sequence[dict],
     preemptions = 0
     unhandled_errors = 0
     drained: List[_Request] = []
+
+    # -- per-tick sampling (the autoscaler's live signal) --
+    timeseries: List[dict] = []
+    completion_log: List[tuple] = []  # (finish, slo_met) in finish order
+    attain_window_s = (max(10.0 * sample_interval_s, queue_slo_s)
+                       if sample_interval_s else 0.0)
+
+    def sample(at: float) -> None:
+        lo = at - attain_window_s
+        recent = [ok for fin, ok in completion_log if fin > lo]
+        backlog = sum(r.chips for r in waiting)
+        in_service = sum(r.chips for r in running.values())
+        timeseries.append({
+            "t": round(at, 3),
+            "queue_depth": len(waiting),
+            "backlog_chips": backlog,
+            "demand_chips": backlog + in_service,
+            "running": len(running),
+            "attainment": (round(sum(recent) / len(recent), 4)
+                           if recent else None),
+            "completed": len(completed),
+        })
 
     def push_completion(req: _Request, now: float) -> None:
         nonlocal seq
@@ -226,8 +257,15 @@ def run_scenario(groups: Sequence[dict],
                 still.append(req)
         waiting[:] = still
 
+    next_sample = 0.0
     while events:
         now, _, kind, req, epoch = heapq.heappop(events)
+        if sample_interval_s:
+            # state is constant between events, so samples due before this
+            # event read the world exactly as the previous event left it
+            while next_sample <= min(now, duration_s):
+                sample(next_sample)
+                next_sample += sample_interval_s
         try:
             if kind == ARRIVE:
                 if req.chips > max_chips:
@@ -244,6 +282,10 @@ def run_scenario(groups: Sequence[dict],
                 req.remaining = 0.0
                 req.finish = now
                 completed.append(req)
+                if sample_interval_s:
+                    ideal = req.tokens / rate(req)
+                    completion_log.append(
+                        (now, (now - req.arrival) - ideal <= queue_slo_s))
                 try_place_all(now)
             elif kind == PLAN:
                 # RetilePlanned: named slices stop taking new tenants and
@@ -276,6 +318,11 @@ def run_scenario(groups: Sequence[dict],
                 try_place_all(now)
         except Exception:
             unhandled_errors += 1
+
+    if sample_interval_s:
+        while next_sample <= duration_s:
+            sample(next_sample)
+            next_sample += sample_interval_s
 
     preemptions = sum(r.preempted for r in requests)
     # churn: every placement beyond a request's first (preempt or drain)
@@ -311,6 +358,9 @@ def run_scenario(groups: Sequence[dict],
         "placement_churn": churn,
         "unhandled_errors": unhandled_errors,
     }
+    if sample_interval_s:
+        result["sample_interval_s"] = sample_interval_s
+        result["timeseries"] = timeseries
     if retile:
         window = float(retile.get("drain_window_s", 5.0))
         replaced = [r for r in drained if r.replaced_at is not None]

@@ -1,5 +1,5 @@
 from .apiserver import MiniApiServer
-from .chaos import PodChaos
+from .chaos import NodeChaos, PodChaos
 from .trainjob import SimulatedTrainingJob
 
-__all__ = ["MiniApiServer", "PodChaos", "SimulatedTrainingJob"]
+__all__ = ["MiniApiServer", "NodeChaos", "PodChaos", "SimulatedTrainingJob"]

@@ -13,8 +13,10 @@ import random
 import threading
 from typing import Optional
 
+from .. import consts
 from ..client.errors import ApiError, NotFoundError
 from ..client.interface import Client
+from ..utils import deep_get
 
 
 class PodChaos:
@@ -65,6 +67,77 @@ class PodChaos:
             self._thread.join(timeout=5)
 
     def __enter__(self) -> "PodChaos":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+class NodeChaos:
+    """PodChaos's bigger sibling: revokes whole PREEMPTIBLE nodes
+    mid-episode, the way a cloud reclaims spot capacity — pods and Node
+    object vanish together, with no drain plan published (see
+    :meth:`KubeletSimulator.revoke_node`). Only nodes carrying
+    ``tpu.ai/preemptible`` are eligible: the autoscaler opted those pools
+    into revocation risk via ``spec.autoscale.preemptiblePools``, and
+    chaos must not eat durable capacity the test expects to keep.
+
+    Deterministic via ``seed``; ``revoked`` lists victims in order so
+    tests can assert both that chaos struck and what it struck. Drive it
+    with ``revoke_one()`` for exact control, or start()/stop() (context
+    manager) for background carnage bounded by ``max_revocations``."""
+
+    def __init__(self, kubelet, interval_s: float = 0.1, seed: int = 1729,
+                 max_revocations: int = 1,
+                 label: str = consts.PREEMPTIBLE_POOL_LABEL):
+        self.kubelet = kubelet
+        self.interval_s = interval_s
+        self.max_revocations = max_revocations
+        self.label = label
+        self.revoked: list = []
+        self._rng = random.Random(seed)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def revoke_one(self) -> Optional[str]:
+        """Revoke one randomly chosen eligible node; None when no
+        preemptible capacity exists (or everything is already gone)."""
+        try:
+            nodes = self.kubelet.client.list("v1", "Node")
+        except ApiError:
+            return None
+        eligible = sorted(
+            n["metadata"]["name"] for n in nodes
+            if deep_get(n, "metadata", "labels", self.label) == "true")
+        if not eligible:
+            return None
+        victim = self._rng.choice(eligible)
+        if not self.kubelet.revoke_node(victim):
+            return None
+        self.revoked.append(victim)
+        return victim
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            if len(self.revoked) >= self.max_revocations:
+                return
+            try:
+                self.revoke_one()
+            except (NotFoundError, ApiError):
+                continue  # chaos must tolerate the chaos it causes
+
+    def start(self) -> "NodeChaos":
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="node-chaos")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def __enter__(self) -> "NodeChaos":
         return self.start()
 
     def __exit__(self, *exc) -> None:

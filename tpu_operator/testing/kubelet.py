@@ -206,6 +206,31 @@ class KubeletSimulator:
             pod["status"] = {"phase": phase}
             self.client.update_status(pod)
 
+    def revoke_node(self, name: str) -> bool:
+        """Spot/preemptible reclamation: the cloud takes the machine back
+        with no warning — every pod on the node vanishes and the Node
+        object goes with it. Deliberately NO drain plan and no ack window:
+        revocation is exactly the path the coordinated drain protocol
+        cannot cover, so tests use this to prove the health machine and
+        the autoscaler's replacement loop recover capacity anyway.
+        Returns False when the node was already gone."""
+        from ..client.errors import NotFoundError
+
+        for pod in self.client.list("v1", "Pod", None,
+                                    field_selector={"spec.nodeName": name}):
+            try:
+                self.client.delete(
+                    "v1", "Pod", pod["metadata"]["name"],
+                    deep_get(pod, "metadata", "namespace"))
+            except NotFoundError:
+                pass
+        try:
+            self.client.delete("v1", "Node", name)
+        except NotFoundError:
+            return False
+        log.info("kubelet sim: node %s revoked (spot reclaim)", name)
+        return True
+
     @staticmethod
     def _is_device_plugin(ds: dict) -> bool:
         component = deep_get(ds, "spec", "template", "metadata", "labels",
