@@ -70,6 +70,12 @@ AUTOSCALE_BENCH_SEED ?= 20260805
 autoscale-bench:  ## closed-loop autoscaler episode (seeded diurnal curve + mid-episode preemptible revocation) through the latency-injected simulator; fails unless SLO attainment >= target at strictly fewer node-hours than a static peak-sized fleet, with zero bare deletes and revoked capacity replaced in-window
 	AUTOSCALE_BENCH_SEED=$(AUTOSCALE_BENCH_SEED) JAX_PLATFORMS=cpu $(PYTHON) bench.py --autoscale
 
+MIGRATE_BENCH_SEED ?= 20260805
+
+.PHONY: migrate-bench
+migrate-bench:  ## end-to-end cross-node migration pair (cooperative drain-ack + wedged-trainer transparent snapshot) through the latency-injected simulator; fails unless both tenants resume on the destination at exactly the committed step (zero steps lost), the wedged one via the snapshot path (never a bare force-retile), inside the wall-clock budget
+	MIGRATE_BENCH_SEED=$(MIGRATE_BENCH_SEED) JAX_PLATFORMS=cpu $(PYTHON) bench.py --migrate
+
 .PHONY: generate
 generate:  ## regenerate CRDs into all install channels (reference: make manifests)
 	$(PYTHON) hack/gen-crds.py

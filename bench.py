@@ -636,6 +636,160 @@ def bench_autoscale(seed: int = None) -> dict:
         srv.stop()
 
 
+#: seed for `make migrate-bench` (overridable via $MIGRATE_BENCH_SEED):
+#: pins Poisson-free but still content-addressed Event naming and the
+#: simulated episode bit-for-bit
+MIGRATE_BENCH_SEED = 20260805
+MIGRATE_TICK_S = 1.0
+MIGRATE_EPISODE_TICK_BUDGET = 120
+#: real-seconds budget for the whole bench (two episodes through the
+#: latency-injected sim): generous on CI hardware, tight enough to catch
+#: a polling regression that turns the episode into minutes of spinning
+MIGRATE_WALL_BUDGET_S = 120.0
+
+
+def bench_migrate(seed: int = None) -> dict:
+    """End-to-end cross-node migration through the latency-injected
+    simulator (`make migrate-bench`): the REAL MigrationReconciler (behind
+    WriteBatcher -> RetryingClient -> FencedClient) drains a tenant off
+    node A, transfers the checkpoint manifest, and restores it on node B's
+    slice — episode 1 with a cooperating trainer (drain-ack path), episode
+    2 with a wedged trainer that never acks and is recovered via the
+    operator-driven transparent snapshot instead of a bare force-retile.
+    Simulated clock for all deadlines; the kubelet sim runs the node-side
+    migrate agents; zero steps lost is asserted by resuming a trainer from
+    the DESTINATION's restored checkpoint and comparing steps."""
+    import shutil
+    import tempfile
+
+    from tpu_operator import consts
+    from tpu_operator.api.clusterpolicy import new_cluster_policy
+    from tpu_operator.client.batch import WriteBatcher
+    from tpu_operator.client.fenced import FencedClient
+    from tpu_operator.client.resilience import RetryingClient
+    from tpu_operator.client.rest import RestClient
+    from tpu_operator.controllers.runtime import Request
+    from tpu_operator.health import drain as drain_protocol
+    from tpu_operator.migrate import MigrationReconciler, migration_state
+    from tpu_operator.migrate import agent as migrate_agent
+    from tpu_operator.testing import MiniApiServer
+    from tpu_operator.testing.kubelet import KubeletSimulator
+    from tpu_operator.testing.trainjob import SimulatedTrainingJob
+    from tpu_operator.validator.status import StatusFiles
+
+    seed = int(os.environ.get("MIGRATE_BENCH_SEED",
+                              MIGRATE_BENCH_SEED)) if seed is None else seed
+    accelerator = "tpu-v5-lite-podslice"
+    chips = 4
+    tmp = tempfile.mkdtemp(prefix="migrate-bench-")
+    prior_transfer = os.environ.get(migrate_agent.TRANSFER_DIR_ENV)
+    # the shared host-path tree doubles as the object store: each node's
+    # status dir is <tmp>/<node>, which is exactly where the destination
+    # agent's default fetch looks for the source's checkpoint
+    os.environ[migrate_agent.TRANSFER_DIR_ENV] = tmp
+    srv = MiniApiServer(latency_s=0.002)
+    base = srv.start()
+    feeder = RestClient(base_url=base)  # node agents + trainers + FD mirror
+    feeder.create(new_cluster_policy(spec={
+        "migrate": {"enabled": True, "snapshotWaitS": 10,
+                    "restoreWaitS": 30},
+        "health": {"drainDeadlineS": 3},
+    }))
+    for name in ("tpu-a", "tpu-b", "tpu-c", "tpu-d"):
+        feeder.create({
+            "apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": name, "labels": {
+                consts.GKE_TPU_ACCELERATOR_LABEL: accelerator,
+                consts.GKE_TPU_TOPOLOGY_LABEL: "2x2"}},
+            "status": {"capacity": {consts.TPU_RESOURCE_NAME: str(chips)}}})
+
+    clock = [0.0]
+    op_client = WriteBatcher(RetryingClient(FencedClient(
+        RestClient(base_url=base))))
+    reconciler = MigrationReconciler(op_client, now=lambda: clock[0])
+    kubelet = KubeletSimulator(feeder)
+    statuses = {}
+    for name in ("tpu-a", "tpu-b", "tpu-c", "tpu-d"):
+        statuses[name] = StatusFiles(os.path.join(tmp, name))
+        kubelet.attach_migrate_agent(name, statuses[name],
+                                     accelerator=accelerator,
+                                     total_chips=chips)
+
+    def mirror_ack(src: str) -> None:
+        # the feature-discovery role: publish the barrier's drain-ack
+        # stamp as the node annotation the operator sweep reads
+        ack = drain_protocol.read_drain_ack(statuses[src])
+        value = drain_protocol.ack_annotation_value(ack)
+        if value:
+            feeder.patch("v1", "Node", src, {"metadata": {"annotations": {
+                consts.DRAIN_ACK_ANNOTATION: value}}})
+
+    def run_episode(src: str, dst: str, job) -> dict:
+        feeder.patch("v1", "Node", src, {"metadata": {"annotations": {
+            consts.MIGRATE_REQUEST_ANNOTATION: json.dumps(
+                {"reason": "bench", "dst": dst}, sort_keys=True)}}})
+        phases = []
+        state = None
+        for tick in range(MIGRATE_EPISODE_TICK_BUDGET):
+            clock[0] += MIGRATE_TICK_S
+            job.tick()
+            mirror_ack(src)
+            kubelet.tick()
+            reconciler.reconcile(Request(name=src))
+            state = migration_state(srv.backend.get("v1", "Node", src))
+            if state and (not phases or phases[-1] != state["phase"]):
+                phases.append(state["phase"])
+            if state and state["phase"] in ("done", "failed"):
+                break
+        resumer = SimulatedTrainingJob(feeder, dst, statuses[dst])
+        return {"src": src, "dst": dst,
+                "phase": (state or {}).get("phase"),
+                "phases": phases,
+                "final_step": (state or {}).get("step"),
+                "ticks": tick + 1,
+                "error": (state or {}).get("error"),
+                "resume_step": resumer.resume()}
+
+    wall0 = time.monotonic()
+    try:
+        # episode 1: cooperating trainer — the drain-ack path
+        job_a = SimulatedTrainingJob(feeder, "tpu-a", statuses["tpu-a"],
+                                     partition="2x2")
+        ep1 = run_episode("tpu-a", "tpu-b", job_a)
+        ack = drain_protocol.read_drain_ack(statuses["tpu-a"]) or {}
+        ep1["ack_step"] = ack.get("step")
+        # episode 2: wedged trainer — never acks; only the transparent
+        # snapshot (reading its process-state mirror) can save its steps
+        job_c = SimulatedTrainingJob(feeder, "tpu-c", statuses["tpu-c"],
+                                     cooperative=False, partition="2x2")
+        ep2 = run_episode("tpu-c", "tpu-d", job_c)
+        ep2["wedged_trainer_step"] = job_c.step
+        wall_s = time.monotonic() - wall0
+        namespace = consts.DEFAULT_NAMESPACE
+        reasons = [e.get("reason") for e in
+                   srv.backend.list("v1", "Event", namespace)]
+        return {
+            "simulated": True,
+            "seed": seed,
+            "tick_s": MIGRATE_TICK_S,
+            "wall_s": round(wall_s, 3),
+            "wall_budget_s": MIGRATE_WALL_BUDGET_S,
+            "cooperative": ep1,
+            "transparent": ep2,
+            "snapshot_used": "snapshotting" in ep2["phases"],
+            "event_reasons": sorted(set(r for r in reasons if r)),
+            "force_retiles": reasons.count("RetileDeadlineExpired"),
+        }
+    finally:
+        op_client.stop()
+        srv.stop()
+        if prior_transfer is None:
+            os.environ.pop(migrate_agent.TRANSFER_DIR_ENV, None)
+        else:
+            os.environ[migrate_agent.TRANSFER_DIR_ENV] = prior_transfer
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 #: matrix dim for the join bench's real node-side ICI sweep: small enough
 #: to finish well inside the injected DS rollout window on a CPU host
 JOIN_BENCH_MATRIX_DIM = 64
@@ -1158,6 +1312,35 @@ def autoscale_bench_main() -> int:
     return 0 if all(gates.values()) else 1
 
 
+def migrate_bench_main() -> int:
+    """`make migrate-bench`: the end-to-end cross-node migration episode
+    pair, one JSON line. Exit 0 iff both episodes completed, the tenant
+    resumed on the DESTINATION at exactly the committed step (zero steps
+    lost — `resume_step == ack_step` for the cooperative episode, and the
+    final migrated step for the wedged one), the wedged trainer was
+    recovered via the transparent snapshot path (never a bare
+    force-retile), and the whole bench stayed inside its wall-clock
+    budget."""
+    out = bench_migrate()
+    ep1, ep2 = out["cooperative"], out["transparent"]
+    gates = {
+        "cooperative_completed": ep1["phase"] == "done",
+        "cooperative_zero_steps_lost": (
+            ep1["resume_step"] is not None
+            and ep1["resume_step"] == ep1["ack_step"]),
+        "transparent_completed": ep2["phase"] == "done",
+        "transparent_zero_steps_lost": (
+            ep2["resume_step"] is not None
+            and ep2["resume_step"] == ep2["final_step"]),
+        "snapshot_path_used": out["snapshot_used"],
+        "no_bare_force_retile": out["force_retiles"] == 0,
+        "wall_under_budget": out["wall_s"] <= out["wall_budget_s"],
+    }
+    line = {"metric": "migration_episode", "migrate": out, "gates": gates}
+    print(json.dumps(line))
+    return 0 if all(gates.values()) else 1
+
+
 def join_bench_main() -> int:
     """`make join-bench`: the end-to-end join-attribution bench alone, one
     JSON line; exit 0 iff the stitched trace is complete, node-side spans
@@ -1184,4 +1367,6 @@ if __name__ == "__main__":
         sys.exit(scale_bench_main())
     if "--autoscale" in _argv:
         sys.exit(autoscale_bench_main())
+    if "--migrate" in _argv:
+        sys.exit(migrate_bench_main())
     sys.exit(main())

@@ -28,6 +28,7 @@ record run missed fails the whole matrix. ``make crash-soak`` runs the
 slow full matrix with CRASH_SOAK_SEED pinning the replay order.
 """
 
+import json
 import os
 import random
 import time
@@ -460,4 +461,298 @@ def test_crash_point_matrix_full_episode(tmp_path, monkeypatch):
         failures.append(
             "state-mutating sites outside the replay matrix (record run "
             f"missed them): {sorted(uncovered)}")
+    assert not failures, "\n".join(failures)
+
+
+# -- migration episode (docs/design.md §15) ------------------------------------
+
+#: the migration-episode Events whose multiplicity the invariants pin down
+MIGRATION_EVENT_REASONS = ("RetilePlanned", "MigrationCompleted",
+                           "MigrationRestored", "MigrationFailed",
+                           "MigrationSnapshotRequested",
+                           "MigrationSnapshotFailed", "MigrationBlocked",
+                           "RetileDeadlineExpired")
+
+#: substrings that mark a mutating site as part of the migration episode
+#: proper (the install-phase operand writes around it are already matrix-
+#: covered by the health episode above)
+MIGRATION_SITE_MARKERS = (
+    consts.MIGRATE_REQUEST_ANNOTATION,
+    consts.MIGRATION_STATE_ANNOTATION,
+    consts.MIGRATE_SNAPSHOT_REQUEST_ANNOTATION,
+    consts.MIGRATE_SNAPSHOT_RESULT_ANNOTATION,
+    consts.MIGRATION_INBOUND_ANNOTATION,
+    consts.MIGRATION_RESTORE_ANNOTATION,
+    consts.RETILE_PLAN_ANNOTATION,
+    consts.DRAIN_ACK_ANNOTATION,
+    "Migration",
+    "RetilePlanned",
+)
+
+
+class MigrationCrashEpisode:
+    """One full cross-node migration episode (cooperative drain-ack path)
+    with an optional armed crash point, same plumbing as
+    :class:`CrashEpisode`: the operator on
+    ``CachedClient(WriteBatcher(CrashPointClient(RestClient)))``, node
+    agents and assertions on a separate plain client, cold restart on
+    every kill. The shared host-path tree doubles as the transfer object
+    store (each node's status dir is ``<transfer dir>/<node>``)."""
+
+    def __init__(self, tmp_path, monkeypatch, arm=None):
+        tmp_path.mkdir(parents=True, exist_ok=True)
+        transfer = tmp_path / "transfer"
+        self.src_status = StatusFiles(str(transfer / "tpu-src"))
+        self.dst_status = StatusFiles(str(transfer / "tpu-dst"))
+        monkeypatch.setenv("TPU_MIGRATE_TRANSFER_DIR", str(transfer))
+
+        self.srv = MiniApiServer()
+        self.base = self.srv.start()
+        self.chaos = RestClient(base_url=self.base)
+        crash = CrashPointClient(RestClient(base_url=self.base), arm=arm)
+        self.crashpoints = [crash]
+        op_client = CachedClient(WriteBatcher(crash))
+        self.kubelet = KubeletSimulator(self.chaos, interval=0.05,
+                                        create_pods=True).start()
+        for name, status in (("tpu-src", self.src_status),
+                             ("tpu-dst", self.dst_status)):
+            self.chaos.create({"apiVersion": "v1", "kind": "Node",
+                               "metadata": {"name": name,
+                                            "labels": dict(TPU_LABELS)},
+                               "status": {}})
+            self.kubelet.attach_migrate_agent(
+                name, status,
+                accelerator=TPU_LABELS[consts.GKE_TPU_ACCELERATOR_LABEL],
+                total_chips=8)
+        self.app = OperatorApp(op_client)
+        self.apps = [self.app]
+        self.clients = [op_client]
+        self.crashes = 0
+
+    maybe_restart = CrashEpisode.maybe_restart
+    wait = CrashEpisode.wait
+    event_count = CrashEpisode.event_count
+    all_sites = CrashEpisode.all_sites
+    teardown = CrashEpisode.teardown
+
+    def node(self, name):
+        return self.chaos.get("v1", "Node", name)
+
+    def node_event_count(self, reason, name):
+        return sum(e.get("count", 1)
+                   for e in self.chaos.list("v1", "Event", "tpu-operator")
+                   if e.get("reason") == reason
+                   and deep_get(e, "involvedObject", "name") == name)
+
+    def mirror_ack(self):
+        """The feature-discovery role: mirror the workload barrier's
+        drain ack onto the source node annotation (agents are separate
+        processes; a dying operator cannot take this down)."""
+        ack = drain.read_drain_ack(self.src_status)
+        if not ack:
+            return
+        self.chaos.patch("v1", "Node", "tpu-src", {"metadata": {
+            "annotations": {consts.DRAIN_ACK_ANNOTATION:
+                            drain.ack_annotation_value(ack)}}})
+
+    def terminal_state(self):
+        out = {}
+        for name in ("tpu-src", "tpu-dst"):
+            node = self.node(name)
+            anns = dict(deep_get(node, "metadata", "annotations",
+                                 default={}) or {})
+            # the episode record carries wall-clock stamps (deadlines,
+            # started_at) and a crash-dependent transition counter; only
+            # its *semantic* core is pinned run-to-run
+            raw = anns.pop(consts.MIGRATION_STATE_ANNOTATION, None)
+            state = {}
+            if raw:
+                parsed = json.loads(raw)
+                state = {k: parsed.get(k)
+                         for k in ("phase", "src", "dst", "plan", "step")}
+            out[name] = {
+                "labels": dict(deep_get(node, "metadata", "labels",
+                                        default={}) or {}),
+                "annotations": {k: v for k, v in anns.items()
+                                if k not in VOLATILE_ANNOTATIONS},
+                "migration": state,
+            }
+        return out
+
+    def run(self):
+        self.chaos.create(new_cluster_policy(spec={
+            "migrate": {"enabled": True, "snapshotWaitS": 10,
+                        "restoreWaitS": 30},
+            "health": {"drainDeadlineS": 60}}))
+        self.app.start()
+        self.wait(lambda: deep_get(
+            self.chaos.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy"),
+            "status", "state") == "ready", message="install ready")
+
+        job = SimulatedTrainingJob(self.chaos, "tpu-src", self.src_status)
+        for _ in range(5):
+            job.tick()
+
+        # -- the admin asks for the move -----------------------------------
+        self.chaos.patch("v1", "Node", "tpu-src", {"metadata": {
+            "annotations": {consts.MIGRATE_REQUEST_ANNOTATION:
+                            json.dumps({"reason": "crash-soak",
+                                        "dst": "tpu-dst"},
+                                       sort_keys=True)}}})
+        self.wait(lambda: drain.node_plan(self.node("tpu-src")) is not None,
+                  message="migration drain plan published")
+
+        # -- the workload acks: checkpoint + barrier stamp, FD mirrors -----
+        job.tick()
+        ack_step = job.step
+        self.mirror_ack()
+
+        # -- transfer + restore run to a terminal phase --------------------
+        from tpu_operator.migrate import migration_state
+
+        def settled():
+            """Terminal phase AND converged cleanup: finalize spans two
+            objects, so a replay may land the terminal record first and
+            repair the working annotations on its next sweep."""
+            state = migration_state(self.node("tpu-src"))
+            if state is None or state["phase"] not in ("done", "failed"):
+                return False
+            if state["phase"] == "failed":
+                return True
+            src_anns = deep_get(self.node("tpu-src"), "metadata",
+                                "annotations", default={}) or {}
+            dst_anns = deep_get(self.node("tpu-dst"), "metadata",
+                                "annotations", default={}) or {}
+            working = {consts.MIGRATE_REQUEST_ANNOTATION,
+                       consts.RETILE_PLAN_ANNOTATION,
+                       consts.DRAIN_ACK_ANNOTATION}
+            return (not (working & set(src_anns))
+                    and consts.MIGRATION_INBOUND_ANNOTATION not in dst_anns)
+
+        self.wait(settled, timeout=90.0,
+                  message="terminal migration phase + converged cleanup")
+        state = migration_state(self.node("tpu-src"))
+
+        # -- the tenant resumes on the DESTINATION -------------------------
+        resumed = SimulatedTrainingJob(self.chaos, "tpu-dst",
+                                       self.dst_status)
+        resume_step = resumed.resume()
+
+        return {
+            "phase": state["phase"],
+            "terminal": self.terminal_state(),
+            "src_events": {r: self.node_event_count(r, "tpu-src")
+                           for r in MIGRATION_EVENT_REASONS},
+            "dst_events": {r: self.node_event_count(r, "tpu-dst")
+                           for r in MIGRATION_EVENT_REASONS},
+            "ack_step": ack_step,
+            "resume_step": resume_step,
+            "sites": list(self.crashpoints[0].sites),
+            "all_sites": self.all_sites(),
+            "fired": self.crashpoints[0].fired,
+            "crashes": self.crashes,
+        }
+
+
+def run_migration_episode(tmp_path, monkeypatch, arm=None):
+    episode = MigrationCrashEpisode(tmp_path, monkeypatch, arm=arm)
+    try:
+        return episode.run()
+    finally:
+        episode.teardown()
+
+
+def check_migration_invariants(summary, baseline):
+    """The convergence contract every migration crash replay must
+    satisfy: exactly one restore, zero duplicate Events, zero steps
+    lost."""
+    assert summary["phase"] == "done", \
+        f"episode must complete, ended {summary['phase']!r}"
+    assert summary["terminal"] == baseline["terminal"], \
+        "terminal node state diverged from the crash-free baseline"
+    assert summary["resume_step"] == summary["ack_step"], \
+        "the destination resume must land exactly on the acked checkpoint"
+    assert summary["ack_step"] >= 5, "pre-plan steps were lost"
+    assert summary["src_events"]["RetilePlanned"] == 1, \
+        f"RetilePlanned must fire exactly once, saw {summary['src_events']}"
+    assert summary["src_events"]["MigrationCompleted"] == 1, \
+        "duplicate (or lost) MigrationCompleted"
+    assert summary["dst_events"]["MigrationRestored"] == 1, \
+        "duplicate (or lost) restore announcement"
+    for reason in ("MigrationFailed", "MigrationSnapshotRequested",
+                   "MigrationSnapshotFailed", "MigrationBlocked",
+                   "RetileDeadlineExpired"):
+        assert summary["src_events"][reason] == 0, \
+            f"cooperative episode must never see {reason}"
+
+
+def migration_sites(sites):
+    return [s for s in sites
+            if any(marker in s for marker in MIGRATION_SITE_MARKERS)]
+
+
+# -- fast lane (tier-1): baseline + sampled kills on the durable-state write ---
+
+def test_migration_crash_baseline_and_sampled_kills(tmp_path, monkeypatch):
+    """Tier-1 smoke: the crash-free migration episode satisfies its own
+    invariants and enumerates the episode's mutating sites; one
+    before-kill and one after-kill on the subsystem's most delicate
+    write (the durable ``tpu.ai/migration-state`` record) both converge
+    to exactly one restore. The full matrix is the slow test below."""
+    baseline = run_migration_episode(tmp_path / "baseline", monkeypatch)
+    check_migration_invariants(baseline, baseline)
+    assert baseline["crashes"] == 0 and not baseline["fired"]
+    episode_sites = migration_sites(baseline["sites"])
+    assert len(episode_sites) >= 4, baseline["sites"]
+
+    state_sites = [s for s in episode_sites
+                   if consts.MIGRATION_STATE_ANNOTATION in s]
+    assert state_sites, baseline["sites"]
+    for i, when in enumerate(("before", "after")):
+        summary = run_migration_episode(tmp_path / f"kill{i}", monkeypatch,
+                                        arm=(state_sites[0], when))
+        assert summary["fired"], f"site {state_sites[0]!r} never re-fired"
+        assert summary["crashes"] == 1
+        check_migration_invariants(summary, baseline)
+
+
+# -- the full migration matrix (make crash-soak) -------------------------------
+
+@pytest.mark.slow
+def test_migration_crash_point_matrix(tmp_path, monkeypatch):
+    """Coverage-complete over the migration episode: every mutating site
+    the episode exercises — request intake, durable state record, plan
+    publication, ack mirror intake, inbound transfer record, restore
+    answer, finalize cleanup — is killed both before and after its
+    write, and every replay converges to exactly one restore with zero
+    duplicate Events."""
+    baseline = run_migration_episode(tmp_path / "baseline", monkeypatch)
+    check_migration_invariants(baseline, baseline)
+    sites = migration_sites(baseline["sites"])
+    assert len(sites) >= 4, baseline["sites"]
+
+    matrix = [(site, when) for site in sites for when in ("before", "after")]
+    rng = random.Random(int(os.environ.get("CRASH_SOAK_SEED", "20260805")))
+    rng.shuffle(matrix)  # replay order must not matter; the seed pins it
+
+    observed = set(migration_sites(baseline["all_sites"]))
+    failures = []
+    for i, (site, when) in enumerate(matrix):
+        summary = run_migration_episode(tmp_path / f"ep{i}", monkeypatch,
+                                        arm=(site, when))
+        observed |= set(migration_sites(summary["all_sites"]))
+        if not summary["fired"]:
+            failures.append(f"uncovered crash site ({when}): {site}")
+            continue
+        try:
+            check_migration_invariants(summary, baseline)
+        except AssertionError as e:
+            failures.append(f"kill {when} {site}: {e}")
+    # self-audit, same shape as the health matrix: a migration STATE
+    # write pathway the record run never saw means sampling, not coverage
+    uncovered = {s for s in observed - set(sites) if " Event/" not in s}
+    if uncovered:
+        failures.append(
+            "migration state-mutating sites outside the replay matrix "
+            f"(record run missed them): {sorted(uncovered)}")
     assert not failures, "\n".join(failures)

@@ -161,7 +161,8 @@ def test_debug_queue_and_state_shapes(monkeypatch):
         queues = rq.get(f"http://127.0.0.1:{hport}/debug/queue",
                         timeout=5).json()
         assert {q["controller"] for q in queues} == {
-            "clusterpolicy", "tpudriver", "upgrade", "autoscale"}
+            "clusterpolicy", "tpudriver", "upgrade", "autoscale",
+            "migrate"}
         for q in queues:
             assert {"depth_ready", "delayed", "pending", "backoff",
                     "inflight", "worker_alive"} <= set(q)

@@ -1,0 +1,619 @@
+"""Migration subsystem (tpu_operator/migrate/, docs/design.md §15).
+
+Four layers, mirroring the package's own split:
+
+* the checkpoint schema — v2 payloads (optimizer pointers + sharded-array
+  manifest keyed by the layout fingerprint) round-trip, v1 payloads keep
+  loading, and a corrupt file becomes a counted, content-addressed
+  ``CheckpointCorrupt`` Event instead of silent restart-from-scratch;
+* the node-side migrate agent — transparent snapshot and restore, both
+  idempotent across operator crash-replays and agent restarts;
+* the MigrationReconciler phase machine against a FakeClient — the
+  cooperative drain-ack path, the deadline→transparent-snapshot path,
+  the failed-snapshot fallback, retarget on a vanished destination, and
+  exactly-once announcements across replayed sweeps;
+* the wiring — the autoscaler delegating scale-down to a migration
+  episode, and the cfgtool MIGRATION status column.
+
+The end-to-end pair (real MiniApiServer + kubelet-sim agents + wall
+clock) is ``make migrate-bench``; the crash-point matrix over every
+mutating site of an episode is in test_crash_soak.py.
+"""
+
+import json
+
+import pytest
+
+from tpu_operator import consts
+from tpu_operator.api.clusterpolicy import ClusterPolicy, new_cluster_policy
+from tpu_operator.autoscale.controller import AutoscaleReconciler
+from tpu_operator.cfgtool.main import _migration_cell
+from tpu_operator.client.fake import FakeClient
+from tpu_operator.controllers.metrics import OperatorMetrics
+from tpu_operator.controllers.runtime import Request
+from tpu_operator.health import drain
+from tpu_operator.migrate import agent as migrate_agent
+from tpu_operator.migrate import checkpoint as ckpt
+from tpu_operator.migrate.controller import (
+    MigrationReconciler,
+    migration_state,
+)
+from tpu_operator.validator.status import StatusFiles
+
+NS = "tpu-operator"
+
+TPU_LABELS = {
+    consts.TPU_PRESENT_LABEL: "true",
+    consts.GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice",
+    consts.GKE_TPU_TOPOLOGY_LABEL: "2x2",
+}
+
+
+class Clock:
+    def __init__(self, t=1_000_000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def mk_node(name):
+    return {"apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": name, "labels": dict(TPU_LABELS)},
+            "status": {"capacity": {consts.TPU_RESOURCE_NAME: "4"}}}
+
+
+def events_with_reason(client, reason):
+    return [e for e in client.list("v1", "Event", NS)
+            if e.get("reason") == reason]
+
+
+# -- checkpoint schema (tentpole a) -------------------------------------------
+
+def test_save_checkpoint_v2_roundtrip(tmp_path):
+    path = str(tmp_path / "drain-checkpoint.json")
+    manifest = ckpt.build_manifest(
+        "2x2", [], groups=[{"topology": "2x2", "chips": [0, 1, 2, 3]}])
+    ckpt.save_checkpoint_v2(
+        path, 42, rng_state=[1, 2],
+        optimizer_state=ckpt.optimizer_state_pointer(str(tmp_path)),
+        manifest=manifest, now=lambda: 123.0)
+    loaded = drain.load_checkpoint(path)
+    assert loaded["step"] == 42 and loaded["rng_state"] == [1, 2]
+    assert ckpt.checkpoint_version(loaded) == 2
+    assert loaded["optimizer_state"]["format"] == "msgpack"
+    assert loaded["optimizer_state"]["path"].endswith(
+        ckpt.OPTIMIZER_STATE_FILE)
+    # the manifest key IS the layout identity the drain protocol uses
+    assert ckpt.manifest_layout(loaded) == drain.plan_fingerprint("2x2", [])
+    assert loaded["manifest"]["shards"][0]["chips"] == [0, 1, 2, 3]
+    assert "transparent" not in loaded  # workload-written, not a snapshot
+
+
+def test_v1_checkpoints_still_load(tmp_path):
+    """Old checkpoints (no version key) stay loadable forever — every v2
+    key is additive."""
+    path = str(tmp_path / "drain-checkpoint.json")
+    drain.save_checkpoint(path, 7, rng_state=[3])
+    loaded = drain.load_checkpoint(path)
+    assert loaded["step"] == 7
+    assert ckpt.checkpoint_version(loaded) == 1
+    assert ckpt.manifest_layout(loaded) is None
+
+
+def test_checkpoint_version_of_garbage():
+    assert ckpt.checkpoint_version(None) == 0
+    assert ckpt.checkpoint_version({"step": 1}) == 1
+    assert ckpt.checkpoint_version({"step": 1, "version": "x"}) == 1
+    assert ckpt.checkpoint_version({"version": 2}) == 2
+
+
+def test_remap_manifest_onto_healthy_destination():
+    manifest = ckpt.build_manifest(
+        "2x2", [], groups=[{"topology": "2x2", "chips": [0, 1, 2, 3]},
+                           {"topology": "2x2", "chips": [4, 5, 6, 7]}])
+    out = ckpt.remap_manifest(manifest, "tpu-v5-lite-podslice", 8, [], "2x2")
+    assert out is not None and len(out["shards"]) == 2
+    assert out["layout"] == drain.plan_fingerprint("2x2", [])
+    # every shard landed on a full-size footprint; arrays ride along
+    for shard in out["shards"]:
+        assert len(shard["chips"]) == 4
+        assert shard["arrays"] == ["params", "opt_state"]
+
+
+def test_remap_manifest_refuses_undersized_destination():
+    """A destination that cannot place every shard returns None — callers
+    must pick another node, never silently drop arrays."""
+    manifest = ckpt.build_manifest(
+        "2x2", [], groups=[{"topology": "2x2", "chips": [0, 1, 2, 3]},
+                           {"topology": "2x2", "chips": [4, 5, 6, 7]}])
+    out = ckpt.remap_manifest(manifest, "tpu-v5-lite-podslice", 8,
+                              [0, 1, 2, 3, 4], "2x2")
+    assert out is None
+
+
+# -- corrupt-checkpoint visibility (satellite 1) ------------------------------
+
+@pytest.mark.parametrize("raw,kind", [
+    ('{"step": 5', "torn"),          # truncated mid-write
+    ("[1, 2, 3]", "non-dict"),
+    ('{"saved_at": 1.0}', "missing-step"),
+])
+def test_load_checkpoint_corrupt_kinds(tmp_path, raw, kind):
+    path = tmp_path / "drain-checkpoint.json"
+    path.write_text(raw)
+    seen = []
+    assert drain.load_checkpoint(
+        str(path), on_corrupt=lambda k, r: seen.append((k, r))) is None
+    assert seen == [(kind, raw)]
+
+
+def test_load_checkpoint_absent_is_not_corrupt(tmp_path):
+    seen = []
+    assert drain.load_checkpoint(
+        str(tmp_path / "nope.json"),
+        on_corrupt=lambda k, r: seen.append(k)) is None
+    assert seen == []  # first boot, not data loss
+
+
+def test_corrupt_reporter_counts_and_records_once(tmp_path):
+    client = FakeClient()
+    client.create(mk_node("tpu-a"))
+    metrics = OperatorMetrics()
+    report = ckpt.corrupt_reporter(client, NS, "tpu-a", metrics=metrics)
+    path = tmp_path / "drain-checkpoint.json"
+    path.write_text('{"step": 5')
+
+    for _ in range(3):  # retried loads of the SAME torn file
+        drain.load_checkpoint(str(path), on_corrupt=report)
+    assert metrics.checkpoint_corrupt._value.get() == 3
+    # ...collapse to ONE content-addressed Event
+    assert len(events_with_reason(client, "CheckpointCorrupt")) == 1
+
+    path.write_text("[1]")  # a differently-corrupt successor
+    drain.load_checkpoint(str(path), on_corrupt=report)
+    assert len(events_with_reason(client, "CheckpointCorrupt")) == 2
+
+
+# -- the migrate agent (tentpole b) -------------------------------------------
+
+def snapshot_fp():
+    return drain.plan_fingerprint("migrate:tpu-a->tpu-b", [])
+
+
+def test_snapshot_once_dumps_live_state_without_cooperation(tmp_path):
+    client = FakeClient()
+    client.create(mk_node("tpu-a"))
+    status = StatusFiles(str(tmp_path / "status"))
+    fp = snapshot_fp()
+    client.patch("v1", "Node", "tpu-a", {"metadata": {"annotations": {
+        consts.MIGRATE_SNAPSHOT_REQUEST_ANNOTATION:
+            json.dumps({"plan": fp})}}})
+    state_path = migrate_agent.process_state_path(status.directory)
+    status.write("workload", {"passed": True})  # pre-existing barrier
+    with open(state_path, "w") as f:
+        json.dump({"step": 9, "rng_state": [4], "partition": "2x2",
+                   "blocked": []}, f)
+
+    assert migrate_agent.snapshot_once(client, "tpu-a", status,
+                                       now=lambda: 5.0) is True
+    loaded = drain.load_checkpoint(drain.checkpoint_path(status.directory))
+    assert loaded["step"] == 9 and loaded["transparent"] is True
+    assert ckpt.checkpoint_version(loaded) == 2
+    result = json.loads(client.get("v1", "Node", "tpu-a")["metadata"]
+                        ["annotations"]
+                        [consts.MIGRATE_SNAPSHOT_RESULT_ANNOTATION])
+    assert result["ok"] is True and result["step"] == 9
+    assert result["plan"] == fp
+    # the barrier records the snapshot, the verdict payload survives
+    info = status.read("workload")
+    assert info["migrate_snapshot"]["step"] == 9
+    assert info["passed"] is True
+    # idempotent: the answered request makes the agent stand down
+    assert migrate_agent.snapshot_once(client, "tpu-a", status) is False
+
+
+def test_snapshot_once_fails_without_process_state(tmp_path):
+    """No mirror file = a FAILED snapshot, published as such — the
+    operator falls back to the counted force-retile."""
+    client = FakeClient()
+    client.create(mk_node("tpu-a"))
+    status = StatusFiles(str(tmp_path / "status"))
+    client.patch("v1", "Node", "tpu-a", {"metadata": {"annotations": {
+        consts.MIGRATE_SNAPSHOT_REQUEST_ANNOTATION:
+            json.dumps({"plan": snapshot_fp()})}}})
+    assert migrate_agent.snapshot_once(client, "tpu-a", status) is False
+    result = json.loads(client.get("v1", "Node", "tpu-a")["metadata"]
+                        ["annotations"]
+                        [consts.MIGRATE_SNAPSHOT_RESULT_ANNOTATION])
+    assert result["ok"] is False
+
+
+def test_restore_once_lands_transferred_checkpoint(tmp_path, monkeypatch):
+    client = FakeClient()
+    client.create(mk_node("tpu-b"))
+    transfer = tmp_path / "transfer"
+    src_status = StatusFiles(str(transfer / "tpu-a"))
+    dst_status = StatusFiles(str(transfer / "tpu-b"))
+    monkeypatch.setenv(migrate_agent.TRANSFER_DIR_ENV, str(transfer))
+    fp = snapshot_fp()
+    ckpt.save_checkpoint_v2(
+        drain.checkpoint_path(src_status.directory), 21, rng_state=[7],
+        manifest=ckpt.build_manifest("2x2", []))
+    client.patch("v1", "Node", "tpu-b", {"metadata": {"annotations": {
+        consts.MIGRATION_INBOUND_ANNOTATION:
+            json.dumps({"plan": fp, "src": "tpu-a", "step": 21})}}})
+
+    assert migrate_agent.restore_once(client, "tpu-b", dst_status,
+                                      namespace=NS) is True
+    loaded = drain.load_checkpoint(
+        drain.checkpoint_path(dst_status.directory))
+    assert loaded["step"] == 21 and loaded["rng_state"] == [7]
+    assert loaded["migrated_from"] == "tpu-a"
+    result = json.loads(client.get("v1", "Node", "tpu-b")["metadata"]
+                        ["annotations"]
+                        [consts.MIGRATION_RESTORE_ANNOTATION])
+    assert result["ok"] is True and result["step"] == 21
+    # idempotent across agent restarts / operator replays
+    assert migrate_agent.restore_once(client, "tpu-b", dst_status,
+                                      namespace=NS) is False
+
+
+def test_restore_once_falls_back_to_inbound_minimum(tmp_path, monkeypatch):
+    """Source host gone, transfer unreadable: the inbound record itself
+    carries the committed step — restore from the operator-mediated
+    minimum rather than failing the tenant back to scratch."""
+    client = FakeClient()
+    client.create(mk_node("tpu-b"))
+    monkeypatch.delenv(migrate_agent.TRANSFER_DIR_ENV, raising=False)
+    dst_status = StatusFiles(str(tmp_path / "tpu-b"))
+    client.patch("v1", "Node", "tpu-b", {"metadata": {"annotations": {
+        consts.MIGRATION_INBOUND_ANNOTATION:
+            json.dumps({"plan": snapshot_fp(), "src": "tpu-a",
+                        "step": 13})}}})
+    assert migrate_agent.restore_once(client, "tpu-b", dst_status,
+                                      namespace=NS) is True
+    loaded = drain.load_checkpoint(
+        drain.checkpoint_path(dst_status.directory))
+    assert loaded["step"] == 13
+
+
+# -- the MigrationReconciler phase machine (tentpole c) -----------------------
+
+def setup_migration_cluster(client, migrate=None, drain_deadline_s=60,
+                            nodes=("tpu-a", "tpu-b")):
+    spec = {"enabled": True, "snapshotWaitS": 10, "restoreWaitS": 30}
+    spec.update(migrate or {})
+    client.create(new_cluster_policy(spec={
+        "migrate": spec, "health": {"drainDeadlineS": drain_deadline_s}}))
+    for name in nodes:
+        client.create(mk_node(name))
+
+
+def request_migration(client, src, dst=None, reason="test"):
+    req = {"reason": reason}
+    if dst:
+        req["dst"] = dst
+    client.patch("v1", "Node", src, {"metadata": {"annotations": {
+        consts.MIGRATE_REQUEST_ANNOTATION: json.dumps(req)}}})
+
+
+def stamp_ack(client, src, fp, step):
+    client.patch("v1", "Node", src, {"metadata": {"annotations": {
+        consts.DRAIN_ACK_ANNOTATION:
+            drain.ack_annotation_value({"plan": fp, "step": step})}}})
+
+
+def stamp_restore(client, dst, fp, step, ok=True, src="tpu-a"):
+    client.patch("v1", "Node", dst, {"metadata": {"annotations": {
+        consts.MIGRATION_RESTORE_ANNOTATION:
+            json.dumps({"plan": fp, "ok": ok, "step": step,
+                        "src": src})}}})
+
+
+def anns(client, name):
+    return (client.get("v1", "Node", name)["metadata"]
+            .get("annotations") or {})
+
+
+def test_cooperative_episode_drain_ack_to_done():
+    client = FakeClient()
+    clock = Clock()
+    setup_migration_cluster(client)
+    rec = MigrationReconciler(client, namespace=NS, now=clock)
+    request_migration(client, "tpu-a", dst="tpu-b")
+
+    rec.reconcile(Request(name="tpu-a"))
+    state = migration_state(client.get("v1", "Node", "tpu-a"))
+    assert state["phase"] == "draining"
+    fp = state["plan"]
+    assert fp == drain.plan_fingerprint("migrate:tpu-a->tpu-b", [])
+    plan = drain.node_plan(client.get("v1", "Node", "tpu-a"))
+    assert plan.fingerprint == fp and plan.reason == drain.REASON_MIGRATE
+    assert len(events_with_reason(client, "RetilePlanned")) == 1
+    assert rec.metrics.migrations_in_progress._value.get() == 1
+
+    # the workload acks at step 17; one sweep carries the episode through
+    # transfer (the inbound record lands on the DESTINATION)
+    stamp_ack(client, "tpu-a", fp, 17)
+    rec.reconcile(Request(name="tpu-a"))
+    state = migration_state(client.get("v1", "Node", "tpu-a"))
+    assert state["phase"] == "restoring" and state["step"] == 17
+    inbound = json.loads(
+        anns(client, "tpu-b")[consts.MIGRATION_INBOUND_ANNOTATION])
+    assert inbound == {"plan": fp, "src": "tpu-a", "step": 17}
+
+    # the destination's agent answers; the episode finalizes
+    stamp_restore(client, "tpu-b", fp, 17)
+    rec.reconcile(Request(name="tpu-a"))
+    state = migration_state(client.get("v1", "Node", "tpu-a"))
+    assert state["phase"] == "done" and state["step"] == 17
+    assert len(events_with_reason(client, "MigrationRestored")) == 1
+    assert len(events_with_reason(client, "MigrationCompleted")) == 1
+    # working annotations retired on BOTH nodes; the terminal record stays
+    src_anns = anns(client, "tpu-a")
+    assert consts.MIGRATE_REQUEST_ANNOTATION not in src_anns
+    assert consts.RETILE_PLAN_ANNOTATION not in src_anns
+    assert consts.DRAIN_ACK_ANNOTATION not in src_anns
+    assert consts.MIGRATION_INBOUND_ANNOTATION not in anns(client, "tpu-b")
+    assert rec.metrics.migrations_in_progress._value.get() == 0
+    assert rec.metrics.migrations_total.labels(
+        outcome="completed")._value.get() == 1
+
+    # replayed sweeps are no-ops: exactly-once announcements hold
+    rec.reconcile(Request(name="tpu-a"))
+    assert len(events_with_reason(client, "RetilePlanned")) == 1
+    assert len(events_with_reason(client, "MigrationCompleted")) == 1
+
+
+def test_deadline_expiry_takes_transparent_snapshot_path():
+    client = FakeClient()
+    clock = Clock()
+    setup_migration_cluster(client, drain_deadline_s=5)
+    rec = MigrationReconciler(client, namespace=NS, now=clock)
+    request_migration(client, "tpu-a", dst="tpu-b")
+    rec.reconcile(Request(name="tpu-a"))
+    fp = migration_state(client.get("v1", "Node", "tpu-a"))["plan"]
+
+    clock.t += 6.0  # the workload never acks: deadline expires
+    rec.reconcile(Request(name="tpu-a"))
+    state = migration_state(client.get("v1", "Node", "tpu-a"))
+    assert state["phase"] == "snapshotting"
+    snap_req = json.loads(
+        anns(client, "tpu-a")[consts.MIGRATE_SNAPSHOT_REQUEST_ANNOTATION])
+    assert snap_req["plan"] == fp
+    assert len(events_with_reason(client, "MigrationSnapshotRequested")) == 1
+
+    # the agent answers with a captured snapshot; transfer carries the
+    # manifest the dump produced
+    manifest = ckpt.build_manifest("2x2", [])
+    client.patch("v1", "Node", "tpu-a", {"metadata": {"annotations": {
+        consts.MIGRATE_SNAPSHOT_RESULT_ANNOTATION:
+            json.dumps({"plan": fp, "ok": True, "step": 4,
+                        "manifest": manifest})}}})
+    rec.reconcile(Request(name="tpu-a"))
+    state = migration_state(client.get("v1", "Node", "tpu-a"))
+    assert state["phase"] == "restoring" and state["step"] == 4
+    inbound = json.loads(
+        anns(client, "tpu-b")[consts.MIGRATION_INBOUND_ANNOTATION])
+    assert inbound["step"] == 4 and inbound["manifest"] == manifest
+    assert rec.metrics.migration_snapshots._value.get() == 1
+    assert len(events_with_reason(client, "TransparentSnapshotTaken")) == 1
+
+    stamp_restore(client, "tpu-b", fp, 4)
+    rec.reconcile(Request(name="tpu-a"))
+    assert migration_state(
+        client.get("v1", "Node", "tpu-a"))["phase"] == "done"
+
+
+def test_failed_snapshot_falls_back_to_counted_force_retile():
+    client = FakeClient()
+    clock = Clock()
+    setup_migration_cluster(client, drain_deadline_s=5)
+    rec = MigrationReconciler(client, namespace=NS, now=clock)
+    request_migration(client, "tpu-a", dst="tpu-b")
+    rec.reconcile(Request(name="tpu-a"))
+    fp = migration_state(client.get("v1", "Node", "tpu-a"))["plan"]
+    clock.t += 6.0
+    rec.reconcile(Request(name="tpu-a"))
+    client.patch("v1", "Node", "tpu-a", {"metadata": {"annotations": {
+        consts.MIGRATE_SNAPSHOT_RESULT_ANNOTATION:
+            json.dumps({"plan": fp, "ok": False,
+                        "error": "process state unreadable"})}}})
+    rec.reconcile(Request(name="tpu-a"))
+    state = migration_state(client.get("v1", "Node", "tpu-a"))
+    assert state["phase"] == "failed"
+    assert len(events_with_reason(client, "MigrationSnapshotFailed")) == 1
+    assert rec.metrics.migrations_total.labels(
+        outcome="failed")._value.get() == 1
+    # the drain plan annotation REMAINS: the ordinary deadline force
+    # path (counted in drain_deadline_missed) takes over from here
+    assert drain.node_plan(client.get("v1", "Node", "tpu-a")) is not None
+
+
+def test_snapshot_wait_zero_disables_the_snapshot_path():
+    client = FakeClient()
+    clock = Clock()
+    setup_migration_cluster(client, migrate={"snapshotWaitS": 0},
+                            drain_deadline_s=5)
+    rec = MigrationReconciler(client, namespace=NS, now=clock)
+    request_migration(client, "tpu-a", dst="tpu-b")
+    rec.reconcile(Request(name="tpu-a"))
+    clock.t += 6.0
+    rec.reconcile(Request(name="tpu-a"))
+    state = migration_state(client.get("v1", "Node", "tpu-a"))
+    assert state["phase"] == "failed"  # PR 7 behavior, explicitly chosen
+    assert not events_with_reason(client, "MigrationSnapshotRequested")
+
+
+def test_vanished_destination_retargets_with_state_intact():
+    client = FakeClient()
+    clock = Clock()
+    setup_migration_cluster(client, nodes=("tpu-a", "tpu-b", "tpu-c"))
+    rec = MigrationReconciler(client, namespace=NS, now=clock)
+    request_migration(client, "tpu-a", dst="tpu-b")
+    rec.reconcile(Request(name="tpu-a"))
+    fp = migration_state(client.get("v1", "Node", "tpu-a"))["plan"]
+    stamp_ack(client, "tpu-a", fp, 17)
+    rec.reconcile(Request(name="tpu-a"))
+    assert migration_state(
+        client.get("v1", "Node", "tpu-a"))["phase"] == "restoring"
+
+    # spot revocation takes the destination mid-restore
+    client.delete("v1", "Node", "tpu-b")
+    rec.reconcile(Request(name="tpu-a"))
+    state = migration_state(client.get("v1", "Node", "tpu-a"))
+    assert state["dst"] == "tpu-c" and state["phase"] == "restoring"
+    # the replayed transfer record carries the SAME committed step
+    inbound = json.loads(
+        anns(client, "tpu-c")[consts.MIGRATION_INBOUND_ANNOTATION])
+    assert inbound["step"] == 17 and inbound["plan"] == state["plan"]
+
+    stamp_restore(client, "tpu-c", state["plan"], 17)
+    rec.reconcile(Request(name="tpu-a"))
+    assert migration_state(
+        client.get("v1", "Node", "tpu-a"))["phase"] == "done"
+
+
+def test_request_ignored_when_migration_disabled():
+    client = FakeClient()
+    client.create(new_cluster_policy(spec={}))  # migrate.enabled=false
+    client.create(mk_node("tpu-a"))
+    client.create(mk_node("tpu-b"))
+    rec = MigrationReconciler(client, namespace=NS, now=Clock())
+    request_migration(client, "tpu-a", dst="tpu-b")
+    rec.reconcile(Request(name="tpu-a"))
+    assert migration_state(client.get("v1", "Node", "tpu-a")) is None
+    assert drain.node_plan(client.get("v1", "Node", "tpu-a")) is None
+
+
+def test_destination_pick_prefers_empty_uninvolved_nodes():
+    client = FakeClient()
+    clock = Clock()
+    setup_migration_cluster(client, nodes=("tpu-a", "tpu-b", "tpu-c"))
+    # tpu-b is already a destination of someone else's episode
+    client.patch("v1", "Node", "tpu-b", {"metadata": {"annotations": {
+        consts.MIGRATION_INBOUND_ANNOTATION:
+            json.dumps({"plan": "x", "src": "other", "step": 1})}}})
+    rec = MigrationReconciler(client, namespace=NS, now=clock)
+    request_migration(client, "tpu-a")  # no explicit dst
+    rec.reconcile(Request(name="tpu-a"))
+    assert migration_state(
+        client.get("v1", "Node", "tpu-a"))["dst"] == "tpu-c"
+
+
+def test_migrate_spec_defaults_are_opt_in():
+    policy = ClusterPolicy.from_obj(new_cluster_policy(spec={}))
+    assert policy.spec.migrate.is_enabled() is False
+    assert policy.spec.migrate.snapshot_wait_s == 30
+    assert policy.spec.migrate.restore_wait_s == 120
+    enabled = ClusterPolicy.from_obj(new_cluster_policy(
+        spec={"migrate": {"enabled": True, "snapshotWaitS": 0}}))
+    assert enabled.spec.migrate.is_enabled() is True
+    assert enabled.spec.migrate.snapshot_wait_s == 0
+
+
+# -- wiring: the autoscaler delegates scale-down (tentpole c) -----------------
+
+def setup_autoscale_migration(client, n=3):
+    client.create(new_cluster_policy(spec={
+        "autoscale": {"enabled": True, "scaleDownDelayS": 0,
+                      "cooldownS": 0, "minNodes": {"default": 1},
+                      "maxNodes": {"default": 8}},
+        "migrate": {"enabled": True},
+        "health": {"drainDeadlineS": 60}}))
+    for i in range(n):
+        client.create(mk_node(f"tpu-{i}"))
+
+
+def publish_snapshot(client, ts, backlog_chips):
+    client.patch("tpu.ai/v1", "ClusterPolicy", "cluster-policy",
+                 {"metadata": {"annotations": {
+                     consts.TRAFFIC_SNAPSHOT_ANNOTATION: json.dumps({
+                         "ts": ts, "queue_depth": 0,
+                         "backlog_chips": backlog_chips,
+                         "attainment": 1.0})}}})
+
+
+def migrate_requested_nodes(client):
+    return [n["metadata"]["name"] for n in client.list("v1", "Node")
+            if consts.MIGRATE_REQUEST_ANNOTATION
+            in (n["metadata"].get("annotations") or {})]
+
+
+def test_autoscaler_scale_down_delegates_to_migration():
+    client = FakeClient()
+    clock = Clock()
+    setup_autoscale_migration(client)
+    publish_snapshot(client, clock.t, backlog_chips=6.0)  # wants 2 of 3
+    rec = AutoscaleReconciler(client, namespace=NS, now=clock)
+    rec.reconcile(Request(name="cluster-policy"))
+
+    # no bare drain plan: the victim carries a migrate request instead
+    victims = migrate_requested_nodes(client)
+    assert len(victims) == 1
+    victim = victims[0]
+    req = json.loads(
+        anns(client, victim)[consts.MIGRATE_REQUEST_ANNOTATION])
+    assert req["reason"] == "scale-down"
+    assert drain.node_plan(client.get("v1", "Node", victim)) is None
+
+    # the migration runs (the MigrationReconciler would do this); the
+    # autoscaler polls its terminal phase, then removes the node
+    clock.t += 5.0
+    rec.reconcile(Request(name="cluster-policy"))
+    assert len(client.list("v1", "Node")) == 3  # still waiting
+    client.patch("v1", "Node", victim, {"metadata": {"annotations": {
+        consts.MIGRATION_STATE_ANNOTATION: json.dumps(
+            {"phase": "done", "src": victim, "dst": "tpu-9",
+             "plan": "fp", "step": 17, "seq": 5})}}})
+    clock.t += 5.0
+    rec.reconcile(Request(name="cluster-policy"))
+    names = [n["metadata"]["name"] for n in client.list("v1", "Node")]
+    assert victim not in names
+    assert rec.metrics.drain_deadline_missed._value.get() == 0
+    down = [e for e in events_with_reason(client, "AutoscaleDown")]
+    assert down and "migrated" in down[0].get("message", "")
+
+
+def test_autoscaler_counts_failed_migration_as_deadline_miss():
+    client = FakeClient()
+    clock = Clock()
+    setup_autoscale_migration(client)
+    publish_snapshot(client, clock.t, backlog_chips=6.0)
+    rec = AutoscaleReconciler(client, namespace=NS, now=clock)
+    rec.reconcile(Request(name="cluster-policy"))
+    victim = migrate_requested_nodes(client)[0]
+    client.patch("v1", "Node", victim, {"metadata": {"annotations": {
+        consts.MIGRATION_STATE_ANNOTATION: json.dumps(
+            {"phase": "failed", "src": victim, "dst": "tpu-9",
+             "plan": "fp", "seq": 3, "error": "snapshot failed"})}}})
+    clock.t += 5.0
+    rec.reconcile(Request(name="cluster-policy"))
+    names = [n["metadata"]["name"] for n in client.list("v1", "Node")]
+    assert victim not in names  # fail-safe force removal
+    assert rec.metrics.drain_deadline_missed._value.get() == 1
+
+
+# -- wiring: cfgtool MIGRATION column (satellite 3) ---------------------------
+
+def test_migration_cell_renders_episode_state():
+    cell = _migration_cell({consts.MIGRATION_STATE_ANNOTATION: json.dumps(
+        {"phase": "restoring", "src": "tpu-a", "dst": "tpu-b",
+         "at_risk": 3, "seq": 4})})
+    assert cell == "restoring tpu-a->tpu-b risk=3 seq=4"
+
+
+def test_migration_cell_omits_zero_risk():
+    cell = _migration_cell({consts.MIGRATION_STATE_ANNOTATION: json.dumps(
+        {"phase": "done", "src": "a", "dst": "b", "at_risk": 0,
+         "seq": 7})})
+    assert cell == "done a->b seq=7"
+
+
+def test_migration_cell_absent_and_corrupt():
+    assert _migration_cell({}) == "-"
+    assert _migration_cell(
+        {consts.MIGRATION_STATE_ANNOTATION: "{not json"}) == "corrupt"
+    assert _migration_cell(
+        {consts.MIGRATION_STATE_ANNOTATION: '"a string"'}) == "corrupt"

@@ -55,7 +55,7 @@ class LintConfig:
     docs_text: Optional[str] = None
     #: directory names that mark a file as part of a reconcile path
     reconcile_dirs: Tuple[str, ...] = ("controllers", "state", "upgrade",
-                                       "autoscale")
+                                       "autoscale", "migrate")
     #: directory names allowed to touch raw HTTP / RestClient
     client_dirs: Tuple[str, ...] = ("client",)
     #: composition roots additionally allowed to construct RestClient

@@ -411,6 +411,41 @@ class AutoscaleSpec(SpecBase):
 
 
 @dataclasses.dataclass
+class MigrateSpec(SpecBase):
+    """Cross-node workload migration (``tpu_operator/migrate``):
+    transparent checkpoint/restore in the CRIUgpu mold. When enabled, a
+    drain deadline that expires without a workload ack is answered with an
+    operator-driven snapshot request to the node's migrate agent (the
+    workload never participates) instead of a bare force-retile, and the
+    MigrationReconciler can move a tenant drain->transfer->restore onto
+    another node's slice with zero steps lost. Opt-in like the
+    autoscaler: cooperative-only fleets never pay for it."""
+
+    enabled: bool = spec_field(
+        False, doc="Run the MigrationReconciler (cross-node "
+                   "drain/transfer/restore episodes driven by the "
+                   "tpu.ai/migrate-request annotation) and let the "
+                   "autoscaler route scale-down through it.")
+    snapshot_wait_s: int = spec_field(
+        30, doc="Budget for the node's migrate agent to produce a "
+                "transparent snapshot after a drain deadline expires "
+                "without an ack; only when this window also closes empty "
+                "(or the agent reports failure) does the episode fall "
+                "back to the counted force-retile. 0 disables the "
+                "snapshot path (bare force-retile, PR 7 behavior).",
+        minimum=0, maximum=86400)
+    restore_wait_s: int = spec_field(
+        120, doc="Budget for the destination node's migrate agent to "
+                 "restore a transferred checkpoint before the episode "
+                 "is failed (and the TPUMigrationStuck alert fires).",
+        minimum=1, maximum=86400)
+    extra: Dict[str, Any] = spec_field(dict)
+
+    def is_enabled(self, default: bool = False) -> bool:
+        return default if self.enabled is None else bool(self.enabled)
+
+
+@dataclasses.dataclass
 class PSASpec(SpecBase):
     """Pod Security Admission (reference PSASpec,
     api/nvidia/v1/clusterpolicy_types.go:208-211;
@@ -510,6 +545,7 @@ class ClusterPolicySpec(SpecBase):
     psa: PSASpec = spec_field(PSASpec)
     health: HealthSpec = spec_field(HealthSpec)
     autoscale: AutoscaleSpec = spec_field(AutoscaleSpec)
+    migrate: MigrateSpec = spec_field(MigrateSpec)
     extra: Dict[str, Any] = spec_field(dict)
 
     def libtpu_dir(self) -> str:

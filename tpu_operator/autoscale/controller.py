@@ -50,6 +50,7 @@ from ..controllers.metrics import OperatorMetrics
 from ..controllers.predicates import filtered_node_mapper
 from ..controllers.runtime import Controller, Reconciler, Request, Result
 from ..health import drain as drain_protocol
+from ..migrate import controller as migrate_protocol
 from ..state.nodepool import get_node_pools
 from ..utils import deep_get
 from .engine import PoolDecision, PoolState, decide
@@ -272,6 +273,39 @@ class AutoscaleReconciler(Reconciler):
 
         preconditioned_patch(self.client, "v1", "Node", node_name, build)
 
+    def _request_migration(self, node_name: str) -> None:
+        payload = json.dumps(
+            {"reason": drain_protocol.REASON_SCALE_DOWN}, sort_keys=True)
+
+        def build(fresh: dict) -> Optional[dict]:
+            if deep_get(fresh, "metadata", "annotations",
+                        consts.MIGRATE_REQUEST_ANNOTATION) == payload:
+                return None
+            return {"metadata": {"annotations": {
+                consts.MIGRATE_REQUEST_ANNOTATION: payload}}}
+
+        preconditioned_patch(self.client, "v1", "Node", node_name, build)
+
+    def _migration_verdict(self, node: dict) -> Optional[bool]:
+        """Terminal outcome of a delegated migration episode: True once
+        the tenant restored on its destination, False when the episode
+        failed (fall back to a counted force-removal), None while still
+        in flight. Crash-repairs the request annotation the same way
+        _publish_plan repairs a lost plan."""
+        state = migrate_protocol.migration_state(node)
+        if state is None:
+            if migrate_protocol.migrate_request(node) is None:
+                # crashed after recording intent but before the request
+                # landed: repair the missing half
+                self._request_migration(node["metadata"]["name"])
+            return None
+        phase = state.get("phase")
+        if phase == migrate_protocol.PHASE_DONE:
+            return True
+        if phase == migrate_protocol.PHASE_FAILED:
+            return False
+        return None
+
     def _begin_scale_down(self, spec: AutoscaleSpec, policy: ClusterPolicy,
                           pool: str, victim: dict,
                           states: Dict[str, PoolState], now: float) -> None:
@@ -280,13 +314,25 @@ class AutoscaleReconciler(Reconciler):
             f"scale-down:{name}", [])
         deadline = now + float(policy.spec.health.drain_deadline_s)
         state = states[pool]
+        migrate = policy.spec.migrate.is_enabled()
         state.resize = {"node": name, "fingerprint": fingerprint,
                         "direction": "down",
                         "deadline": round(deadline, 3)}
+        if migrate:
+            state.resize["migrate"] = True
         # durable intent FIRST: the state record is what a restarted
         # operator resumes from; the plan annotation and Event repair
         # idempotently behind it
         self._persist_states(policy, states)
+        if migrate:
+            # scale-down rides the migration subsystem: the migration
+            # reconciler drains the tenant and restores it on another
+            # node's slice before we remove this one; it owns the plan
+            # annotation and the RetilePlanned Event for the episode
+            self._request_migration(name)
+            log.info("autoscale: requested migration-backed scale-down "
+                     "of %s (pool %s)", name, pool)
+            return
         self._publish_plan(name, fingerprint, deadline)
         events.record_once(
             self.client, self.namespace, victim, events.NORMAL,
@@ -315,31 +361,39 @@ class AutoscaleReconciler(Reconciler):
             state.cooldown_until = now + float(spec.cooldown_s)
             self._persist_states(policy, states)
             return None
-        plan = drain_protocol.node_plan(node)
-        deadline = float(rec.get("deadline", now))
-        if plan is None or plan.fingerprint != rec.get("fingerprint"):
-            # crashed after recording intent but before the plan landed:
-            # repair the missing half
-            self._publish_plan(node["metadata"]["name"],
-                               rec["fingerprint"], deadline)
-            plan = drain_protocol.RetilePlan(
-                fingerprint=rec["fingerprint"], deadline=deadline,
-                reason=drain_protocol.REASON_SCALE_DOWN)
-        # unconditional: content-addressed on the fingerprint, so a crash
-        # between plan publish and announcement repairs the lost Event,
-        # while an already-landed announcement collides (AlreadyExists)
-        # and stands down — exactly-once either way
-        events.record_once(
-            self.client, self.namespace, node, events.NORMAL,
-            REASON_PLANNED,
-            f"autoscale scale-down of pool {pool}: drain planned "
-            f"for {node['metadata']['name']} (plan "
-            f"{rec['fingerprint']})",
-            token=rec["fingerprint"])
-        acked = (drain_protocol.node_acked_plan(node)
-                 == rec.get("fingerprint"))
-        if not acked and not plan.expired(now):
-            return max(0.25, plan.deadline - now + 0.1)
+        if rec.get("migrate"):
+            verdict = self._migration_verdict(node)
+            if verdict is None:
+                return 2.0
+            acked = verdict
+            detail = "migrated" if acked else "migration failed"
+        else:
+            plan = drain_protocol.node_plan(node)
+            deadline = float(rec.get("deadline", now))
+            if plan is None or plan.fingerprint != rec.get("fingerprint"):
+                # crashed after recording intent but before the plan
+                # landed: repair the missing half
+                self._publish_plan(node["metadata"]["name"],
+                                   rec["fingerprint"], deadline)
+                plan = drain_protocol.RetilePlan(
+                    fingerprint=rec["fingerprint"], deadline=deadline,
+                    reason=drain_protocol.REASON_SCALE_DOWN)
+            # unconditional: content-addressed on the fingerprint, so a
+            # crash between plan publish and announcement repairs the
+            # lost Event, while an already-landed announcement collides
+            # (AlreadyExists) and stands down — exactly-once either way
+            events.record_once(
+                self.client, self.namespace, node, events.NORMAL,
+                REASON_PLANNED,
+                f"autoscale scale-down of pool {pool}: drain planned "
+                f"for {node['metadata']['name']} (plan "
+                f"{rec['fingerprint']})",
+                token=rec["fingerprint"])
+            acked = (drain_protocol.node_acked_plan(node)
+                     == rec.get("fingerprint"))
+            if not acked and not plan.expired(now):
+                return max(0.25, plan.deadline - now + 0.1)
+            detail = "acked" if acked else "deadline expired"
         if not acked:
             self.metrics.drain_deadline_missed.inc()
         name = node["metadata"]["name"]
@@ -365,9 +419,9 @@ class AutoscaleReconciler(Reconciler):
         events.record(self.client, self.namespace, policy.obj,
                       events.NORMAL, REASON_SCALED_DOWN,
                       f"pool {pool}: drained and removed {name} "
-                      f"({'acked' if acked else 'deadline expired'})")
+                      f"({detail})")
         log.info("autoscale: completed scale-down of %s (pool %s, %s)",
-                 name, pool, "acked" if acked else "deadline expired")
+                 name, pool, detail)
         return None
 
     def _scale_up(self, spec: AutoscaleSpec, policy: ClusterPolicy,

@@ -443,6 +443,35 @@ def _autoscale_cells(policy_obj, tpu_nodes, now=None) -> dict:
     return cells
 
 
+def _migration_cell(annotations: dict) -> str:
+    """MIGRATION column: the episode's phase with src→dst, the steps at
+    risk, and the durable-state seq — read from the same
+    ``tpu.ai/migration-state`` record the controller resumes from, so the
+    table shows exactly where the episode a TPUMigrationStuck alert fired
+    on stands (and what the next sweep will act on)."""
+    import json
+
+    from .. import consts
+
+    raw = annotations.get(consts.MIGRATION_STATE_ANNOTATION)
+    if not raw:
+        return "-"
+    try:
+        state = json.loads(raw)
+    except ValueError:
+        state = None
+    if not isinstance(state, dict):
+        return "corrupt"
+    cell = (f"{state.get('phase', '?')} "
+            f"{state.get('src', '?')}->{state.get('dst', '?')}")
+    at_risk = state.get("at_risk")
+    if at_risk:
+        cell += f" risk={at_risk}"
+    if state.get("seq") is not None:
+        cell += f" seq={state['seq']}"
+    return cell
+
+
 def _status(client, namespace, out) -> int:
     from .. import consts
     from ..utils import deep_get
@@ -477,7 +506,7 @@ def _status(client, namespace, out) -> int:
     autoscale_cells = _autoscale_cells(autoscale_policy, tpu_nodes)
     print("\nNODE            CAPACITY  HEALTHY  HEALTH-STATE     "
           "UPGRADE-STATE    SLICE-PARTITION   SERVING             "
-          "AUTOSCALE", file=out)
+          "AUTOSCALE            MIGRATION", file=out)
     for node in tpu_nodes:
         labels = node.get("metadata", {}).get("labels", {}) or {}
         name = node["metadata"]["name"]
@@ -508,11 +537,14 @@ def _status(client, namespace, out) -> int:
             partition = f"{slice_cfg or '<none>'}={slice_state or '?'}"
         else:
             partition = "-"
-        serving = _serving_cell(labels, node.get("metadata", {})
-                                .get("annotations", {}) or {})
+        annotations = (node.get("metadata", {})
+                       .get("annotations", {}) or {})
+        serving = _serving_cell(labels, annotations)
         autoscale = autoscale_cells.get(name, "-")
+        migration = _migration_cell(annotations)
         print(f"{name:<15} {capacity:<9} {healthy:<8} {health_state:<16} "
-              f"{upgrade:<16} {partition:<17} {serving:<19} {autoscale}",
+              f"{upgrade:<16} {partition:<17} {serving:<19} "
+              f"{autoscale:<20} {migration}",
               file=out)
 
     print("\nDAEMONSET                 DESIRED  AVAILABLE  UPDATED", file=out)

@@ -141,6 +141,37 @@ AUTOSCALE_MANAGED_LABEL = "tpu.ai/autoscale.managed"
 #: kubelet simulator / chaos layer can target them without reading the CR.
 PREEMPTIBLE_POOL_LABEL = "tpu.ai/preemptible"
 
+# -- cross-node migration (transparent checkpoint/restore) --------------------
+#: asks the MigrationReconciler to move a node's tenant elsewhere (JSON:
+#: reason "scale-down" | "revocation" | "manual", optional pool, optional
+#: dst). Stamped by the autoscaler's scale-down path or by an admin
+#: (`kubectl annotate` — docs/operations.md migration runbook).
+MIGRATE_REQUEST_ANNOTATION = "tpu.ai/migrate-request"
+#: the migration episode's crash-durable state record on the SOURCE node
+#: (JSON: phase, src, dst, plan fingerprint, step, at_risk, seq). Written
+#: fenced + preconditioned BEFORE every actuation, so a mid-migration
+#: operator kill resumes the episode exactly once from cluster state alone.
+MIGRATION_STATE_ANNOTATION = "tpu.ai/migration-state"
+#: operator -> migrate agent: take a transparent snapshot of this node's
+#: workload (JSON: plan fingerprint, deadline). The CRIU-style path for
+#: workloads that never ack a drain plan.
+MIGRATE_SNAPSHOT_REQUEST_ANNOTATION = "tpu.ai/migrate-snapshot-request"
+#: migrate agent -> operator: snapshot outcome (JSON: plan, ok, step,
+#: manifest | error). Same annotation-mirrored discipline as drain acks.
+MIGRATE_SNAPSHOT_RESULT_ANNOTATION = "tpu.ai/migrate-snapshot-result"
+#: operator -> DESTINATION node's migrate agent: restore intent (JSON:
+#: plan, src, step, manifest, seq). Durable transfer record — the restore
+#: side of the episode survives the source node vanishing (revocation).
+MIGRATION_INBOUND_ANNOTATION = "tpu.ai/migration-inbound"
+#: destination migrate agent -> operator: restore outcome (JSON: plan, ok,
+#: step | error)
+MIGRATION_RESTORE_ANNOTATION = "tpu.ai/migration-restore"
+#: host-path file (under the validation status dir) the simulated training
+#: job continually mirrors its live in-memory state into — the stand-in
+#: for process memory that a CRIU-style dump reads without the workload's
+#: cooperation (CRIUgpu, arXiv 2502.16631)
+MIGRATE_PROCESS_STATE_FILE = "process-state.json"
+
 # -- leader fencing ------------------------------------------------------------
 #: monotonic leader epoch on the election Lease (metadata.annotations).
 #: Bumped on every acquisition (create or takeover), never on renewal; the

@@ -347,14 +347,16 @@ class ClusterPolicyReconciler(Reconciler):
 
         if not policy.spec.health.enabled:
             machines = [HealthStateMachine(self.client, self.namespace,
-                                           policy.spec.health)]
+                                           policy.spec.health,
+                                           migrate=policy.spec.migrate)]
             machines[0].clear_all(nodes)
             counts = HealthCounts(healthy=len(nodes))
         else:
             shards = shard_by_pools(nodes, pools if pools is not None
                                     else get_node_pools(nodes))
             machines = [HealthStateMachine(self.client, self.namespace,
-                                           policy.spec.health)
+                                           policy.spec.health,
+                                           migrate=policy.spec.migrate)
                         for _ in shards]
             with tracing.phase_span("health-sweep") as sp:
                 shard_counts = self._pool_parallel(
@@ -369,10 +371,13 @@ class ClusterPolicyReconciler(Reconciler):
             self.metrics.node_health_state.labels(state=state).set(value)
         attempts_fired = sum(m.attempts_fired for m in machines)
         deadline_misses = sum(m.deadline_misses for m in machines)
+        snapshots_taken = sum(m.snapshots_taken for m in machines)
         if attempts_fired:
             self.metrics.remediation_attempts.inc(attempts_fired)
         if deadline_misses:
             self.metrics.drain_deadline_missed.inc(deadline_misses)
+        if snapshots_taken:
+            self.metrics.migration_snapshots.inc(snapshots_taken)
         self.metrics.drains_in_progress.set(
             sum(m.plans_pending for m in machines))
 

@@ -219,6 +219,12 @@ class OperatorApp:
             client, namespace=namespace, metrics=self.metrics)
         self.autoscale_controller = self.manager.add(
             setup_autoscale_controller(client, self.autoscale_reconciler))
+        from ..migrate import MigrationReconciler, setup_migration_controller
+
+        self.migration_reconciler = MigrationReconciler(
+            client, namespace=namespace, metrics=self.metrics)
+        self.migration_controller = self.manager.add(
+            setup_migration_controller(client, self.migration_reconciler))
         for controller in self.manager.controllers:
             controller.instrument(self.metrics, self.tracer)
         # rest_client_requests_total rides the innermost RestClient (the

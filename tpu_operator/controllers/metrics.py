@@ -125,6 +125,32 @@ class OperatorMetrics:
             "are saturating at maxNodes or awaiting joins)",
             registry=self.registry)
 
+        # cross-node migration (migrate.MigrationReconciler + agents)
+        self.migrations_total = Counter(
+            "tpu_operator_migrations_total",
+            "Cross-node migration episodes reaching a terminal phase, by "
+            "outcome (completed = tenant restored on the destination with "
+            "zero steps lost; failed = fell back to the counted "
+            "force-retile path)", ["outcome"], registry=self.registry)
+        self.migrations_in_progress = Gauge(
+            "tpu_operator_migrations_in_progress",
+            "Migration episodes currently in a non-terminal phase "
+            "(draining/snapshotting/transferring/restoring)",
+            registry=self.registry)
+        self.migration_snapshots = Counter(
+            "tpu_operator_migration_snapshots_total",
+            "Operator-driven transparent snapshots taken after a drain "
+            "deadline expired without a workload ack (the CRIU-style "
+            "path that replaces a bare force-retile)",
+            registry=self.registry)
+        self.checkpoint_corrupt = Counter(
+            "tpu_operator_checkpoint_corrupt_total",
+            "Drain checkpoints that existed but could not be loaded "
+            "(torn/truncated/non-dict payload) — each one is silent "
+            "restart-from-scratch unless a migration restore supersedes "
+            "it; a CheckpointCorrupt Event carries the detail",
+            registry=self.registry)
+
         # fleet join profiler (joinprofile.JoinProfiler feeds these from
         # the stitched operator+node join traces)
         self.join_phase_seconds = Histogram(

@@ -45,6 +45,9 @@ REASON_REMEDIATE = "remediate"
 #: the autoscaler surrendering a node: same protocol (plan -> ack/deadline
 #: -> act), but the act is node removal, so workloads re-place off-node
 REASON_SCALE_DOWN = "scale-down"
+#: a cross-node migration episode (tpu_operator/migrate): plan -> ack or
+#: transparent snapshot -> transfer -> restore on the destination slice
+REASON_MIGRATE = "migrate"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -180,15 +183,35 @@ def save_checkpoint(path: str, step: int, rng_state=None,
     return path
 
 
-def load_checkpoint(path: str) -> Optional[dict]:
+def load_checkpoint(path: str, on_corrupt=None) -> Optional[dict]:
     """The checkpoint payload, or None for absent/corrupt — a corrupt
-    checkpoint means restart-from-scratch (PR 5 behavior), never a crash."""
+    checkpoint means restart-from-scratch (PR 5 behavior), never a crash.
+
+    ``on_corrupt(kind, raw)`` fires when the file EXISTS but the payload is
+    unusable (kind: "torn" | "non-dict" | "missing-step"; raw: the bytes
+    read) — absent files are a normal first boot, corrupt ones are silent
+    data loss that migrate.checkpoint.corrupt_reporter() turns into a
+    counter bump plus a content-addressed CheckpointCorrupt Event."""
     try:
         with open(path) as f:
-            data = json.load(f)
-    except (FileNotFoundError, json.JSONDecodeError, OSError):
+            raw = f.read()
+    except (FileNotFoundError, OSError):
         return None
-    return data if isinstance(data, dict) and "step" in data else None
+    try:
+        data = json.loads(raw)
+    except json.JSONDecodeError:
+        if on_corrupt is not None:
+            on_corrupt("torn", raw)
+        return None
+    if not isinstance(data, dict):
+        if on_corrupt is not None:
+            on_corrupt("non-dict", raw)
+        return None
+    if "step" not in data:
+        if on_corrupt is not None:
+            on_corrupt("missing-step", raw)
+        return None
+    return data
 
 
 # -- agent-side ack hook ------------------------------------------------------

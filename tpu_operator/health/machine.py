@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import calendar
 import dataclasses
+import json
 import logging
 import time
 from typing import Dict, List, Optional
@@ -113,12 +114,16 @@ class HealthCounts:
 
 class HealthStateMachine:
     def __init__(self, client: Client, namespace: str, policy=None,
-                 now=time.time):
+                 now=time.time, migrate=None):
         from ..api.clusterpolicy import HealthSpec
 
         self.client = client
         self.namespace = namespace
         self.policy = policy or HealthSpec()
+        #: MigrateSpec (or None): when enabled with snapshotWaitS > 0, an
+        #: expired drain deadline requests a transparent snapshot through
+        #: the node's migrate agent before any counted force-retile
+        self.migrate = migrate
         self._now = now  # injectable clock for budget/flap tests
         #: remediation actions fired THIS sweep — the reconciler adds this
         #: to the tpu_operator_remediation_attempts_total counter
@@ -130,6 +135,9 @@ class HealthStateMachine:
         #: nodes currently inside an open drain window (plan published,
         #: no ack yet) — feeds the tpu_operator_drains_in_progress gauge
         self.plans_pending = 0
+        #: transparent snapshots that replaced a force-retile THIS sweep —
+        #: feeds tpu_operator_migration_snapshots_total
+        self.snapshots_taken = 0
 
     # -- cluster inspection ---------------------------------------------------
     def _pods_on(self, node_name: str, component: str) -> List[dict]:
@@ -217,6 +225,8 @@ class HealthStateMachine:
             ann_patch[consts.HEALTH_FLAP_STICKY_ANNOTATION] = None
             ann_patch[consts.RETILE_PLAN_ANNOTATION] = None
             ann_patch[consts.DRAIN_ACK_ANNOTATION] = None
+            ann_patch[consts.MIGRATE_SNAPSHOT_REQUEST_ANNOTATION] = None
+            ann_patch[consts.MIGRATE_SNAPSHOT_RESULT_ANNOTATION] = None
         ann_patch.update(extra_annotations or {})
         declined = []
 
@@ -446,14 +456,111 @@ class HealthStateMachine:
         if drain.node_acked_plan(node) == fingerprint:
             return True
         if plan.expired(self._now()):
-            self.deadline_misses += 1
-            self._event(node, events.WARNING, "RetileDeadlineExpired",
-                        f"{name}: drain deadline passed without a workload "
-                        f"ack for plan {fingerprint}; force-proceeding",
-                        token=fingerprint)
-            return True
+            verdict = self._snapshot_gate(node, fingerprint)
+            if verdict is not None:
+                return verdict
+            # snapshot window still open: the node keeps its quarantine
+            self.plans_pending += 1
+            return False
         self.plans_pending += 1
         return False
+
+    def _snapshot_wait_s(self) -> float:
+        if self.migrate is None or not self.migrate.is_enabled():
+            return 0.0
+        return float(getattr(self.migrate, "snapshot_wait_s", 0) or 0)
+
+    def _force_expired(self, node: dict, fingerprint: str,
+                       detail: str) -> bool:
+        """Today's counted force-retile — the fallback every snapshot
+        failure degrades to (fail-safe: the machine is never wedged)."""
+        name = node["metadata"]["name"]
+        self.deadline_misses += 1
+        self._event(node, events.WARNING, "RetileDeadlineExpired",
+                    f"{name}: {detail} for plan {fingerprint}; "
+                    f"force-proceeding", token=fingerprint)
+        return True
+
+    def _snapshot_gate(self, node: dict, fingerprint: str
+                       ) -> Optional[bool]:
+        """The transparent-snapshot path on an expired drain deadline
+        (CRIUgpu, arXiv 2502.16631): instead of a bare force-retile, ask
+        the node's migrate agent for an operator-driven snapshot the
+        workload never participates in, and only fall back to the counted
+        force when the snapshot itself fails or times out. Returns True
+        to proceed (snapshot landed, or counted force), None while the
+        snapshot window is open. Same write-ahead discipline as the plan:
+        the request annotation is the durable intent, the Event its
+        announcement, and everything lives on the node so a restarted
+        operator resumes without re-requesting."""
+        wait = self._snapshot_wait_s()
+        if wait <= 0:
+            return self._force_expired(
+                node, fingerprint,
+                "drain deadline passed without a workload ack")
+        name = node["metadata"]["name"]
+        raw = deep_get(node, "metadata", "annotations",
+                       consts.MIGRATE_SNAPSHOT_REQUEST_ANNOTATION)
+        request = None
+        if raw:
+            try:
+                request = json.loads(raw)
+            except ValueError:
+                request = None
+        if (not isinstance(request, dict)
+                or request.get("plan") != fingerprint):
+            payload = json.dumps(
+                {"plan": fingerprint,
+                 "deadline": round(self._now() + wait, 3)},
+                sort_keys=True)
+            self._annotate(node,
+                           consts.MIGRATE_SNAPSHOT_REQUEST_ANNOTATION,
+                           payload)
+            self._event(node, events.NORMAL, "MigrationSnapshotRequested",
+                        f"{name}: drain deadline passed without a "
+                        f"workload ack for plan {fingerprint}; requesting "
+                        f"a transparent snapshot before any force-retile",
+                        token=fingerprint)
+            return None
+        if not self._event_exists(node, "MigrationSnapshotRequested",
+                                  fingerprint):
+            # crash repair: annotation landed, announcement lost
+            self._event(node, events.NORMAL, "MigrationSnapshotRequested",
+                        f"{name}: drain deadline passed without a "
+                        f"workload ack for plan {fingerprint}; requesting "
+                        f"a transparent snapshot before any force-retile",
+                        token=fingerprint)
+        raw = deep_get(node, "metadata", "annotations",
+                       consts.MIGRATE_SNAPSHOT_RESULT_ANNOTATION)
+        result = None
+        if raw:
+            try:
+                result = json.loads(raw)
+            except ValueError:
+                result = None
+        if isinstance(result, dict) and result.get("plan") == fingerprint:
+            if result.get("ok"):
+                self.snapshots_taken += 1
+                self._event(node, events.NORMAL, "TransparentSnapshotTaken",
+                            f"{name}: transparent snapshot captured at "
+                            f"step {result.get('step')} for plan "
+                            f"{fingerprint}; proceeding with a restorable "
+                            f"checkpoint, no steps lost",
+                            token=fingerprint)
+                return True
+            return self._force_expired(
+                node, fingerprint,
+                f"transparent snapshot failed "
+                f"({result.get('error', 'unknown')})")
+        try:
+            snap_deadline = float(request.get("deadline", 0) or 0)
+        except (TypeError, ValueError):
+            snap_deadline = 0.0
+        if self._now() >= snap_deadline:
+            return self._force_expired(
+                node, fingerprint,
+                "transparent snapshot never materialized")
+        return None
 
     # -- the sweep ------------------------------------------------------------
     def process(self, nodes: List[dict]) -> HealthCounts:
@@ -493,7 +600,9 @@ class HealthStateMachine:
                                      consts.HEALTH_FLAP_STICKY_ANNOTATION,
                                      consts.HEALTH_FAILED_TEMPLATE_ANNOTATION,
                                      consts.HEALTH_FLAP_HISTORY_ANNOTATION,
-                                     consts.RETILE_PLAN_ANNOTATION)
+                                     consts.RETILE_PLAN_ANNOTATION,
+                                     consts.MIGRATE_SNAPSHOT_REQUEST_ANNOTATION,
+                                     consts.MIGRATE_SNAPSHOT_RESULT_ANNOTATION)
                          if k in anns]
             if leftovers and (consts.HEALTH_FLAP_STICKY_ANNOTATION in anns
                               or consts.HEALTH_FAILED_TEMPLATE_ANNOTATION in anns):
@@ -710,7 +819,9 @@ class HealthStateMachine:
                 # plan is never cleared MID-episode — a partitioner still
                 # waiting on it would otherwise wedge pending forever)
                 consts.RETILE_PLAN_ANNOTATION: None,
-                consts.DRAIN_ACK_ANNOTATION: None}):
+                consts.DRAIN_ACK_ANNOTATION: None,
+                consts.MIGRATE_SNAPSHOT_REQUEST_ANNOTATION: None,
+                consts.MIGRATE_SNAPSHOT_RESULT_ANNOTATION: None}):
             return node_health_state(node)
         self._event(node, events.NORMAL, "NodeHealthRecovered",
                     f"{name}: workload barrier passing again; restoring "
@@ -730,7 +841,9 @@ class HealthStateMachine:
                 consts.HEALTH_FLAP_STICKY_ANNOTATION,
                 consts.HEALTH_FAILED_TEMPLATE_ANNOTATION,
                 consts.RETILE_PLAN_ANNOTATION,
-                consts.DRAIN_ACK_ANNOTATION))
+                consts.DRAIN_ACK_ANNOTATION,
+                consts.MIGRATE_SNAPSHOT_REQUEST_ANNOTATION,
+                consts.MIGRATE_SNAPSHOT_RESULT_ANNOTATION))
             if node_health_state(node) == HEALTHY and not has_ann:
                 continue
             if self.policy.cordon_on_quarantine:

@@ -1,0 +1,36 @@
+"""Cross-node workload migration: transparent checkpoint/restore (ROADMAP #2).
+
+CRIUgpu (arXiv 2502.16631) shows that GPU/TPU training jobs can be
+checkpointed transparently — without the workload's cooperation — and
+restored elsewhere with zero lost steps. This package is that capability
+for the operator's fleet:
+
+- ``checkpoint``: the versioned drain-checkpoint schema (v2 adds
+  optimizer-state pointers and a sharded-array manifest keyed by the
+  layout fingerprint) plus the corrupt-checkpoint reporter.
+- ``agent``: the node-side migrate agent — takes CRIU-style snapshots on
+  operator request and restores transferred checkpoints on destination
+  nodes, with the same host-path + barrier discipline as drain acks.
+- ``controller``: the MigrationReconciler — drain node A, transfer the
+  manifest, restore the tenant on node B's slice, all durable state in
+  preconditioned node annotations so a mid-migration operator kill
+  resumes exactly once.
+"""
+
+from .checkpoint import (CHECKPOINT_VERSION, build_manifest,
+                         checkpoint_version, corrupt_reporter,
+                         remap_manifest, save_checkpoint_v2)
+from .controller import (MigrationReconciler, migration_state,
+                         setup_migration_controller)
+
+__all__ = [
+    "CHECKPOINT_VERSION",
+    "MigrationReconciler",
+    "build_manifest",
+    "checkpoint_version",
+    "corrupt_reporter",
+    "migration_state",
+    "remap_manifest",
+    "save_checkpoint_v2",
+    "setup_migration_controller",
+]

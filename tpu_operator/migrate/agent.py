@@ -1,0 +1,240 @@
+"""The node-side migrate agent: transparent snapshot + restore (tentpole b).
+
+Two verbs, both driven by node annotations and answered through the same
+host-path + barrier discipline as drain acks:
+
+- **snapshot**: the operator stamps ``tpu.ai/migrate-snapshot-request``
+  when a drain deadline expired without an ack. The agent dumps the
+  workload's live state CRIU-style — reading the process-state mirror
+  file the training harness maintains (the stand-in for process memory;
+  the workload itself never participates) — writes a restorable v2
+  checkpoint to the drain-checkpoint host path, stamps a
+  ``migrate_snapshot`` record into the workload barrier, and publishes
+  the outcome on ``tpu.ai/migrate-snapshot-result``.
+- **restore**: the operator stamps ``tpu.ai/migration-inbound`` on the
+  DESTINATION node. The agent fetches the transferred checkpoint, re-maps
+  its sharded-array manifest onto the local layout via the partitioner's
+  incremental re-tile, writes it to the local checkpoint path (so the
+  resumed tenant loads it like any drain checkpoint), stamps a
+  ``migrate_restore`` barrier record, and answers on
+  ``tpu.ai/migration-restore``.
+
+Both verbs are idempotent: a result annotation that already covers the
+requested plan fingerprint makes the agent stand down, so operator
+crash-replays and agent restarts never double-snapshot or double-restore.
+
+Runs as a kubelet-simulator double in tests and as the real validator CLI
+component (``tpuop-validator -c migrate-agent``) on nodes.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Callable, Optional
+
+from .. import consts
+from ..client.errors import BreakerOpenError
+from ..client.preconditions import preconditioned_patch
+from ..health import drain
+from ..utils import deep_get
+from . import checkpoint as ckpt_schema
+
+log = logging.getLogger(__name__)
+
+#: overrides where the CRIU-style dump reads live process state from
+#: (defaults to <status dir>/process-state.json)
+PROCESS_STATE_ENV = "TPU_MIGRATE_PROCESS_STATE"
+#: directory the default restore fetch pulls transferred checkpoints from:
+#: <dir>/<src node>/drain-checkpoint.json (the sim's object-store stand-in)
+TRANSFER_DIR_ENV = "TPU_MIGRATE_TRANSFER_DIR"
+
+
+def process_state_path(status_dir: str) -> str:
+    return os.path.join(status_dir, consts.MIGRATE_PROCESS_STATE_FILE)
+
+
+def read_process_state(path: str) -> Optional[dict]:
+    """The live process-state mirror (step, rng_state, optional layout) —
+    what a CRIU dump would lift out of process memory. None for
+    absent/corrupt: that is a FAILED snapshot, and the operator falls
+    back to the counted force-retile."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError, OSError):
+        return None
+    return data if isinstance(data, dict) and "step" in data else None
+
+
+def _parse_annotation(node: dict, key: str) -> Optional[dict]:
+    raw = deep_get(node, "metadata", "annotations", key)
+    if not raw:
+        return None
+    try:
+        data = json.loads(raw)
+    except ValueError:
+        return None
+    return data if isinstance(data, dict) else None
+
+
+def _publish(client, node_name: str, key: str, payload: dict) -> None:
+    value = ckpt_schema.dumps_compact(payload)
+
+    def build(fresh: dict) -> Optional[dict]:
+        if deep_get(fresh, "metadata", "annotations", key) == value:
+            return None
+        return {"metadata": {"annotations": {key: value}}}
+
+    preconditioned_patch(client, "v1", "Node", node_name, build)
+
+
+def _stamp_barrier(status, key: str, record: dict) -> None:
+    """Fold a migration record into the workload barrier, preserving the
+    verdict payload (same discipline as write_drain_ack)."""
+    info = status.read("workload") or {}
+    details = {k: v for k, v in info.items()
+               if k not in ("component", "timestamp", "host")}
+    details[key] = record
+    status.write("workload", details)
+
+
+def snapshot_once(client, node_name: str, status,
+                  dump: Optional[Callable[[], Optional[dict]]] = None,
+                  now=time.time) -> bool:
+    """One snapshot pass: if the node carries a snapshot request this
+    agent has not answered, take the transparent dump and publish the
+    outcome. Returns True when a restorable checkpoint was produced."""
+    try:
+        node = client.get("v1", "Node", node_name)
+    except BreakerOpenError:
+        raise  # degraded mode: the caller's loop backs off, not a failure
+    except Exception as e:  # transient apiserver trouble: retry next pass
+        log.debug("migrate agent: node read failed (%s)", e)
+        return False
+    request = _parse_annotation(
+        node, consts.MIGRATE_SNAPSHOT_REQUEST_ANNOTATION)
+    if not request or not request.get("plan"):
+        return False
+    plan = str(request["plan"])
+    result = _parse_annotation(
+        node, consts.MIGRATE_SNAPSHOT_RESULT_ANNOTATION)
+    if result and result.get("plan") == plan:
+        return False  # already answered this request
+    if dump is not None:
+        state = dump()
+    else:
+        path = os.environ.get(PROCESS_STATE_ENV) or process_state_path(
+            status.directory)
+        state = read_process_state(path)
+    if state is None or "step" not in state:
+        log.warning("migrate agent: snapshot of %s failed (no process "
+                    "state)", node_name)
+        _publish(client, node_name,
+                 consts.MIGRATE_SNAPSHOT_RESULT_ANNOTATION,
+                 {"plan": plan, "ok": False,
+                  "error": "process state unreadable"})
+        return False
+    step = int(state["step"])
+    manifest = state.get("manifest")
+    if not isinstance(manifest, dict):
+        manifest = ckpt_schema.build_manifest(
+            state.get("partition") or deep_get(
+                node, "metadata", "labels", consts.TPU_SLICE_CONFIG_LABEL),
+            state.get("blocked") or [],
+            groups=state.get("groups"))
+    ckpt_schema.save_checkpoint_v2(
+        drain.checkpoint_path(status.directory), step,
+        rng_state=state.get("rng_state"),
+        compile_cache=os.environ.get("JAX_COMPILATION_CACHE_DIR"),
+        optimizer_state=ckpt_schema.optimizer_state_pointer(
+            status.directory),
+        manifest=manifest, transparent=True, now=now)
+    _stamp_barrier(status, "migrate_snapshot",
+                   {"plan": plan, "step": step, "taken_at": now()})
+    payload = {"plan": plan, "ok": True, "step": step,
+               "manifest": manifest}
+    _publish(client, node_name,
+             consts.MIGRATE_SNAPSHOT_RESULT_ANNOTATION, payload)
+    log.info("migrate agent: transparent snapshot of %s at step %d "
+             "(plan %s)", node_name, step, plan)
+    return True
+
+
+def _default_fetch(inbound: dict, on_corrupt=None) -> Optional[dict]:
+    base = os.environ.get(TRANSFER_DIR_ENV)
+    if not base:
+        return None
+    path = os.path.join(base, str(inbound.get("src", "")),
+                        consts.DRAIN_CHECKPOINT_FILE)
+    return drain.load_checkpoint(path, on_corrupt=on_corrupt)
+
+
+def restore_once(client, node_name: str, status,
+                 fetch: Optional[Callable[[dict], Optional[dict]]] = None,
+                 accelerator: Optional[str] = None,
+                 total_chips: Optional[int] = None,
+                 metrics=None, namespace: Optional[str] = None,
+                 now=time.time) -> bool:
+    """One restore pass on a destination node: if an inbound migration
+    this agent has not restored is stamped, fetch the transferred
+    checkpoint, re-map its manifest onto the local layout, and land it at
+    the local checkpoint path so the resumed tenant loads it exactly like
+    a drain checkpoint. Returns True when the restore landed."""
+    try:
+        node = client.get("v1", "Node", node_name)
+    except BreakerOpenError:
+        raise  # degraded mode: the caller's loop backs off, not a failure
+    except Exception as e:
+        log.debug("migrate agent: node read failed (%s)", e)
+        return False
+    inbound = _parse_annotation(node, consts.MIGRATION_INBOUND_ANNOTATION)
+    if not inbound or not inbound.get("plan"):
+        return False
+    plan = str(inbound["plan"])
+    result = _parse_annotation(node, consts.MIGRATION_RESTORE_ANNOTATION)
+    if result and result.get("plan") == plan:
+        return False  # already restored this migration
+    on_corrupt = ckpt_schema.corrupt_reporter(
+        client, namespace or os.environ.get(
+            consts.NAMESPACE_ENV, consts.DEFAULT_NAMESPACE),
+        node_name, metrics=metrics)
+    payload = (fetch(inbound) if fetch is not None
+               else _default_fetch(inbound, on_corrupt=on_corrupt))
+    if payload is None:
+        # the full payload is unreachable (source host gone, transfer
+        # torn): the inbound record itself carries the committed step +
+        # manifest — restore from the operator-mediated minimum rather
+        # than failing the tenant back to scratch
+        if "step" not in inbound:
+            _publish(client, node_name, consts.MIGRATION_RESTORE_ANNOTATION,
+                     {"plan": plan, "ok": False, "src": inbound.get("src"),
+                      "error": "transferred checkpoint unreadable"})
+            return False
+        payload = {"step": inbound["step"],
+                   "manifest": inbound.get("manifest")}
+    step = int(payload["step"])
+    manifest = payload.get("manifest") or inbound.get("manifest")
+    if isinstance(manifest, dict) and accelerator and total_chips:
+        remapped = ckpt_schema.remap_manifest(
+            manifest, accelerator, int(total_chips), [],
+            deep_get(node, "metadata", "labels",
+                     consts.TPU_SLICE_CONFIG_LABEL))
+        manifest = remapped if remapped is not None else manifest
+    ckpt_schema.save_checkpoint_v2(
+        drain.checkpoint_path(status.directory), step,
+        rng_state=payload.get("rng_state"),
+        compile_cache=payload.get("compile_cache"),
+        optimizer_state=payload.get("optimizer_state"),
+        manifest=manifest if isinstance(manifest, dict) else None,
+        extra={"migrated_from": inbound.get("src")}, now=now)
+    _stamp_barrier(status, "migrate_restore",
+                   {"plan": plan, "step": step, "restored_at": now()})
+    _publish(client, node_name, consts.MIGRATION_RESTORE_ANNOTATION,
+             {"plan": plan, "ok": True, "step": step,
+              "src": inbound.get("src")})
+    log.info("migrate agent: restored tenant from %s on %s at step %d "
+             "(plan %s)", inbound.get("src"), node_name, step, plan)
+    return True

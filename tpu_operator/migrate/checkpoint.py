@@ -1,0 +1,181 @@
+"""Versioned checkpoint payloads for cross-node migration (tentpole a).
+
+PR 7's drain checkpoint carries ``{step, saved_at, rng_state?,
+compile_cache?}`` — enough to resume in place, not enough to restore on a
+DIFFERENT node: the destination needs to know how the arrays were sharded
+over the source layout to re-map them onto its own. Schema v2 adds:
+
+- ``version``: 2. v1 payloads (no version key) still load everywhere —
+  :func:`~tpu_operator.health.drain.load_checkpoint` only requires a dict
+  with a ``step``, and every new key is additive.
+- ``optimizer_state``: pointers (host path + format) to the optimizer
+  state saved beside the model arrays, so restore skips the
+  warmup-from-scratch an Adam-style optimizer would otherwise pay.
+- ``manifest``: the sharded-array manifest — per-shard chip ids and
+  topology, keyed by the layout fingerprint
+  ``object_hash({partition, blocked})`` (the SAME identity the drain
+  protocol and the partitioner already agree on), so the destination can
+  re-map shards via the partitioner's incremental re-tile instead of
+  resharding blind.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from typing import List, Optional, Sequence
+
+from .. import events
+from ..health import drain
+from ..partitioner import topology
+
+#: current checkpoint schema version; payloads without a ``version`` key
+#: are v1 (PR 7) and remain loadable forever
+CHECKPOINT_VERSION = 2
+
+#: file (beside the checkpoint, same host-path dir) optimizer-state
+#: pointers reference; the sim writes the pointer, not gigabytes of moments
+OPTIMIZER_STATE_FILE = "optimizer-state.msgpack"
+
+
+def checkpoint_version(ckpt: Optional[dict]) -> int:
+    """The schema version of a loaded checkpoint payload (1 when the
+    ``version`` key predates this PR, 0 for None/garbage)."""
+    if not isinstance(ckpt, dict):
+        return 0
+    try:
+        return int(ckpt.get("version", 1))
+    except (TypeError, ValueError):
+        return 1
+
+
+def optimizer_state_pointer(status_dir: str,
+                            fmt: str = "msgpack") -> dict:
+    """Pointer record for the optimizer state saved beside the model
+    checkpoint — path + format, never the payload itself (the arrays
+    travel out-of-band, like the model shards)."""
+    return {"path": os.path.join(status_dir, OPTIMIZER_STATE_FILE),
+            "format": fmt}
+
+
+def build_manifest(partition: Optional[str], blocked,
+                   groups: Optional[List[dict]] = None,
+                   arrays: Sequence[str] = ("params", "opt_state")) -> dict:
+    """The sharded-array manifest for a layout: one shard per slice group
+    (chip ids + topology string), keyed by the layout fingerprint the
+    drain protocol already uses as the plan identity."""
+    shards = []
+    for idx, group in enumerate(groups or []):
+        shards.append({
+            "shard": idx,
+            "topology": (group or {}).get("topology"),
+            "chips": [int(c) for c in (group or {}).get("chips", [])],
+            "arrays": list(arrays),
+        })
+    return {
+        "layout": drain.plan_fingerprint(partition, blocked),
+        "partition": partition or "",
+        "blocked": sorted(int(c) for c in (blocked or [])),
+        "shards": shards,
+    }
+
+
+def remap_manifest(manifest: dict, accelerator: str, total_chips: int,
+                   blocked, partition: Optional[str]) -> Optional[dict]:
+    """Re-map a source manifest onto the destination layout via the
+    partitioner's incremental re-tile: shards whose chip footprint is
+    still placeable keep their identity (arrays stay put), the rest are
+    re-placed on healthy cells. Returns None when any shard cannot be
+    placed (the destination genuinely lacks capacity — callers must pick
+    another node rather than silently drop arrays)."""
+    shards = manifest.get("shards") or []
+    previous = [{"topology": s.get("topology"),
+                 "chips": [int(c) for c in s.get("chips", [])]}
+                for s in shards]
+    try:
+        groups, dropped = topology.retile_incremental(
+            accelerator, total_chips, blocked or [], previous)
+    except topology.TopologyError:
+        return None
+    if dropped or len(groups) != len(shards):
+        return None
+    out = []
+    for shard, group in zip(shards, groups):
+        placed = dict(shard)
+        placed["topology"] = group.get("topology")
+        placed["chips"] = [int(c) for c in group.get("chips", [])]
+        out.append(placed)
+    return {
+        "layout": drain.plan_fingerprint(partition, blocked),
+        "partition": partition or "",
+        "blocked": sorted(int(c) for c in (blocked or [])),
+        "shards": out,
+    }
+
+
+def save_checkpoint_v2(path: str, step: int, rng_state=None,
+                       compile_cache: Optional[str] = None,
+                       optimizer_state: Optional[dict] = None,
+                       manifest: Optional[dict] = None,
+                       transparent: bool = False,
+                       extra: Optional[dict] = None,
+                       now=time.time) -> str:
+    """Persist a v2 checkpoint: the v1 payload plus version, optimizer
+    pointers and the sharded-array manifest, through the SAME atomic
+    tmp+rename writer — readers that predate v2 see the extra keys as
+    opaque and keep working."""
+    payload = {"version": CHECKPOINT_VERSION}
+    if optimizer_state:
+        payload["optimizer_state"] = dict(optimizer_state)
+    if manifest:
+        payload["manifest"] = manifest
+    if transparent:
+        # the workload never participated: an operator-driven snapshot
+        payload["transparent"] = True
+    if extra:
+        payload.update(extra)
+    return drain.save_checkpoint(path, step, rng_state=rng_state,
+                                 compile_cache=compile_cache,
+                                 extra=payload, now=now)
+
+
+# -- corrupt-checkpoint visibility (satellite: silent restart-from-scratch) ----
+
+def corrupt_reporter(client, namespace: str, node_name: str, metrics=None):
+    """An ``on_corrupt`` callback for :func:`drain.load_checkpoint` that
+    turns a silently-dropped checkpoint into operator-visible signal: one
+    ``tpu_operator_checkpoint_corrupt_total`` bump plus a
+    content-addressed ``CheckpointCorrupt`` Event — the token is the hash
+    of the corrupt bytes, so retried loads of the SAME torn file collapse
+    to one Event while a differently-corrupt successor gets its own."""
+    involved = {"apiVersion": "v1", "kind": "Node",
+                "metadata": {"name": node_name}}
+
+    def report(kind: str, raw: str) -> None:
+        if metrics is not None:
+            metrics.checkpoint_corrupt.inc()
+        digest = hashlib.sha1((raw or "").encode()).hexdigest()[:16]
+        events.record_once(
+            client, namespace, involved, events.WARNING,
+            "CheckpointCorrupt",
+            f"{node_name}: drain checkpoint unreadable ({kind}); the "
+            f"workload will restart from scratch unless a migration "
+            f"restore supersedes it",
+            token=f"{kind}:{digest}")
+
+    return report
+
+
+def manifest_layout(ckpt: Optional[dict]) -> Optional[str]:
+    """The layout fingerprint a checkpoint's manifest was sharded for."""
+    manifest = (ckpt or {}).get("manifest")
+    if not isinstance(manifest, dict):
+        return None
+    layout = manifest.get("layout")
+    return str(layout) if layout else None
+
+
+def dumps_compact(payload: dict) -> str:
+    return json.dumps(payload, sort_keys=True, separators=(",", ":"))

@@ -125,6 +125,13 @@ def run_scenario(groups: Sequence[dict],
     deadline). The summary then reports ``drained_within_window``: tenants
     that finished migrating before the deadline.
 
+    ``retile["migrate"] = True`` models the migration subsystem on top:
+    drained tenants do NOT re-queue — their decoded progress travels with
+    the checkpoint and each resumes directly on a destination slice after
+    ``retile["migrate_latency_s"]`` (default 0.25 s, the
+    transfer+restore cost), keeping its place ahead of the arrival queue.
+    The summary then also reports ``migrated_within_window``.
+
     Returns a plain dict (bench-JSON-ready); ``unhandled_errors`` counts
     event-loop exceptions and must be 0 in any healthy run.
     """
@@ -141,13 +148,16 @@ def run_scenario(groups: Sequence[dict],
     def rate(req: _Request) -> float:
         return req.chips * 1000.0 / per_token_ms
 
-    ARRIVE, COMPLETE, RETILE, PLAN = 0, 1, 2, 3
+    ARRIVE, COMPLETE, RETILE, PLAN, MIGRATE = 0, 1, 2, 3, 4
     events: List[tuple] = []
     seq = 0
     for req in requests:
         events.append((req.arrival, seq, ARRIVE, req, 0))
         seq += 1
     planned = bool(retile and retile.get("planned"))
+    migrate = bool(retile and retile.get("migrate"))
+    migrate_latency = (float(retile.get("migrate_latency_s", 0.25))
+                       if retile else 0.0)
     if retile:
         if planned:
             # coordinated drain: the plan lands at `at`, the block at the
@@ -169,6 +179,7 @@ def run_scenario(groups: Sequence[dict],
     preemptions = 0
     unhandled_errors = 0
     drained: List[_Request] = []
+    migrated: List[_Request] = []
 
     # -- per-tick sampling (the autoscaler's live signal) --
     timeseries: List[dict] = []
@@ -300,7 +311,18 @@ def run_scenario(groups: Sequence[dict],
                             unplace(r, now)
                             r.drained_at = now
                             drained.append(r)
-                            waiting.append(r)
+                            if migrate:
+                                # migration subsystem: the checkpoint
+                                # travels with the tenant; it resumes on
+                                # the destination after the transfer
+                                # latency, never re-queueing
+                                migrated.append(r)
+                                heapq.heappush(
+                                    events, (now + migrate_latency, seq,
+                                             MIGRATE, r, r.epoch))
+                                seq += 1
+                            else:
+                                waiting.append(r)
                 try_place_all(now)
             elif kind == RETILE:
                 for idx in retile.get("blocked", []):
@@ -314,8 +336,30 @@ def run_scenario(groups: Sequence[dict],
                             unplace(r, now)
                             r.drained_at = now
                             drained.append(r)
-                            waiting.append(r)
+                            if migrate:
+                                migrated.append(r)
+                                heapq.heappush(
+                                    events, (now + migrate_latency, seq,
+                                             MIGRATE, r, r.epoch))
+                                seq += 1
+                            else:
+                                waiting.append(r)
                 try_place_all(now)
+            elif kind == MIGRATE:
+                if req.epoch != epoch or req.slice_id is not None:
+                    continue  # stale: already resumed elsewhere
+                sl = next((s for s in slices
+                           if not s.blocked and not s.pending_block
+                           and s.free >= req.chips), None)
+                if sl is not None:
+                    # restore-on-destination: the tenant lands directly
+                    # with its progress intact, ahead of the queue
+                    place(req, sl, now)
+                else:
+                    # destination capacity genuinely missing: degrade to
+                    # the re-queue path rather than losing the tenant
+                    waiting.append(req)
+                    try_place_all(now)
         except Exception:
             unhandled_errors += 1
 
@@ -384,6 +428,17 @@ def run_scenario(groups: Sequence[dict],
                 (r.replaced_at - r.drained_at for r in replaced),
                 default=0.0), 4),
         }
+        # migration-subsystem numbers: tenants that resumed on their
+        # destination slice (no re-queue) before the drain deadline
+        resumed = [r for r in migrated if r.replaced_at is not None]
+        m_within = [r for r in resumed
+                    if r.replaced_at - r.drained_at <= window]
+        result["retile"].update({
+            "migrate": migrate,
+            "migrated_tenants": len(migrated),
+            "migrated_within_window": len(m_within),
+            "all_migrated_within_window": len(m_within) == len(migrated),
+        })
     return result
 
 

@@ -48,6 +48,10 @@ class KubeletSimulator:
         #: Lets tests execute the RENDERED command/args/env through the real
         #: validator CLI instead of teleporting pods to Succeeded.
         self.validation_exec = validation_exec
+        #: node name -> migrate-agent config (status files + restore
+        #: knobs); each tick runs the agent's snapshot/restore passes for
+        #: these nodes, the sim double of `tpuop-validator -c migrate-agent`
+        self._migrate_agents: dict = {}
         self._seen: dict = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -71,10 +75,30 @@ class KubeletSimulator:
                 # errors must not kill the loop mid-test
                 log.debug("kubelet sim tick error: %s", e)
 
+    def attach_migrate_agent(self, node_name: str, status,
+                             dump: Optional[Callable] = None,
+                             fetch: Optional[Callable] = None,
+                             accelerator: Optional[str] = None,
+                             total_chips: Optional[int] = None,
+                             metrics=None) -> None:
+        """Run the migrate agent's snapshot/restore passes for this node
+        on every tick, against the given StatusFiles (the node's host
+        path). ``dump``/``fetch`` override the process-state read and the
+        transfer fetch; ``accelerator``+``total_chips`` enable manifest
+        re-mapping onto this node's layout."""
+        self._migrate_agents[node_name] = {
+            "status": status, "dump": dump, "fetch": fetch,
+            "accelerator": accelerator, "total_chips": total_chips,
+            "metrics": metrics}
+
+    def detach_migrate_agent(self, node_name: str) -> None:
+        self._migrate_agents.pop(node_name, None)
+
     # one scheduling pass; public so tests can drive it deterministically
     def tick(self) -> None:
         nodes = self.client.list("v1", "Node")
         self._complete_validation_pods()
+        self._run_migrate_agents()
         for ds in self.client.list("apps/v1", "DaemonSet", self.namespace):
             selector = deep_get(ds, "spec", "template", "spec", "nodeSelector", default={})
             matching = [n for n in nodes if node_matches_selector(n, selector)]
@@ -180,6 +204,26 @@ class KubeletSimulator:
                 except AlreadyExistsError:
                     pass
         return available, updated
+
+    def _run_migrate_agents(self) -> None:
+        from ..migrate import agent as migrate_agent
+
+        for node_name, cfg in list(self._migrate_agents.items()):
+            try:
+                migrate_agent.snapshot_once(
+                    self.client, node_name, cfg["status"],
+                    dump=cfg.get("dump"))
+                migrate_agent.restore_once(
+                    self.client, node_name, cfg["status"],
+                    fetch=cfg.get("fetch"),
+                    accelerator=cfg.get("accelerator"),
+                    total_chips=cfg.get("total_chips"),
+                    metrics=cfg.get("metrics"),
+                    namespace=self.namespace)
+            except (ApiError, requests.RequestException) as e:
+                # a revoked node mid-pass must not kill the other agents
+                log.debug("migrate agent pass for %s failed: %s",
+                          node_name, e)
 
     def _complete_validation_pods(self) -> None:
         """Pinned validation pods (workload + multihost rendezvous +

@@ -15,10 +15,14 @@ with operator sweeps, so kill/restart points are deterministic.
 
 from __future__ import annotations
 
+import json
 import logging
+import os
 from typing import List, Optional
 
 from ..health import drain
+from ..migrate import agent as migrate_agent
+from ..migrate import checkpoint as migrate_ckpt
 from ..validator.status import StatusFiles
 
 log = logging.getLogger(__name__)
@@ -35,10 +39,20 @@ class SimulatedTrainingJob:
     the (bounded) loss the soak asserts on.
     """
 
-    def __init__(self, client, node_name: str, status: StatusFiles):
+    def __init__(self, client, node_name: str, status: StatusFiles,
+                 cooperative: bool = True, partition: str = "",
+                 blocked: Optional[List[int]] = None):
         self.client = client
         self.node_name = node_name
         self.status = status
+        #: cooperative=False models a hung/wedged trainer: the step loop
+        #: still runs (so process memory keeps changing) but the
+        #: drain-watch pass never fires — no checkpoint, no ack, ever.
+        #: Exactly the workload the transparent snapshot path exists for.
+        self.cooperative = cooperative
+        #: slice layout the sharded-array manifest is keyed by
+        self.partition = partition
+        self.blocked = list(blocked or [])
         self.step = 0
         #: deterministic RNG stand-in, advanced with the step counter so a
         #: resume that loses steps also detectably loses RNG sync
@@ -51,6 +65,9 @@ class SimulatedTrainingJob:
         when a plan is pending). Returns the step counter."""
         self.step += 1
         self.rng_state = (self.rng_state * 6364136223846793005 + 1442695040888963407) % (2 ** 64)
+        self._mirror_process_state()
+        if not self.cooperative:
+            return self.step
         node = self.client.get("v1", "Node", self.node_name)
         plan = drain.node_plan(node)
         if plan is not None and plan.fingerprint not in self.acked_plans:
@@ -66,9 +83,27 @@ class SimulatedTrainingJob:
     def _ckpt_path(self) -> str:
         return drain.checkpoint_path(self.status.directory)
 
+    def _mirror_process_state(self) -> None:
+        """Continuously mirror live {step, rng_state, layout} to the host
+        path the migrate agent dumps from — the stand-in for process
+        memory that makes a transparent snapshot possible WITHOUT this
+        job's cooperation."""
+        path = migrate_agent.process_state_path(self.status.directory)
+        os.makedirs(self.status.directory, exist_ok=True)
+        payload = {"step": self.step, "rng_state": self.rng_state,
+                   "partition": self.partition, "blocked": self.blocked}
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)
+
     def checkpoint(self) -> str:
-        return drain.save_checkpoint(self._ckpt_path(), self.step,
-                                     rng_state=self.rng_state)
+        return migrate_ckpt.save_checkpoint_v2(
+            self._ckpt_path(), self.step, rng_state=self.rng_state,
+            optimizer_state=migrate_ckpt.optimizer_state_pointer(
+                self.status.directory),
+            manifest=migrate_ckpt.build_manifest(self.partition,
+                                                 self.blocked))
 
     # -- remediation/recycle modelling ----------------------------------------
     def crash(self) -> None:

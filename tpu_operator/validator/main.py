@@ -26,6 +26,10 @@ metrics             node-status exporter (status files -> Prometheus)
 telemetry           libtpu telemetry exporter (DCGM analog)
 feature-discovery   chip/topology node labeler loop
 slice-partitioner   apply the node's slice partition config (MIG analog)
+migrate-agent       node-side migration loop: transparent CRIU-style
+                    snapshots on operator request + inbound-checkpoint
+                    restore (same host-path + barrier discipline as
+                    drain acks)
 ==================  =========================================================
 """
 
@@ -51,7 +55,7 @@ def build_parser() -> argparse.ArgumentParser:
                             "perf", "serving", "wait", "sleep", "metrics",
                             "telemetry", "feature-discovery",
                             "slice-partitioner", "device-plugin", "cdi",
-                            "info"])
+                            "migrate-agent", "info"])
     p.add_argument("--json", action="store_true",
                    help="info: machine-readable output")
     p.add_argument("--cdi-dir", default="/etc/cdi")
@@ -553,6 +557,36 @@ def _dispatch(args, status, client) -> int:
         client = client or make_client()
         return partitioner_run(client, config_path=args.config,
                                handoff_dir=args.handoff_dir)
+
+    if component == "migrate-agent":
+        import time
+
+        from ..migrate import agent as migrate_agent
+
+        node_name = os.environ.get("NODE_NAME", "")
+        if not node_name:
+            log.error("migrate-agent: NODE_NAME required")
+            return 1
+        client = client or make_client()
+        accelerator = os.environ.get("TPU_ACCELERATOR_TYPE") or None
+        try:
+            total_chips = int(os.environ.get("TPU_TOTAL_CHIPS", "0")) or None
+        except ValueError:
+            total_chips = None
+        log.info("migrate-agent: watching %s (interval %ss)",
+                 node_name, args.sleep_interval)
+        while True:
+            try:
+                migrate_agent.snapshot_once(client, node_name, status)
+                migrate_agent.restore_once(
+                    client, node_name, status,
+                    accelerator=accelerator, total_chips=total_chips)
+            except Exception:
+                # one bad pass must not crash-loop the agent DS — the
+                # operator's deadline path stays live regardless
+                log.exception("migrate-agent pass failed; retrying "
+                              "next interval")
+            time.sleep(args.sleep_interval)
 
     raise AssertionError(f"unhandled component {component}")
 
