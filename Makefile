@@ -55,13 +55,13 @@ serving-bench:  ## serving SLO probe (healthy + quarantined fail-closed) + seede
 	SERVING_TRAFFIC_SEED=$(SERVING_TRAFFIC_SEED) $(PYTHON) bench.py --serving-only
 
 .PHONY: join-bench
-join-bench:  ## one-node end-to-end join trace + critical-path attribution; fails unless attribution covers >=95% of the join window with zero orphan spans. Trace id pinned by construction (sha256 of the policy identity); JAX on CPU for run-to-run comparability.
+join-bench:  ## one-node end-to-end join through the pipelined operand DAG; fails unless join < 8 s, attribution covers >=95% of the join window with zero orphan spans, and the pass guarantees hold (chain exit codes 0, barrier order driver<=plugin<=workload). Publishes BENCH_join.json (versioned artifact). Trace id pinned by construction; JAX on CPU for run-to-run comparability.
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --join-only
 
 SCALE_BENCH_SEED ?= 20260805
 
 .PHONY: scale-bench
-scale-bench:  ## 5,000-node join + label-churn envelope through the latency-injected simulator; fails unless churn traffic is O(events) (fleet-size-independent per-event request budget) and reconcile p99 stays under the gate
+scale-bench:  ## 5,000-node join + label-churn envelope through the latency-injected simulator; fails unless churn traffic is O(events) (fleet-size-independent per-event request budget), reconcile p99 stays under the gate, and fleet join beats the pre-DAG 351 s baseline
 	SCALE_BENCH_SEED=$(SCALE_BENCH_SEED) JAX_PLATFORMS=cpu $(PYTHON) bench.py --scale-only
 
 AUTOSCALE_BENCH_SEED ?= 20260805

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
 import sys
 import time
 
@@ -801,21 +802,31 @@ def bench_join_attribution(timeout: float = 115.0) -> dict:
 
     The operator renders operand manifests carrying the stable join
     traceparent (read back off the rendered validator DS template — the
-    propagation path under test, not recomputed here). While the
-    latency-injected DS rollout converges, the REAL validator CLI runs the
-    node side in subprocesses the way operand pods start mid-join: a
-    workload-local ICI sweep and a concurrent barrier wait, both under
-    that ``TPU_TRACE_PARENT``, appending span records to a temp status
-    dir. A real feature-discovery pass then mirrors the span log to the
-    ``tpu.ai/trace-spans`` node annotation, and the operator's
-    JoinProfiler stitches operator sweeps + rollout wait + node spans into
-    one trace. Pinned by construction: the simulator mints no uids, so
-    the traceparent is the same sha256-derived value every run.
+    propagation path under test, not recomputed here). The kubelet sim
+    runs the per-DS pull model (INJECTED_DS_ROLLOUT_TICKS): every operand
+    image pulls concurrently from the labeler's pre-pull stamp, and DS
+    availability is gated on the REAL barrier files. The node side is the
+    full validator init chain run serially by the real CLI, exactly as
+    the rendered validator DS orders it — driver validation (fake ELF
+    libtpu), cache prewarm (cold XLA compile into the persistent cache,
+    hidden inside the plugin poll window), plugin validation (polling the
+    apiserver until the device-plugin DS registers the resource), then
+    the workload-local ICI sweep paying only the warm compile — plus a
+    concurrent barrier wait, all under ``TPU_TRACE_PARENT``, appending
+    span records to a temp status dir. A real feature-discovery pass then
+    mirrors the span log to the ``tpu.ai/trace-spans`` node annotation,
+    and the operator's JoinProfiler stitches operator sweeps + pre-pull
+    window + rollout wait + node spans into one trace. Pinned by
+    construction: the simulator mints no uids, so the traceparent is the
+    same sha256-derived value every run.
 
     CI gates (join_bench_main): stitched trace complete, attribution
-    covers >= 95% of the join window, zero orphan spans."""
+    covers >= 95% of the join window, zero orphan spans, join under
+    JOIN_BUDGET_S, pass guarantees intact (all barriers real + DAG-
+    ordered)."""
     import subprocess
     import tempfile
+    import threading
 
     _ensure_operand_images()
 
@@ -828,16 +839,32 @@ def bench_join_attribution(timeout: float = 115.0) -> dict:
     from tpu_operator.testing.kubelet import KubeletSimulator
     from tpu_operator.utils import deep_get
     from tpu_operator.validator import feature_discovery
+    from tpu_operator.validator.status import StatusFiles
 
     node_name = "tpu-join-0"
+    tmp = tempfile.mkdtemp(prefix="tpu-join-bench-")
+    status_dir = os.path.join(tmp, "status")
+    os.makedirs(status_dir)
+    # fake driver install the REAL driver validation accepts: an ELF-
+    # headed libtpu.so (is_valid_libtpu checks the magic, not the arch)
+    install_dir = os.path.join(tmp, "libtpu")
+    os.makedirs(install_dir)
+    with open(os.path.join(install_dir, "libtpu.so"), "wb") as f:
+        f.write(b"\x7fELF" + b"\x00" * 60)
+    cache_dir = os.path.join(tmp, "xla-cache")
+
     srv = MiniApiServer(latency_s=INJECTED["latency_s"])
     base = srv.start()
     seed = RestClient(base_url=base)
     seed.create(new_cluster_policy())
     op_client = CachedClient(RestClient(base_url=base))
     app = OperatorApp(op_client)
-    kubelet = KubeletSimulator(seed, interval=INJECTED["interval"],
-                               rollout_ticks=INJECTED["rollout_ticks"])
+    # per-DS pull model + barrier gating against the bench's status dir:
+    # the node-agent chain below writes the real barrier files there
+    kubelet = KubeletSimulator(
+        seed, interval=INJECTED["interval"],
+        rollout_ticks=INJECTED_DS_ROLLOUT_TICKS,
+        barrier_check=StatusFiles(status_dir).is_ready)
     app.start()
     kubelet.start()
     procs: list = []
@@ -861,67 +888,129 @@ def bench_join_attribution(timeout: float = 115.0) -> dict:
         if trace_parent is None:
             return {"error": "no rendered DS carried TPU_TRACE_PARENT"}
 
-        with tempfile.TemporaryDirectory(prefix="tpu-join-bench-") as status_dir:
-            env = dict(os.environ)
-            env.update({"TPU_TRACE_PARENT": trace_parent,
-                        "NODE_NAME": node_name,
-                        "STATUS_DIR": status_dir})
-            env.setdefault("JAX_PLATFORMS", "cpu")
-            repo = os.path.dirname(os.path.abspath(__file__))
-            t0 = time.monotonic()
-            seed.create({"apiVersion": "v1", "kind": "Node",
-                         "metadata": {"name": node_name, "labels": {
-                             consts.GKE_TPU_ACCELERATOR_LABEL:
-                                 "tpu-v5-lite-podslice",
-                             consts.GKE_TPU_TOPOLOGY_LABEL: "4x4"}},
-                         "status": {}})
-            # node-agent emulation, launched DURING the rollout so the
-            # subprocess boot cost falls inside the ds-rollout-wait tile
-            # (as a real pod's container start would); the overlap of the
-            # sweep and the barrier wait also exercises the sweep-line's
-            # priority rules on genuinely overlapping phases
-            for args in (["-c", "workload-local",
-                          "--matrix-dim", str(JOIN_BENCH_MATRIX_DIM),
-                          "--status-dir", status_dir],
-                         ["-c", "wait", "--for", "workload",
-                          "--timeout", "90", "--status-dir", status_dir]):
-                procs.append(subprocess.Popen(
+        env = dict(os.environ)
+        env.update({"TPU_TRACE_PARENT": trace_parent,
+                    "NODE_NAME": node_name,
+                    "STATUS_DIR": status_dir,
+                    "KUBE_API_URL": base,
+                    "TPU_COMPILATION_CACHE_DIR": cache_dir})
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        repo = os.path.dirname(os.path.abspath(__file__))
+        t0 = time.monotonic()
+        seed.create({"apiVersion": "v1", "kind": "Node",
+                     "metadata": {"name": node_name, "labels": {
+                         consts.GKE_TPU_ACCELERATOR_LABEL:
+                             "tpu-v5-lite-podslice",
+                         consts.GKE_TPU_TOPOLOGY_LABEL: "4x4"}},
+                     "status": {}})
+
+        # node-agent emulation: the validator DS init chain, run serially
+        # by the REAL CLI in the exact order the rendered manifest pins
+        # (driver -> prewarm -> plugin -> workload), launched DURING the
+        # rollout so subprocess boot cost falls where container starts
+        # would. The chain races the concurrent DS pulls: plugin polls
+        # until the device-plugin DS registers the resource, and the
+        # prewarm's cold compile hides inside that poll window.
+        chain_rcs: dict = {}
+
+        def node_agent_chain() -> None:
+            steps = (
+                ("driver", ["-c", "driver", "--install-dir", install_dir,
+                            "--no-require-devices",
+                            "--status-dir", status_dir]),
+                # --prewarm rides the plugin step, exactly as the rendered
+                # manifest orders it: the cold compile thread runs in the
+                # shadow of the resource poll
+                ("plugin", ["-c", "plugin", "--timeout", "60",
+                            "--poll", "0.2", "--prewarm",
+                            "--matrix-dim", str(JOIN_BENCH_MATRIX_DIM),
+                            "--status-dir", status_dir]),
+                ("workload", ["-c", "workload-local",
+                              "--matrix-dim", str(JOIN_BENCH_MATRIX_DIM),
+                              "--status-dir", status_dir]),
+            )
+            for step, args in steps:
+                rc = subprocess.run(
                     [sys.executable, "-m", "tpu_operator.validator.main"]
                     + args, cwd=repo, env=env,
-                    stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+                    stdout=subprocess.DEVNULL,
+                    stderr=subprocess.DEVNULL).returncode
+                chain_rcs[step] = rc
+                if rc != 0:
+                    return  # a failed stage blocks the chain, like a pod
 
-            def converged() -> bool:
-                node = srv.backend.get("v1", "Node", node_name)
-                return (deep_get(node, "status", "capacity",
-                                 consts.TPU_RESOURCE_NAME) is not None
-                        and deep_get(
-                            srv.backend.get("tpu.ai/v1", "ClusterPolicy",
-                                            "cluster-policy"),
-                            "status", "state") == "ready")
+        chain = threading.Thread(target=node_agent_chain,
+                                 name="join-bench-node-agent", daemon=True)
+        chain.start()
+        # a concurrent barrier wait (the serving DS's wait init analog):
+        # overlaps the sweep so the sweep-line's priority rules are
+        # exercised on genuinely overlapping phases
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "tpu_operator.validator.main",
+             "-c", "wait", "--for", "workload",
+             "--timeout", "90", "--status-dir", status_dir],
+            cwd=repo, env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
 
-            while time.monotonic() - t0 < timeout and not converged():
-                time.sleep(0.05)
-            if not converged():
-                return {"timed_out": True}
-            join_s = time.monotonic() - t0
-            for p in procs:
-                try:
-                    p.wait(timeout=240)
-                except subprocess.TimeoutExpired:
-                    p.kill()
-                    return {"error": "node-side validator did not finish"}
-            # one real feature-discovery pass mirrors the span log up
-            # (sync_node_labels reads the status dir from $STATUS_DIR)
-            prev = os.environ.get("STATUS_DIR")
-            os.environ["STATUS_DIR"] = status_dir
+        def converged() -> bool:
+            node = srv.backend.get("v1", "Node", node_name)
+            return (deep_get(node, "status", "capacity",
+                             consts.TPU_RESOURCE_NAME) is not None
+                    and deep_get(
+                        srv.backend.get("tpu.ai/v1", "ClusterPolicy",
+                                        "cluster-policy"),
+                        "status", "state") == "ready")
+
+        while time.monotonic() - t0 < timeout and not converged():
+            time.sleep(0.05)
+        if not converged():
+            return {"timed_out": True, "chain_exit_codes": chain_rcs}
+        join_s = time.monotonic() - t0
+        chain.join(timeout=240)
+        if chain.is_alive():
+            return {"error": "node-side validator chain did not finish"}
+        for p in procs:
             try:
-                feature_discovery.sync_node_labels(seed, node_name,
-                                                   use_jax=False)
-            finally:
-                if prev is None:
-                    os.environ.pop("STATUS_DIR", None)
-                else:
-                    os.environ["STATUS_DIR"] = prev
+                p.wait(timeout=240)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                return {"error": "node-side validator did not finish"}
+
+        # pass guarantees: convergence must mean what the serial chain
+        # meant — every barrier written by a real validator run that
+        # exited 0, in declared DAG order (driver -> plugin -> workload)
+        barriers = {b: StatusFiles(status_dir).read(b)
+                    for b in ("driver", "plugin", "workload")}
+        stamps = [(b, (barriers[b] or {}).get("timestamp"))
+                  for b in ("driver", "plugin", "workload")]
+        pass_guarantees = {
+            "chain_exit_codes": dict(chain_rcs),
+            "chain_ok": all(chain_rcs.get(s) == 0 for s in
+                            ("driver", "plugin", "workload")),
+            "barriers_passed": all(
+                rec is not None and rec.get("passed") is not False
+                for rec in barriers.values()),
+            "barrier_order_ok": all(
+                a is not None and b is not None and a <= b
+                for (_, a), (_, b) in zip(stamps, stamps[1:])),
+        }
+        node_obj = srv.backend.get("v1", "Node", node_name)
+        prepull_stamped = deep_get(
+            node_obj, "metadata", "annotations",
+            consts.IMAGE_PREPULL_ANNOTATION) is not None
+
+        # one real feature-discovery pass mirrors the span log up
+        # (sync_node_labels reads the status dir from $STATUS_DIR)
+        prev = os.environ.get("STATUS_DIR")
+        os.environ["STATUS_DIR"] = status_dir
+        try:
+            feature_discovery.sync_node_labels(seed, node_name,
+                                               use_jax=False)
+        finally:
+            if prev is None:
+                os.environ.pop("STATUS_DIR", None)
+            else:
+                os.environ["STATUS_DIR"] = prev
 
         # the annotation patch triggers a sweep; wait for the profiler to
         # pick the mirrored node spans up
@@ -940,6 +1029,11 @@ def bench_join_attribution(timeout: float = 115.0) -> dict:
             "node": node_name,
             "traceparent": trace["traceparent"],
             "join_s": round(join_s, 3),
+            "join_budget_s": JOIN_BUDGET_S,
+            "under_budget": join_s < JOIN_BUDGET_S,
+            "ds_rollout_ticks": dict(INJECTED_DS_ROLLOUT_TICKS),
+            "prepull_stamped": prepull_stamped,
+            "pass_guarantees": pass_guarantees,
             "window_s": att["window_s"],
             "coverage": att["coverage"],
             "phases": att["phases"],
@@ -951,10 +1045,12 @@ def bench_join_attribution(timeout: float = 115.0) -> dict:
             "complete": trace["window"]["complete"],
             "reconcile_latency": app.join_profiler.reconcile_latency(),
             "note": ("one-node join through the latency-injected simulator "
-                     "(20 ms RTT + DS rollout delay) with the REAL validator "
-                     "CLI as the node agent; phases from the sweep-line "
-                     "critical path — every instant charged to the most "
-                     "specific active phase"),
+                     "(20 ms RTT, per-DS concurrent pull model seeded by "
+                     "the labeler's pre-pull stamp, barrier-gated DS "
+                     "availability) with the REAL validator init chain as "
+                     "the node agent; phases from the sweep-line critical "
+                     "path — every instant charged to the most specific "
+                     "active phase"),
         }
     finally:
         for p in procs:
@@ -964,6 +1060,7 @@ def bench_join_attribution(timeout: float = 115.0) -> dict:
         op_client.stop()
         kubelet.stop()
         srv.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 def _run_json_subprocess(script: str, timeout: float, env=None) -> dict:
@@ -1045,6 +1142,26 @@ def perf_summary(perf: dict) -> dict:
 #: node registration, and the JSON says so.
 INJECTED = dict(latency_s=0.02, interval=0.5, rollout_ticks=20)
 
+#: Per-DS image-pull model for the join bench (kubelet sync = 0.5 s, so
+#: ticks x 0.5 = seconds of pull): the validator image is the fattest
+#: (jax + libtpu), the device plugin mid-weight, everything else small.
+#: Serialized behind the old single wait chain these pulls would cost
+#: 10+7+5 ticks (~11 s); pipelined — every pull starts at the labeler's
+#: pre-pull stamp and runs concurrently — the slowest single pull (5 s)
+#: bounds the rollout contribution. Availability is additionally gated on
+#: the REAL barrier files the node-agent chain writes (barrier_check), so
+#: "ready" keeps meaning "validated", not just "pulled".
+INJECTED_DS_ROLLOUT_TICKS = {
+    "tpu-operator-validator": 10,
+    "tpu-device-plugin": 7,
+    "*": 5,
+}
+
+#: hard join-bench gate (join_bench_main): single-node injected join must
+#: land under this, with identical pass guarantees to the serial chain
+#: (all three barriers written by real validator runs, in DAG order)
+JOIN_BUDGET_S = 8.0
+
 #: 5,000-node scale scenario (`make scale-bench`): 2 ms per apiserver
 #: request — at this fleet size the in-process server's own serialization
 #: already contributes real latency, and 20 ms x O(fleet) requests would
@@ -1063,6 +1180,13 @@ SCALE_BENCH_SEED = 20260805
 #: orders of magnitude — and reconcile p99 must stay interactive
 SCALE_CHURN_BUDGET_PER_EVENT = 8
 SCALE_P99_GATE_S = 5.0
+#: the 5,000-node fleet join measured BEFORE the operand DAG was
+#: pipelined (PR 10's event-driven control plane, serialized wait
+#: chains + cache-blind conflict retries): the scale bench must beat it
+#: — the fleet-scale payoff of concurrent DS rollouts plus the write
+#: batcher's authoritative conflict re-reads has to show up here, not
+#: just in the single-node number
+SCALE_JOIN_BASELINE_S = 351.0
 
 
 def main() -> int:
@@ -1227,7 +1351,8 @@ def serving_main() -> int:
 def scale_bench_main() -> int:
     """`make scale-bench`: the 5,000-node join + label-churn envelope
     through the latency-injected simulator, one JSON line. Exit 0 iff the
-    join converged, churn traffic stayed inside the O(events) budget
+    join converged AND beat the pre-DAG fleet-join baseline
+    (SCALE_JOIN_BASELINE_S), churn traffic stayed inside the O(events) budget
     (requests per churn event bounded by a constant, independent of fleet
     size), and the operator's reconcile p99 stayed under the gate."""
     import random
@@ -1243,6 +1368,8 @@ def scale_bench_main() -> int:
     churn_budget = SCALE_CHURN_BUDGET_PER_EVENT * SCALE_CHURN_ROUNDS
     gates = {
         "join_converged": join_s is not None,
+        "join_improves": (join_s is not None
+                          and join_s < SCALE_JOIN_BASELINE_S),
         "churn_measured": churn_requests is not None,
         "churn_o_events": (churn_requests is not None
                            and churn_requests <= churn_budget),
@@ -1274,6 +1401,7 @@ def scale_bench_main() -> int:
         },
         "reconcile_latency": latency,
         "reconcile_p99_gate_s": SCALE_P99_GATE_S,
+        "join_baseline_s": SCALE_JOIN_BASELINE_S,
         "gates": gates,
     }
     print(json.dumps(line))
@@ -1343,18 +1471,39 @@ def migrate_bench_main() -> int:
 
 def join_bench_main() -> int:
     """`make join-bench`: the end-to-end join-attribution bench alone, one
-    JSON line; exit 0 iff the stitched trace is complete, node-side spans
-    actually arrived, attribution covers >= 95% of the join window, and no
-    span is orphaned — the CI gate for the whole tracing pipeline
-    (inject -> propagate -> record -> mirror -> stitch -> attribute)."""
+    JSON line plus the BENCH_join.json artifact; exit 0 iff the stitched
+    trace is complete, node-side spans actually arrived, attribution
+    covers >= 95% of the join window, no span is orphaned, the join
+    landed under JOIN_BUDGET_S, and the pipelined rollout kept the serial
+    chain's pass guarantees (all barriers real + DAG-ordered) — the CI
+    gate for both the tracing pipeline (inject -> propagate -> record ->
+    mirror -> stitch -> attribute) and the pipelined-join optimisation."""
     att = bench_join_attribution()
-    print(json.dumps({"metric": "join_attribution",
-                      "join_attribution": att}))
-    ok = (att.get("complete") is True
-          and att.get("node_spans", 0) > 0
-          and att.get("orphan_spans") == 0
-          and att.get("coverage", 0.0) >= 0.95)
-    return 0 if ok else 1
+    guarantees = att.get("pass_guarantees") or {}
+    gates = {
+        "complete": att.get("complete") is True,
+        "node_spans": att.get("node_spans", 0) > 0,
+        "zero_orphans": att.get("orphan_spans") == 0,
+        "coverage": att.get("coverage", 0.0) >= 0.95,
+        "under_budget": (att.get("join_s") is not None
+                         and att["join_s"] < JOIN_BUDGET_S),
+        "pass_guarantees": (guarantees.get("chain_ok") is True
+                            and guarantees.get("barriers_passed") is True
+                            and guarantees.get("barrier_order_ok") is True),
+    }
+    line = {"metric": "join_attribution",
+            "join_budget_s": JOIN_BUDGET_S,
+            "gates": gates,
+            "join_attribution": att}
+    print(json.dumps(line))
+    # versioned artifact, like the archived BENCH_r{N}.json lines: the
+    # join budget is a headline claim and its evidence should be
+    # diffable PR-to-PR
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_join.json"), "w") as f:
+        json.dump(line, f, indent=1)
+        f.write("\n")
+    return 0 if all(gates.values()) else 1
 
 
 if __name__ == "__main__":

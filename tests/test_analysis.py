@@ -648,6 +648,91 @@ def test_unbatched_sweep_write_suppressed():
     assert kept == [] and dropped == 1
 
 
+# -- operand-dag --------------------------------------------------------------
+
+OPERANDS_SRC = """
+    OPERAND_DAG = {
+        "state-device-plugin": ("driver",),
+        "state-telemetry": (),
+        "state-operator-serving": ("workload",),
+    }
+"""
+
+STRAY_WAIT_MANIFEST = """
+    spec:
+      initContainers:
+        - name: driver-validation-wait
+          args: [-c, wait, --for=driver, --status-dir=/run/validations]
+"""
+
+
+def lint_dag(src, manifest_texts, relpath="tpu_operator/state/operands.py"):
+    src = textwrap.dedent(src)
+    ctx = FileContext(relpath, src, ast.parse(src),
+                      LintConfig(manifest_texts={
+                          k: textwrap.dedent(v)
+                          for k, v in manifest_texts.items()}))
+    found = list(all_checkers()["operand-dag"]().check(ctx))
+    return apply_suppressions(found, suppressions(src))
+
+
+def test_operand_dag_positive_undeclared_literal_gate():
+    # telemetry declares no parents, but its template hand-writes a wait
+    # on the driver barrier: the stray gate re-serializes the rollout
+    kept, _ = lint_dag(OPERANDS_SRC, {
+        "tpu_operator/manifests/state-telemetry/0500_daemonset.yaml":
+            STRAY_WAIT_MANIFEST})
+    assert rules_of(kept) == ["operand-dag"]
+    assert "state-telemetry" in kept[0].message
+    assert "'driver'" in kept[0].message
+    # anchored at the OPERAND_DAG assignment, where the fix lands
+    assert "OPERAND_DAG" in kept[0].line_text
+
+
+def test_operand_dag_positive_literal_wait_for_macro_call():
+    kept, _ = lint_dag(OPERANDS_SRC, {
+        "tpu_operator/manifests/state-telemetry/0500_daemonset.yaml":
+            '{{ common.wait_for(data, "plugin") }}\n'})
+    assert rules_of(kept) == ["operand-dag"]
+    assert "'plugin'" in kept[0].message
+
+
+def test_operand_dag_negative_declared_and_templated_gates():
+    kept, _ = lint_dag(OPERANDS_SRC, {
+        # literal gate matching the declared parent: fine
+        "tpu_operator/manifests/state-device-plugin/0500_daemonset.yaml":
+            STRAY_WAIT_MANIFEST,
+        # macro-driven gates expand wait_barriers, declared by construction
+        "tpu_operator/manifests/state-operator-serving/0500_daemonset.yaml":
+            "args: [-c, wait, --for={{ barrier }}, --status-dir=/x]\n",
+        # shared includes define the macro itself, no DS of their own
+        "tpu_operator/manifests/_includes/common.j2":
+            "args: [-c, wait, --for=anything, --status-dir=/x]\n",
+    })
+    assert kept == []
+
+
+def test_operand_dag_disabled_without_manifests_or_elsewhere():
+    # no manifest_texts (fixture trees) or a non-operands file: inert
+    assert lint_dag(OPERANDS_SRC, {})[0] == []
+    kept, _ = lint_dag(OPERANDS_SRC, {
+        "tpu_operator/manifests/state-telemetry/0500_daemonset.yaml":
+            STRAY_WAIT_MANIFEST},
+        relpath="tpu_operator/controllers/manager.py")
+    assert kept == []
+
+
+def test_operand_dag_suppressed():
+    src = OPERANDS_SRC.replace(
+        "OPERAND_DAG = {",
+        "OPERAND_DAG = {  "
+        "# opalint: disable=operand-dag — staged migration, gate lands next PR")
+    kept, dropped = lint_dag(src, {
+        "tpu_operator/manifests/state-telemetry/0500_daemonset.yaml":
+            STRAY_WAIT_MANIFEST})
+    assert kept == [] and dropped == 1
+
+
 # -- CLI ----------------------------------------------------------------------
 
 POSITIVE_FIXTURES = {
@@ -680,13 +765,20 @@ POSITIVE_FIXTURES = {
     "unfenced-write": ("tpu_operator/controllers/manager.py", UNFENCED_CHAIN),
     "unbatched-sweep-write": ("tpu_operator/nodeinfo/labeler.py",
                               SWEEP_LOOP_WRITE),
+    # cross-file rule: needs the operands module AND a manifest in-tree
+    "operand-dag": {
+        "tpu_operator/state/operands.py": OPERANDS_SRC,
+        "tpu_operator/manifests/state-telemetry/0500_daemonset.yaml":
+            STRAY_WAIT_MANIFEST,
+    },
 }
 
 
 @pytest.mark.parametrize("rule", sorted(POSITIVE_FIXTURES))
 def test_cli_exits_nonzero_on_each_positive_fixture(rule, tmp_path):
-    rel, src = POSITIVE_FIXTURES[rule]
-    root = _tree(tmp_path, {rel: src})
+    fixture = POSITIVE_FIXTURES[rule]
+    files = fixture if isinstance(fixture, dict) else {fixture[0]: fixture[1]}
+    root = _tree(tmp_path, files)
     out = io.StringIO()
     assert main(["--root", str(root), "--no-baseline"], out=out) == 1
     assert f"[{rule}]" in out.getvalue()

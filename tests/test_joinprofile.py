@@ -203,6 +203,9 @@ def test_phase_naming_rules():
     assert phase_of("reconcile") == "reconcile"
     assert phase_of("mystery-span") == "other"
     assert phase_of("anything", kind="phase") == "reconcile"
+    # "prepull" must match BEFORE the generic "pull" fragment
+    assert phase_of("image-prepull") == "image-prepull"
+    assert phase_of("image-pull.validator") == "image-pull"
 
 
 def test_attribution_charges_overlaps_to_most_specific_phase():
@@ -338,6 +341,40 @@ def test_join_profiler_stitches_hand_built_join():
     assert profiler.stats()["completed_joins"] == 1
     assert profiler.join_traces(node="n0") == [trace]
     assert profiler.join_traces(node="absent") == []
+
+
+def test_join_profiler_attributes_image_prepull_from_annotation():
+    """The labeler's pre-pull stamp becomes an image-prepull interval:
+    it outranks the ds-rollout-wait tile (waiting honestly reads as
+    pulling) but yields to any node-side span."""
+    profiler = JoinProfiler()
+    policy = _policy()
+    not_ready = types.SimpleNamespace(ready=False)
+    ready = types.SimpleNamespace(ready=True)
+
+    def stamped(schedulable=False):
+        node = _node("n0", schedulable=schedulable)
+        node["metadata"]["annotations"][
+            consts.IMAGE_PREPULL_ANNOTATION] = f"{stamp:.3f}"
+        return node
+
+    stamp = time.time()
+    profiler.observe(policy, [stamped()], not_ready)
+    time.sleep(0.05)
+    profiler.observe(policy, [stamped(schedulable=True)], ready)
+    trace = profiler.join_trace("n0")
+    phases = trace["attribution"]["phases"]
+    assert phases.get("image-prepull", 0.0) > 0.0
+    # the prepull interval ends at schedulability (pulls are done once the
+    # plugin pod is up), so it never covers the whole window by itself
+    assert trace["window"]["complete"] is True
+
+    # a malformed stamp is ignored, never crashes the sweep
+    bad = _node("n1", schedulable=True)
+    bad["metadata"]["annotations"][consts.IMAGE_PREPULL_ANNOTATION] = "nope"
+    profiler.observe(policy, [bad], ready)
+    assert "image-prepull" not in profiler.join_trace(
+        "n1")["attribution"]["phases"]
 
 
 def test_join_profiler_flags_orphan_spans():

@@ -1,0 +1,151 @@
+"""KubeletSimulator per-DS image-pull model: the piece that lets
+bench_join_attribution measure DAG pipelining. Dict-valued rollout_ticks
+gives each (DS, node) its own pull clock — started at first match, or
+earlier at the node's image-prepull stamp — while int-valued rollout_ticks
+keeps the legacy whole-DS delay the scale bench depends on. Ticks are
+driven by hand: no threads, fully deterministic."""
+
+from tpu_operator import consts
+from tpu_operator.testing.kubelet import KubeletSimulator
+
+
+def mk_node(name, prepull_at=None):
+    node = {
+        "apiVersion": "v1", "kind": "Node",
+        "metadata": {"name": name,
+                     "labels": {consts.TPU_PRESENT_LABEL: "true"},
+                     "annotations": {}},
+        "status": {},
+    }
+    if prepull_at is not None:
+        node["metadata"]["annotations"][
+            consts.IMAGE_PREPULL_ANNOTATION] = f"{prepull_at:.3f}"
+    return node
+
+
+def mk_ds(name, generation=1, inits=None):
+    return {
+        "apiVersion": "apps/v1", "kind": "DaemonSet",
+        "metadata": {"name": name, "namespace": consts.DEFAULT_NAMESPACE,
+                     "generation": generation},
+        "spec": {
+            "selector": {"matchLabels": {"app": name}},
+            "template": {
+                "metadata": {"labels": {"app": name}},
+                "spec": {
+                    "nodeSelector": {consts.TPU_PRESENT_LABEL: "true"},
+                    "initContainers": inits or [],
+                    "containers": [{"name": name, "image": "img:1"}],
+                },
+            },
+        },
+    }
+
+
+def available(client, name):
+    ds = client.get("apps/v1", "DaemonSet", name, consts.DEFAULT_NAMESPACE)
+    return (ds.get("status") or {}).get("numberAvailable", 0)
+
+
+def test_per_ds_rollout_stagger(fake_client):
+    """Each DS pulls on its own clock: a slow image doesn't hold up the
+    fast one — the concurrency the relaxed wait chains buy."""
+    fake_client.create(mk_node("n0"))
+    fake_client.create(mk_ds("slow-ds"))
+    fake_client.create(mk_ds("fast-ds"))
+    sim = KubeletSimulator(fake_client, rollout_ticks={"slow-ds": 3, "*": 1})
+    sim.tick()  # clocks start
+    assert available(fake_client, "slow-ds") == 0
+    assert available(fake_client, "fast-ds") == 0
+    sim.tick()
+    assert available(fake_client, "fast-ds") == 1  # 1 tick elapsed
+    assert available(fake_client, "slow-ds") == 0
+    sim.tick()
+    sim.tick()
+    assert available(fake_client, "slow-ds") == 1  # 3 ticks elapsed
+
+
+def test_prepull_credit_starts_the_clock_early(fake_client):
+    """A node stamped with the image-prepull annotation gets pull credit
+    from the tick the stamp was first seen, not from first DS match."""
+    fake_client.create(mk_node("warm", prepull_at=1000.0))
+    fake_client.create(mk_node("cold"))
+    sim = KubeletSimulator(fake_client, rollout_ticks={"*": 3})
+    sim.tick()  # tick 1: warm's stamp noted; no DS yet
+    fake_client.create(mk_ds("plugin-ds"))
+    sim.tick()  # tick 2: clocks start — warm backdated to 1, cold at 2
+    sim.tick()
+    sim.tick()  # tick 4: warm has 3 ticks of credit, cold only 2
+    assert available(fake_client, "plugin-ds") == 1
+    sim.tick()  # tick 5: cold catches up
+    assert available(fake_client, "plugin-ds") == 2
+
+
+def test_generation_bump_resets_pull_clock_without_credit(fake_client):
+    """A template change means a new image: fresh pull from the bump tick,
+    and the prepull stamp (which predates the new image) earns nothing."""
+    fake_client.create(mk_node("warm", prepull_at=1000.0))
+    ds = fake_client.create(mk_ds("plugin-ds"))
+    sim = KubeletSimulator(fake_client, rollout_ticks={"*": 2})
+    for _ in range(3):
+        sim.tick()
+    assert available(fake_client, "plugin-ds") == 1
+    ds = fake_client.get("apps/v1", "DaemonSet", "plugin-ds",
+                         consts.DEFAULT_NAMESPACE)
+    ds["spec"]["template"]["spec"]["containers"][0]["image"] = "img:2"
+    ds = fake_client.update(ds)  # spec change -> generation bump
+    assert ds["metadata"]["generation"] == 2
+    sim.tick()  # rollout restarts: pod outdated, new pull begins
+    assert available(fake_client, "plugin-ds") == 0
+    sim.tick()
+    sim.tick()
+    assert available(fake_client, "plugin-ds") == 1
+
+
+def test_barrier_check_gates_pod_readiness(fake_client):
+    """With barrier_check wired, a DS whose rendered inits wait on a
+    barrier only reports Available once the barrier is written — the sim
+    honors the same ordering guarantee the real init containers enforce."""
+    passed = set()
+    fake_client.create(mk_node("n0"))
+    fake_client.create(mk_ds("gated-ds", inits=[{
+        "name": "driver-validation-wait",
+        "args": ["-c", "wait", "--for=driver", "--status-dir=/x"]}]))
+    sim = KubeletSimulator(fake_client, rollout_ticks={"*": 1},
+                           barrier_check=lambda b: b in passed)
+    for _ in range(4):
+        sim.tick()
+    assert available(fake_client, "gated-ds") == 0  # pulled, but gated
+    passed.add("driver")
+    sim.tick()
+    assert available(fake_client, "gated-ds") == 1
+
+
+def test_gating_barriers_extraction():
+    """Explicit waits and validation-chain stages gate; prewarm-style
+    extras don't."""
+    ds = mk_ds("v", inits=[
+        {"name": "w1", "args": ["-c", "wait", "--for=driver",
+                                "--status-dir=/x"]},
+        {"name": "w2", "args": ["-c", "wait", "--for", "workload"]},
+        {"name": "plugin-validation",
+         "args": ["-c", "plugin", "--resource=google.com/tpu", "--prewarm"]},
+        {"name": "extra", "args": ["-c", "serving"]},
+    ])
+    assert KubeletSimulator._gating_barriers(ds) == [
+        "driver", "workload", "plugin"]
+
+
+def test_legacy_int_rollout_unchanged(fake_client):
+    """Int rollout_ticks keeps the whole-DS (ds, generation) delay the
+    5,000-node scale bench calibrates against: all nodes flip at once."""
+    for i in range(3):
+        fake_client.create(mk_node(f"n{i}", prepull_at=1000.0))
+    fake_client.create(mk_ds("bulk-ds"))
+    sim = KubeletSimulator(fake_client, rollout_ticks=2)
+    sim.tick()
+    assert available(fake_client, "bulk-ds") == 0
+    sim.tick()
+    assert available(fake_client, "bulk-ds") == 0  # seen 2 ticks, need >= 2
+    sim.tick()
+    assert available(fake_client, "bulk-ds") == 3  # all at once, no prepull

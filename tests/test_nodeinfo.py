@@ -110,6 +110,23 @@ def test_label_idempotent(fake_client):
     assert result.labeled == 0  # no second write
 
 
+def test_prepull_annotation_stamped_once_with_labels(fake_client):
+    # first sight of a TPU node stamps the image-prepull annotation in the
+    # SAME patch as the deploy labels (one write), and never re-stamps it
+    fake_client.create(mk_node("tpu-1", GKE_TPU_LABELS))
+    fake_client.create(mk_node("cpu-1"))
+    label_tpu_nodes(fake_client, policy())
+    node = fake_client.get("v1", "Node", "tpu-1")
+    stamp = node["metadata"]["annotations"][consts.IMAGE_PREPULL_ANNOTATION]
+    float(stamp)  # unix-seconds timestamp
+    label_tpu_nodes(fake_client, policy())
+    node = fake_client.get("v1", "Node", "tpu-1")
+    assert node["metadata"]["annotations"][consts.IMAGE_PREPULL_ANNOTATION] == stamp
+    cpu = fake_client.get("v1", "Node", "cpu-1")
+    anns = cpu["metadata"].get("annotations") or {}
+    assert consts.IMAGE_PREPULL_ANNOTATION not in anns
+
+
 def test_cluster_info(fake_client):
     fake_client.create(mk_node("a", runtime="containerd://1.7.13"))
     fake_client.create(mk_node("b", runtime="containerd://1.7.13"))

@@ -8,6 +8,7 @@ from . import (  # noqa: F401
     exception_hygiene,
     lock_discipline,
     metrics_discipline,
+    operand_dag,
     span_discipline,
     unbatched_sweep_write,
     unfenced_write,

@@ -53,6 +53,10 @@ class LintConfig:
     #: docs/operations.md content; None (file absent) disables only the
     #: documented-metric check — registration/cardinality still apply
     docs_text: Optional[str] = None
+    #: manifest template texts keyed by posix relpath (e.g.
+    #: ``tpu_operator/manifests/state-telemetry/0500_daemonset.yaml``);
+    #: None/{} disables the ``operand-dag`` cross-file check
+    manifest_texts: Optional[Dict[str, str]] = None
     #: directory names that mark a file as part of a reconcile path
     reconcile_dirs: Tuple[str, ...] = ("controllers", "state", "upgrade",
                                        "autoscale", "migrate")

@@ -28,6 +28,7 @@ from .core import (
 )
 
 DOCS_RELPATH = os.path.join("docs", "operations.md")
+MANIFESTS_RELPATH = os.path.join("tpu_operator", "manifests")
 #: path fragments never linted: generated protobuf code and caches
 SKIP_PARTS = ("__pycache__", os.path.join("deviceplugin", "proto"))
 
@@ -47,6 +48,24 @@ def iter_py_files(root: str, paths: Iterable[str]) -> List[str]:
                     out.append(os.path.join(dirpath, fn))
     return [f for f in out
             if not any(part in f for part in SKIP_PARTS)]
+
+
+def load_manifest_texts(root: str) -> Dict[str, str]:
+    """Manifest template sources for the operand-dag cross-file check:
+    posix relpath -> text. Empty when the tree has no manifests dir (e.g.
+    fixture trees), which disables only that rule."""
+    out: Dict[str, str] = {}
+    mdir = os.path.join(root, MANIFESTS_RELPATH)
+    for dirpath, dirnames, filenames in os.walk(mdir):
+        dirnames.sort()
+        for fn in sorted(filenames):
+            if not fn.endswith((".yaml", ".yml", ".j2")):
+                continue
+            full = os.path.join(dirpath, fn)
+            rel = os.path.relpath(full, root).replace(os.sep, "/")
+            with open(full, encoding="utf-8") as fh:
+                out[rel] = fh.read()
+    return out
 
 
 def lint_file(path: str, root: str, checkers: List[Checker],
@@ -88,7 +107,8 @@ def run(root: str, paths: Iterable[str],
     if os.path.exists(docs_file):
         with open(docs_file, encoding="utf-8") as fh:
             docs_text = fh.read()
-    config = LintConfig(root=root, docs_text=docs_text)
+    config = LintConfig(root=root, docs_text=docs_text,
+                        manifest_texts=load_manifest_texts(root))
 
     findings: List[Finding] = []
     suppressed_total = 0

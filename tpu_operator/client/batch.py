@@ -180,8 +180,17 @@ class WriteBatcher(Client):
         self._read = read
 
     def _read_obj(self, api_version: str, kind: str, name: str,
-                  namespace: Optional[str]) -> dict:
+                  namespace: Optional[str], authoritative: bool = False) -> dict:
+        """Base read for a deferred build. The first attempt reads through
+        the informer cache (free); ``authoritative`` bypasses it — after a
+        409 the cache has DEMONSTRABLY lagged the competing writer (e.g. a
+        kubelet's status bump racing a label flush), and re-reading the
+        same stale resourceVersion just burns the whole retry budget. At
+        fleet scale that was the difference between one extra GET per
+        conflict and ~0.8 s of doomed retries per node per flush."""
         reader = self._read if self._read is not None else self.inner
+        if authoritative:
+            reader = self.inner
         return reader.get(api_version, kind, name, namespace)
 
     def defer_patch(self, api_version: str, kind: str, name: str,
@@ -270,10 +279,14 @@ class WriteBatcher(Client):
         last_conflict: Optional[ConflictError] = None
         for attempt in range(self._attempts):
             if attempt:
-                # let the write-through cache observe the competing write
+                # brief yield, then re-read AUTHORITATIVELY below: waiting
+                # for the cache to observe the competing write is hopeless
+                # under sustained contention (a kubelet sweep bumping every
+                # node's status lags the watch by more than the backoff)
                 self._sleep(min(0.25, 0.02 * (2 ** attempt)))
             base = self._read_obj(pending.api_version, pending.kind,
-                                  pending.name, pending.namespace)
+                                  pending.name, pending.namespace,
+                                  authoritative=attempt > 0)
             working = copy.deepcopy(base)
             merged: dict = {}
             for build in pending.builds:

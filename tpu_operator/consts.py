@@ -100,6 +100,13 @@ WORKLOAD_HEALTH_ANNOTATION = "tpu.ai/workload-health"
 #: can stitch node-side spans into the end-to-end join trace. Bounded to
 #: joinprofile.records.MAX_ANNOTATION_BYTES encoded bytes, newest-first.
 TRACE_SPANS_ANNOTATION = "tpu.ai/trace-spans"
+#: unix-seconds stamp (string) the labeler writes the FIRST time it sees a
+#: TPU node, riding the same coalesced label patch. Kubelets (and the sim)
+#: treat it as "start pulling operand images now": by the time the operand
+#: DaemonSets schedule their pods the layers are already local, so the
+#: image-pull tile drops off the join critical path. JoinProfiler reads it
+#: back to attribute the pre-pull window in the join trace.
+IMAGE_PREPULL_ANNOTATION = "tpu.ai/image-prepull"
 
 # -- coordinated drain/handoff (planned re-tiles) ------------------------------
 #: a published re-tile/remediation plan (JSON: layout fingerprint, drain

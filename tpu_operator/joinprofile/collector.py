@@ -109,6 +109,7 @@ class JoinProfiler:
                 if rec is None:
                     rec = {"first_seen": now, "schedulable_at": None,
                            "completed_at": None, "pending_until": now,
+                           "prepull_at": None,
                            "records": [], "post_sweeps": 0, "emitted": False}
                     self._nodes[name] = rec
                     while len(self._nodes) > self.max_nodes:
@@ -118,6 +119,16 @@ class JoinProfiler:
                     consts.TPU_RESOURCE_NAME) is not None
                 if schedulable and rec["schedulable_at"] is None:
                     rec["schedulable_at"] = now
+                if rec["prepull_at"] is None:
+                    # labeler's pre-pull stamp: background pulls started
+                    # here, long before any DS pod scheduled
+                    stamp = deep_get(node, "metadata", "annotations",
+                                     consts.IMAGE_PREPULL_ANNOTATION)
+                    if stamp is not None:
+                        try:
+                            rec["prepull_at"] = float(stamp)
+                        except (TypeError, ValueError):
+                            pass
                 mirrored = decode_annotation(deep_get(
                     node, "metadata", "annotations",
                     consts.TRACE_SPANS_ANNOTATION))
@@ -190,6 +201,15 @@ class JoinProfiler:
         intervals = list(operator_intervals) + node_intervals
         if rollout_end > start:
             intervals.append(("ds-rollout-wait", start, rollout_end))
+        # background image pre-pulls run from the labeler's stamp until
+        # the node turns schedulable (the plugin DS pod is up — pulls are
+        # done by then); higher priority than the rollout tile, lower than
+        # any node-side span, so "waiting" honestly reads as "pulling"
+        prepull_at = rec.get("prepull_at")
+        if prepull_at is not None:
+            prepull_end = rec["schedulable_at"] or rollout_end
+            if prepull_end > prepull_at:
+                intervals.append(("image-prepull", prepull_at, prepull_end))
         attribution = attribute(intervals, (start, end))
         return {
             "node": name,

@@ -25,6 +25,10 @@ PHASES = (
     "barrier-handshake",
     "validation-run",
     "serving-probe",
+    # background image pre-pulls (labeler stamp -> node schedulable): less
+    # specific than any node-side span but a better explanation than a
+    # bare rollout wait — the kubelet IS doing useful join work
+    "image-prepull",
     "ds-rollout-wait",
     "reconcile",
     "other",
@@ -36,6 +40,9 @@ _PRIORITY = {p: i for i, p in enumerate(PHASES)}
 _NAME_RULES: Tuple[Tuple[str, str], ...] = (
     ("xla-compile", "xla-compile"),
     ("compile", "xla-compile"),
+    # "prepull" before the generic "pull" fragment, or pre-pull spans
+    # would be mislabeled as foreground pulls
+    ("prepull", "image-prepull"),
     ("image-pull", "image-pull"),
     ("pull", "image-pull"),
     # rollout before the generic "wait": "ds-rollout-wait" is a rollout

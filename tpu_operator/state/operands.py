@@ -13,7 +13,7 @@ State order mirrors the reference's registration order
 from __future__ import annotations
 
 import os
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from .. import consts, tracing
 from ..api.clusterpolicy import ClusterPolicy
@@ -31,6 +31,38 @@ from .manager import (
     StateResult,
 )
 from .skel import StateSkel, SyncState
+
+
+#: The operand dependency DAG: state name -> validation barriers its pods
+#: gate on (rendered as ``wait_for`` init containers). This is the single
+#: source of truth for join-path serialization — templates loop over
+#: ``wait_barriers`` instead of hard-coding waits, the opalint
+#: ``operand-dag`` rule flags any template wait not declared here, and the
+#: kubelet simulator gates DS availability on exactly these barriers.
+#:
+#: Only REAL data dependencies appear. The device plugin mounts libtpu
+#: into workloads and the partitioner re-tiles live chips, so both need
+#: the driver barrier; the serving probe certifies a node the whole stack
+#: already validated, so it needs the workload barrier. Telemetry, feature
+#: discovery, and the node-status exporter are node-scoped observers —
+#: they read status files and sysfs, not libtpu — so they carry NO
+#: barrier and roll concurrently with the driver (the pipelined join).
+#: The validator state is its own chain (driver -> plugin -> workload
+#: init containers), not a wait_for consumer.
+#:
+#: Kept a pure literal: the opalint rule reads it via ast.literal_eval.
+OPERAND_DAG: Dict[str, Tuple[str, ...]] = {
+    "state-device-plugin": ("driver",),
+    "state-slice-partitioner": ("driver",),
+    "state-operator-serving": ("workload",),
+    "state-operator-validation": (),
+    "state-telemetry": (),
+    "state-feature-discovery": (),
+    "state-node-status-exporter": (),
+    "state-operator-metrics": (),
+    "state-driver": (),
+    "state-multihost-validation": (),
+}
 
 
 def stamp_operator_meta(objs: List[dict], policy: ClusterPolicy) -> List[dict]:
@@ -150,6 +182,10 @@ class OperandState:
             "validator_image": (policy.spec.operator.init_container_image()
                                 or policy.spec.validator.image_path()),
             "wait_pull_policy": policy.spec.operator.init_container_pull_policy(),
+            # declared DAG parents only: templates render one wait_for init
+            # container per entry, so a template cannot re-serialize the
+            # join without editing OPERAND_DAG (and the golden + DAG tests)
+            "wait_barriers": list(OPERAND_DAG.get(self.name, ())),
             "daemonsets": {
                 "update_strategy": policy.spec.daemonsets.update_strategy,
                 "rolling_update": policy.spec.daemonsets.rolling_update,
@@ -209,12 +245,19 @@ class PrerequisitesState(OperandState):
 
 
 def _duration_seconds(value: str) -> float:
-    """'500ms' | '60s' | '5m' | '1h' -> seconds (spec duration strings)."""
+    """'500ms' | '60s' | '1.5s' | '5m' | '1h' -> seconds (spec duration
+    strings). Fractional mantissas are valid spec values ("1.5s"); the
+    suffix check must come first ("ms" before "s", insertion order) so
+    "500ms" is not read as 500 minutes-of-s."""
+    s = str(value)
     units = {"ms": 0.001, "s": 1.0, "m": 60.0, "h": 3600.0}
     for suffix, mult in units.items():
-        if str(value).endswith(suffix) and str(value)[:-len(suffix)].isdigit():
-            return int(str(value)[:-len(suffix)]) * mult
-    return float(value)
+        if s.endswith(suffix):
+            try:
+                return float(s[:-len(suffix)]) * mult
+            except ValueError:
+                continue  # e.g. "abcs": fall through to the bare parse
+    return float(s)
 
 
 def feature_discovery_extras(policy: ClusterPolicy) -> dict:

@@ -16,7 +16,7 @@ from __future__ import annotations
 import copy
 import logging
 import threading
-from typing import Callable, Optional
+from typing import Callable, Dict, List, Optional, Tuple, Union
 
 import requests
 
@@ -32,13 +32,32 @@ log = logging.getLogger(__name__)
 class KubeletSimulator:
     def __init__(self, client: Client, namespace: str = consts.DEFAULT_NAMESPACE,
                  chips_per_node: int = 4, interval: float = 0.05,
-                 rollout_ticks: int = 0, create_pods: bool = False,
-                 validation_exec: Optional[Callable[[dict], int]] = None):
+                 rollout_ticks: Union[int, Dict[str, int]] = 0,
+                 create_pods: bool = False,
+                 validation_exec: Optional[Callable[[dict], int]] = None,
+                 barrier_check: Optional[Callable[[str], bool]] = None):
         self.client = client
         self.namespace = namespace
         self.chips_per_node = chips_per_node
         self.interval = interval
-        self.rollout_ticks = rollout_ticks  # ticks a DS stays unavailable first
+        #: int: legacy whole-DS delay — every DS is unavailable for this
+        #: many ticks after each generation, counted from DS creation.
+        #: dict: per-DS IMAGE-PULL model ({ds_name: ticks, "*": default}).
+        #: Each (DS, node) gets its own pull clock that starts when the DS
+        #: first matches the node — or EARLIER, at the node's
+        #: ``tpu.ai/image-prepull`` stamp, modeling a kubelet that began
+        #: pulling at registration. A generation bump restarts the clock
+        #: (new image, no prepull credit). This is what lets the join
+        #: bench measure pipelining: independent DSes pull concurrently
+        #: instead of serializing behind wait chains.
+        self.rollout_ticks = rollout_ticks
+        #: opt-in barrier gating for per-DS mode: called with each barrier
+        #: name extracted from the DS's rendered wait/validation init
+        #: containers (``-c wait --for=X`` -> X; ``-c driver|plugin|
+        #: workload`` -> that component); the pod only reports Available
+        #: once every gate returns True. None (default, and the scale
+        #: bench) skips gating — there are no node agents writing barriers.
+        self.barrier_check = barrier_check
         #: create one pod per (DS, node) with real DS-controller semantics:
         #: RollingUpdate replaces outdated pods automatically, OnDelete only
         #: recreates after someone (e.g. the upgrade machine) deletes them
@@ -53,6 +72,11 @@ class KubeletSimulator:
         #: these nodes, the sim double of `tpuop-validator -c migrate-agent`
         self._migrate_agents: dict = {}
         self._seen: dict = {}
+        #: per-DS pull model state (dict rollout_ticks only)
+        self._tick_count = 0
+        self._pull_start: Dict[Tuple[str, str], int] = {}  # (ds, node) -> tick
+        self._pod_gen: Dict[Tuple[str, str], object] = {}
+        self._prepull: Dict[str, int] = {}  # node -> tick its stamp was seen
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -99,6 +123,10 @@ class KubeletSimulator:
         nodes = self.client.list("v1", "Node")
         self._complete_validation_pods()
         self._run_migrate_agents()
+        self._tick_count += 1
+        per_node = isinstance(self.rollout_ticks, dict)
+        if per_node:
+            self._note_prepull(nodes)
         for ds in self.client.list("apps/v1", "DaemonSet", self.namespace):
             selector = deep_get(ds, "spec", "template", "spec", "nodeSelector", default={})
             matching = [n for n in nodes if node_matches_selector(n, selector)]
@@ -106,8 +134,12 @@ class KubeletSimulator:
             key = (ds["metadata"]["name"], ds["metadata"].get("generation"))
             ticks = self._seen.get(key, 0)
             self._seen[key] = ticks + 1
+            ready_nodes = matching
             if self.create_pods:
                 available, updated = self._reconcile_ds_pods(ds, matching)
+            elif per_node:
+                ready_nodes = [n for n in matching if self._node_ready(ds, n)]
+                available = updated = len(ready_nodes)
             else:
                 available = desired if ticks >= self.rollout_ticks else 0
                 updated = desired if ticks >= self.rollout_ticks else available
@@ -123,8 +155,74 @@ class KubeletSimulator:
                 ds["status"] = status
                 self.client.update_status(ds)
             if available and self._is_device_plugin(ds):
-                for node in matching:
+                for node in ready_nodes:
                     self._register_tpus(node)
+
+    def _note_prepull(self, nodes: List[dict]) -> None:
+        """Record the tick at which each node's pre-pull stamp first became
+        visible — the moment a real kubelet would have started pulling."""
+        for node in nodes:
+            name = node["metadata"]["name"]
+            if name in self._prepull:
+                continue
+            ann = deep_get(node, "metadata", "annotations", default={}) or {}
+            if consts.IMAGE_PREPULL_ANNOTATION in ann:
+                self._prepull[name] = self._tick_count
+
+    def _node_ready(self, ds: dict, node: dict) -> bool:
+        """Per-DS pull model: is this (DS, node) pod pulled AND past its
+        barrier gates?"""
+        assert isinstance(self.rollout_ticks, dict)
+        ds_name = ds["metadata"]["name"]
+        gen = ds["metadata"].get("generation")
+        nname = node["metadata"]["name"]
+        key = (ds_name, nname)
+        prior_gen = self._pod_gen.get(key)
+        if key not in self._pull_start or prior_gen != gen:
+            self._pod_gen[key] = gen
+            if prior_gen is None:
+                # first generation on this node: prepull credit — the pull
+                # started when the labeler's stamp landed, not when the DS
+                # scheduled the pod
+                self._pull_start[key] = self._prepull.get(nname, self._tick_count)
+            else:
+                # template changed: new image, fresh pull, no credit
+                self._pull_start[key] = self._tick_count
+        need = self.rollout_ticks.get(
+            ds_name, self.rollout_ticks.get("*", 0))
+        if self._tick_count - self._pull_start[key] < need:
+            return False
+        if self.barrier_check is not None:
+            for barrier in self._gating_barriers(ds):
+                if not self.barrier_check(barrier):
+                    return False
+        return True
+
+    @staticmethod
+    def _gating_barriers(ds: dict) -> List[str]:
+        """Extract the barrier names a DS's rendered init containers gate
+        on: explicit waits (``-c wait --for=X``) and validation-chain
+        stages that block until their own barrier is written (``-c
+        driver|plugin|workload``). Other inits (prewarm, serving) don't
+        gate pod readiness here."""
+        barriers: List[str] = []
+        inits = deep_get(ds, "spec", "template", "spec", "initContainers",
+                         default=[]) or []
+        for container in inits:
+            args = [str(a) for a in (container.get("args") or [])]
+            comp = None
+            for i, a in enumerate(args):
+                if a == "-c" and i + 1 < len(args):
+                    comp = args[i + 1]
+            if comp == "wait":
+                for i, a in enumerate(args):
+                    if a.startswith("--for="):
+                        barriers.append(a.split("=", 1)[1])
+                    elif a == "--for" and i + 1 < len(args):
+                        barriers.append(args[i + 1])
+            elif comp in ("driver", "plugin", "workload"):
+                barriers.append(comp)
+        return barriers
 
     def _reconcile_ds_pods(self, ds: dict, matching_nodes: list) -> tuple:
         """DS-controller + kubelet roles for one DaemonSet; returns

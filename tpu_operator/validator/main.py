@@ -11,6 +11,9 @@ plugin              wait for the TPU extended resource on this node
 workload            spawn allreduce pod via device plugin; write barrier
 workload-local      run the ICI health sweep in-process (inside the pod)
 workload-multihost  slice-wide sweep after jax.distributed rendezvous
+prewarm             compile the ICI sweep into the persistent XLA cache
+                    (never blocks: a failed warm-up just means the real
+                    sweep pays the cold compile)
 perf                measured MXU TFLOP/s, HBM GB/s, ICI allreduce GB/s;
                     optional floors turn it into a gate (no reference
                     analog — DCGM diag is functional-only)
@@ -52,7 +55,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-c", "--component", required=True,
                    choices=["driver", "driver-daemon", "driver-probe", "plugin",
                             "workload", "workload-local", "workload-multihost",
-                            "perf", "serving", "wait", "sleep", "metrics",
+                            "prewarm", "perf", "serving", "wait", "sleep", "metrics",
                             "telemetry", "feature-discovery",
                             "slice-partitioner", "device-plugin", "cdi",
                             "migrate-agent", "info"])
@@ -65,6 +68,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--resource", default=consts.TPU_RESOURCE_NAME)
     p.add_argument("--for", dest="wait_for", default="driver", help="barrier to wait on")
     p.add_argument("--timeout", type=float, default=300.0)
+    p.add_argument("--poll", type=float, default=None,
+                   help="plugin: resource poll period in seconds (default "
+                        "5, the reference cadence); joins racing a "
+                        "sub-10 s budget need finer granularity")
+    p.add_argument("--prewarm", action="store_true",
+                   help="plugin: warm the persistent XLA compile cache in "
+                        "a background thread while polling for the "
+                        "resource — the poll blocks on the device-plugin "
+                        "DS rollout anyway, so the cold compile rides a "
+                        "wait window instead of adding a serial init "
+                        "container to the join critical path")
     p.add_argument("--port", type=int, default=8000)
     p.add_argument("--sleep-interval", type=float, default=60.0)
     p.add_argument("--revalidate-interval", type=float,
@@ -270,13 +284,55 @@ def _dispatch(args, status, client) -> int:
         return 0 if driver.probe(args.install_dir, require_devices) else 1
 
     if component == "plugin":
+        import threading
+
+        import time as _time
+
         from . import plugin
 
         client = client or make_client()
+        # concurrent cache prewarm: the resource poll below blocks on the
+        # device-plugin DS rolling out, so the cold XLA compile runs in
+        # this thread's shadow instead of as its own serial init container
+        warm: dict = {}
+
+        def _prewarm() -> None:
+            from .workload import prewarm_compile_cache
+
+            warm["start"] = _time.time()
+            try:
+                warm["result"] = prewarm_compile_cache(
+                    matrix_dim=args.matrix_dim)
+            except Exception as e:  # never fail the plugin gate over a warm-up
+                warm["error"] = str(e)
+
+        warm_thread = None
+        if args.prewarm:
+            warm_thread = threading.Thread(target=_prewarm, daemon=True,
+                                           name="prewarm-compile")
+            warm_thread.start()
         with tracing.span("plugin.validate", resource=args.resource) as sp:
+            kwargs = {} if args.poll is None else {"poll": args.poll}
             ok = plugin.validate(client, resource=args.resource, status=status,
-                                 timeout=args.timeout)
+                                 timeout=args.timeout, **kwargs)
             sp.set_attribute("passed", ok)
+        if warm_thread is not None:
+            # bounded join: an exited poll must not hang behind a wedged
+            # compile — the real sweep would just pay the cold compile
+            warm_thread.join(timeout=max(30.0, args.timeout))
+            result = warm.get("result")
+            if result:
+                # pre-measured span (recorded from this thread: the tracer
+                # context is thread-local) so attribution sees the compile
+                tracing.record_span("xla-compile", warm["start"],
+                                    result["compile_s"])
+                log.info("compile cache warmed in %.2fs (%s), inside the "
+                         "plugin poll window", result["compile_s"],
+                         result["cache_dir"])
+            elif "error" in warm:
+                log.warning("concurrent prewarm failed (%s); first "
+                            "validation pays the cold compile",
+                            warm["error"])
         return 0 if ok else 1
 
     if component == "workload":
@@ -290,6 +346,17 @@ def _dispatch(args, status, client) -> int:
             log.error("workload: NODE_NAME and VALIDATOR_IMAGE required")
             return 1
         import time as _time
+
+        # open the control-plane handshake BEFORE spawning: a status
+        # record (NOT the workload barrier — is_ready treats a pending
+        # record as satisfied, so waiters must never see one under the
+        # barrier name) plus an early span flush, so feature discovery
+        # can mirror the in-progress handshake up while the workload pod
+        # is still pulling its image instead of only after the verdict
+        with tracing.span("workload.handshake", node=node_name):
+            status.write("workload-handshake",
+                         {"node": node_name, "phase": "spawning"})
+        tracing.flush_spans()
 
         spawn_start = _time.time()
         with tracing.span("workload.spawn-pod", node=node_name) as sp:
@@ -337,6 +404,36 @@ def _dispatch(args, status, client) -> int:
         # after its first pass keeps taking work forever
         status.write("workload", report.to_dict())
         return 0 if report.passed else 1
+
+    if component == "prewarm":
+        from .workload import prewarm_compile_cache
+
+        import time as _time
+
+        warm_start = _time.time()
+        with tracing.span("prewarm.compile", matrix_dim=args.matrix_dim) as sp:
+            try:
+                result = prewarm_compile_cache(matrix_dim=args.matrix_dim)
+            except Exception as e:
+                # prewarm is an optimisation: a failed warm-up must never
+                # block the init chain — the real sweep just pays the cold
+                # compile it would have paid anyway
+                log.warning("compile-cache prewarm failed (%s); first "
+                            "validation pays the cold compile", e)
+                sp.set_attribute("failed", True)
+                return 0
+            if result is None:
+                log.info("prewarm skipped: TPU_COMPILATION_CACHE_DIR unset")
+                sp.set_attribute("skipped", True)
+                return 0
+            sp.set_attribute("compile_s", result["compile_s"])
+            # pre-measured child span so the sweep-line attributes this
+            # window as xla-compile, same as the in-sweep compile
+            tracing.record_span("xla-compile", warm_start,
+                                result["compile_s"])
+        log.info("compile cache warmed in %.2fs (%s)",
+                 result["compile_s"], result["cache_dir"])
+        return 0
 
     if component == "workload-multihost":
         from .workload import run_multihost

@@ -73,14 +73,13 @@ class IciCheckReport:
         return dataclasses.asdict(self)
 
 
-def ici_health_check(matrix_dim: int = 512, devices=None) -> IciCheckReport:
-    """Run the 4-way ICI/MXU health sweep over all (or given) local devices.
+def _build_sweep(matrix_dim: int, devices):
+    """(jitted sweep fn, sharded ids array, n) for the 4-way sweep below.
 
-    Multi-process safe: the input is a global sharded array (each process
-    contributes only its addressable shards) and the output is fully
-    replicated via an in-program all_gather, so every process can fetch the
-    complete per-chip result matrix.
-    """
+    Shared by :func:`ici_health_check` and :func:`prewarm_compile_cache`
+    so both lower the IDENTICAL program — the prewarm's persistent-cache
+    entry is only useful if its cache key matches the one the real
+    validation will look up."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -91,11 +90,8 @@ def ici_health_check(matrix_dim: int = 512, devices=None) -> IciCheckReport:
     except AttributeError:
         from jax.experimental.shard_map import shard_map
 
-    enable_compilation_cache()
-    devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
     mesh = Mesh(devices, ("chips",))
-    start = time.monotonic()
 
     def per_chip(ids):
         # ids: (1,) int32 — this chip's ordinal
@@ -129,6 +125,47 @@ def ici_health_check(matrix_dim: int = 512, devices=None) -> IciCheckReport:
     ids_host = np.arange(n, dtype=np.int32)
     ids = jax.make_array_from_callback(
         (n,), NamedSharding(mesh, P("chips")), lambda idx: ids_host[idx])
+    return check, ids, n
+
+
+def prewarm_compile_cache(matrix_dim: int = 512, devices=None):
+    """Compile (never run) the ICI sweep into the persistent XLA cache.
+
+    The ``cache-prewarm`` init container runs this right after the driver
+    barrier, while the plugin validation would only be polling for the
+    extended resource — so the cold compile overlaps a wait window and the
+    workload sweep that actually gates node join finds a warm cache.
+    Returns ``{"cache_dir", "compile_s", "n_devices"}``, or None when no
+    cache dir is configured (nothing would persist, so nothing to warm)."""
+    cache_dir = enable_compilation_cache()
+    if cache_dir is None:
+        return None
+    import jax
+
+    devices = list(devices if devices is not None else jax.devices())
+    check, ids, n = _build_sweep(matrix_dim, devices)
+    t0 = time.monotonic()
+    check.lower(ids).compile()
+    return {"cache_dir": cache_dir,
+            "compile_s": round(time.monotonic() - t0, 4),
+            "n_devices": n}
+
+
+def ici_health_check(matrix_dim: int = 512, devices=None) -> IciCheckReport:
+    """Run the 4-way ICI/MXU health sweep over all (or given) local devices.
+
+    Multi-process safe: the input is a global sharded array (each process
+    contributes only its addressable shards) and the output is fully
+    replicated via an in-program all_gather, so every process can fetch the
+    complete per-chip result matrix.
+    """
+    import jax
+    import numpy as np
+
+    enable_compilation_cache()
+    devices = list(devices if devices is not None else jax.devices())
+    start = time.monotonic()
+    check, ids, n = _build_sweep(matrix_dim, devices)
     # AOT split so compile_s really is trace+lower+compile (incl. any
     # persistent-cache hit), not setup time with the compile smeared into
     # the first execution
