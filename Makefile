@@ -19,8 +19,14 @@ lint:  ## ruff (when installed) then opalint; fails on any non-baselined finding
 	fi
 	$(PYTHON) -m tpu_operator.cmd.lint
 
+LINT_CHANGED_REF ?= HEAD
+
+.PHONY: lint-changed
+lint-changed:  ## incremental opalint: lint only files changed vs LINT_CHANGED_REF (default HEAD; PR CI passes the merge base) — the whole-program graph still covers the full tree
+	$(PYTHON) -m tpu_operator.cmd.lint --changed=$(LINT_CHANGED_REF)
+
 .PHONY: lint-baseline
-lint-baseline:  ## regenerate .opalint-baseline.json from the current tree (deliberate act — review the diff)
+lint-baseline:  ## regenerate .opalint-baseline.json from the current tree, pruning stale entries (deliberate act — review the diff)
 	$(PYTHON) -m tpu_operator.cmd.lint --write-baseline
 
 CHAOS_SEED ?= 1729

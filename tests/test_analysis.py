@@ -7,12 +7,14 @@ import ast
 import io
 import json
 import os
+import subprocess
 import textwrap
 from pathlib import Path
 
 import pytest
 
 from tpu_operator.analysis import baseline as baseline_mod
+from tpu_operator.analysis import graph as graph_mod
 from tpu_operator.analysis.core import (
     FileContext,
     LintConfig,
@@ -733,6 +735,385 @@ def test_operand_dag_suppressed():
     assert kept == [] and dropped == 1
 
 
+# -- graph-backed rules (opalint v2) ------------------------------------------
+# These lint one file WITH a whole-program project built from in-memory
+# sources; the bare lint() helper (no project) must leave them silent.
+
+def lint_in_project(sources, relpath, rule, docs_text=None):
+    srcs = {k: textwrap.dedent(v) for k, v in sources.items()}
+    config = LintConfig(docs_text=docs_text)
+    project = graph_mod.build_from_sources(srcs, config)
+    src = srcs[relpath]
+    ctx = FileContext(relpath, src, ast.parse(src), config, project=project)
+    found = list(all_checkers()[rule]().check(ctx))
+    return apply_suppressions(found, suppressions(src))
+
+
+GRAPH_RULES = ("annotation-registry", "deadline-propagation",
+               "exactly-once-event", "lock-order-inversion",
+               "state-before-actuation")
+
+
+@pytest.mark.parametrize("rule", GRAPH_RULES)
+def test_graph_rules_silent_without_project(rule):
+    # isolated single-file lint has no ProjectContext: degrade to silence
+    src = """
+        import urllib.request
+
+        KEY = "tpu.ai/raw-key"
+
+        def reconcile(req):
+            urllib.request.urlopen("http://x")
+    """
+    kept, dropped = lint(src, "tpu_operator/controllers/x.py", rule)
+    assert kept == [] and dropped == 0
+
+
+# -- annotation-registry ------------------------------------------------------
+
+REGISTRY_CONSTS = 'DRAIN_LABEL = "tpu.ai/drain"\n'
+
+
+def test_annotation_registry_positive_known_and_unknown_literal():
+    kept, _ = lint_in_project({
+        "tpu_operator/consts.py": REGISTRY_CONSTS,
+        "tpu_operator/controllers/drain.py":
+            'KEY = "tpu.ai/drain"\nOTHER = "tpu.ai/unregistered"\n',
+    }, "tpu_operator/controllers/drain.py", "annotation-registry")
+    assert rules_of(kept) == ["annotation-registry"] * 2
+    assert "use consts.DRAIN_LABEL" in kept[0].message
+    assert "add a named constant" in kept[1].message
+
+
+def test_annotation_registry_negative_api_version_and_prose():
+    kept, _ = lint_in_project({
+        "tpu_operator/consts.py": REGISTRY_CONSTS,
+        "tpu_operator/api/types.py": """
+            API_VERSION = "tpu.ai/v1alpha1"
+            GROUP_V1 = "tpu.ai/v1"
+            HELP = "set the tpu.ai/drain annotation to request a drain"
+        """,
+    }, "tpu_operator/api/types.py", "annotation-registry")
+    assert kept == []  # group/version strings + prose mentions exempt
+
+
+def test_annotation_registry_docs_check_in_registry_module():
+    sources = {"tpu_operator/consts.py": REGISTRY_CONSTS}
+    # documented: clean
+    kept, _ = lint_in_project(sources, "tpu_operator/consts.py",
+                              "annotation-registry",
+                              docs_text="| `tpu.ai/drain` | drain request |")
+    assert kept == []
+    # undocumented: flagged at the definition
+    kept, _ = lint_in_project(sources, "tpu_operator/consts.py",
+                              "annotation-registry",
+                              docs_text="no registry table here")
+    assert rules_of(kept) == ["annotation-registry"]
+    assert "missing from" in kept[0].message
+    # no docs file at all disables only the doc half
+    kept, _ = lint_in_project(sources, "tpu_operator/consts.py",
+                              "annotation-registry", docs_text=None)
+    assert kept == []
+
+
+def test_annotation_registry_suppressed():
+    kept, dropped = lint_in_project({
+        "tpu_operator/consts.py": REGISTRY_CONSTS,
+        "tpu_operator/controllers/drain.py":
+            'KEY = "tpu.ai/drain"  '
+            '# opalint: disable=annotation-registry — migration shim\n',
+    }, "tpu_operator/controllers/drain.py", "annotation-registry")
+    assert kept == [] and dropped == 1
+
+
+# -- state-before-actuation ---------------------------------------------------
+
+AUTOSCALE_CONSTS = ('AUTOSCALE_STATE_ANNOTATION = "tpu.ai/autoscale-state"\n'
+                    'MIGRATION_STATE_ANNOTATION = "tpu.ai/migration-state"\n')
+
+ACTUATE_BODY_TEMPLATE = """
+    from .. import consts
+
+    class Reconciler:
+        def reconcile(self, client):
+            {body}
+
+        def _persist(self, client):
+            client.preconditioned_patch(
+                "v1", "Node", "n",
+                {{"metadata": {{"annotations": {{
+                    consts.AUTOSCALE_STATE_ANNOTATION: "x"}}}}}})
+
+        def _scale_up(self, client):
+            client.create({{"kind": "Node"}})
+"""
+
+
+def _actuation_tree(body):
+    return {
+        "tpu_operator/consts.py": AUTOSCALE_CONSTS,
+        "tpu_operator/autoscale/controller.py":
+            ACTUATE_BODY_TEMPLATE.format(body=body),
+    }
+
+
+def test_state_before_actuation_positive_direct():
+    kept, _ = lint_in_project(
+        _actuation_tree('client.create({"kind": "Node"})\n'
+                        '            self._persist(client)'),
+        "tpu_operator/autoscale/controller.py", "state-before-actuation")
+    assert rules_of(kept) == ["state-before-actuation"]
+    assert "actuates" in kept[0].message
+    assert "client.create" in kept[0].line_text
+
+
+def test_state_before_actuation_positive_through_helper():
+    # the actuation hides one call deep; the summary propagates UNSAFE up,
+    # so both the helper's own create site AND the caller's call site are
+    # reported — each needs its own fix or suppression
+    kept, _ = lint_in_project(
+        _actuation_tree('self._scale_up(client)\n'
+                        '            self._persist(client)'),
+        "tpu_operator/autoscale/controller.py", "state-before-actuation")
+    assert rules_of(kept) == ["state-before-actuation"] * 2
+    msgs = " | ".join(f.message for f in kept)
+    assert "Reconciler._scale_up actuates" in msgs
+    assert "Reconciler.reconcile actuates" in msgs
+
+
+def test_state_before_actuation_negative_persist_first_and_events():
+    # persisting (or loading) the durable state first makes actuation legal
+    kept, _ = lint_in_project(
+        _actuation_tree('self._persist(client)\n'
+                        '            client.create({"kind": "Node"})'),
+        "tpu_operator/autoscale/controller.py", "state-before-actuation")
+    assert kept == []
+    # Event creation is an announcement, not actuation
+    kept, _ = lint_in_project(
+        _actuation_tree('events.create(client, "Scaled")\n'
+                        '            self._persist(client)'),
+        "tpu_operator/autoscale/controller.py", "state-before-actuation")
+    assert kept == []
+
+
+def test_state_before_actuation_out_of_scope_dir():
+    # same shape outside the reconcile dirs (a cmd/ tool): out of scope
+    tree = _actuation_tree('client.create({"kind": "Node"})\n'
+                           '            self._persist(client)')
+    tree["tpu_operator/cmd/tool.py"] = tree.pop(
+        "tpu_operator/autoscale/controller.py")
+    kept, _ = lint_in_project(tree, "tpu_operator/cmd/tool.py",
+                              "state-before-actuation")
+    assert kept == []
+
+
+def test_state_before_actuation_suppressed():
+    kept, dropped = lint_in_project(
+        _actuation_tree(
+            '# create-first is proven safe here by the crash matrix\n'
+            '            # opalint: disable=state-before-actuation\n'
+            '            client.create({"kind": "Node"})\n'
+            '            self._persist(client)'),
+        "tpu_operator/autoscale/controller.py", "state-before-actuation")
+    assert kept == [] and dropped == 1
+
+
+# -- deadline-propagation -----------------------------------------------------
+
+DEADLINE_ENTRY = """
+    from ..validator import probe
+
+    def reconcile(req):
+        return probe.check()
+"""
+
+DEADLINE_HELPER = """
+    import urllib.request
+
+    def check():
+        return urllib.request.urlopen("http://node:8080/healthz")
+"""
+
+
+def test_deadline_propagation_positive_with_chain():
+    kept, _ = lint_in_project({
+        "tpu_operator/controllers/sync.py": DEADLINE_ENTRY,
+        "tpu_operator/validator/probe.py": DEADLINE_HELPER,
+    }, "tpu_operator/validator/probe.py", "deadline-propagation")
+    assert rules_of(kept) == ["deadline-propagation"]
+    # the sample chain names both ends of the path
+    assert "tpu_operator.controllers.sync:reconcile" in kept[0].message
+    assert "tpu_operator.validator.probe:check" in kept[0].message
+
+
+def test_deadline_propagation_negative_timeout_and_unreachable():
+    # explicit timeout: fine
+    kept, _ = lint_in_project({
+        "tpu_operator/controllers/sync.py": DEADLINE_ENTRY,
+        "tpu_operator/validator/probe.py": DEADLINE_HELPER.replace(
+            '"http://node:8080/healthz"',
+            '"http://node:8080/healthz", timeout=3'),
+    }, "tpu_operator/validator/probe.py", "deadline-propagation")
+    assert kept == []
+    # not reachable from any reconcile entrypoint: out of scope
+    kept, _ = lint_in_project({
+        "tpu_operator/validator/probe.py": DEADLINE_HELPER,
+    }, "tpu_operator/validator/probe.py", "deadline-propagation")
+    assert kept == []
+
+
+def test_deadline_propagation_prunes_at_client_stack():
+    # a chain routed through client/ inherits the stack's deadline budget:
+    # traversal prunes there, so the raw call behind it is not reachable
+    kept, _ = lint_in_project({
+        "tpu_operator/controllers/sync.py": """
+            from ..client import rest
+
+            def reconcile(req):
+                return rest.fetch()
+        """,
+        "tpu_operator/client/rest.py": """
+            from ..validator import probe
+
+            def fetch():
+                return probe.check()
+        """,
+        "tpu_operator/validator/probe.py": DEADLINE_HELPER,
+    }, "tpu_operator/validator/probe.py", "deadline-propagation")
+    assert kept == []
+
+
+def test_deadline_propagation_suppressed():
+    kept, dropped = lint_in_project({
+        "tpu_operator/controllers/sync.py": DEADLINE_ENTRY,
+        "tpu_operator/validator/probe.py": DEADLINE_HELPER.replace(
+            "return urllib.request.urlopen",
+            "# kubelet-local socket, bounded by the kernel\n"
+            "        # opalint: disable=deadline-propagation\n"
+            "        return urllib.request.urlopen"),
+    }, "tpu_operator/validator/probe.py", "deadline-propagation")
+    assert kept == [] and dropped == 1
+
+
+# -- exactly-once-event -------------------------------------------------------
+
+PROTOCOL_CONSTS = 'RETILE_PLAN_ANNOTATION = "tpu.ai/retile-plan"\n'
+
+PROTOCOL_WRITER = """
+    from .. import consts
+
+    def publish(client, events):
+        client.patch("v1", "Node", "n",
+                     {{"metadata": {{"annotations": {{
+                         consts.RETILE_PLAN_ANNOTATION: "p"}}}}}})
+        events.{record}("RetilePlanned", "plan published")
+"""
+
+
+def test_exactly_once_event_positive_writer_and_direct_caller():
+    kept, _ = lint_in_project({
+        "tpu_operator/consts.py": PROTOCOL_CONSTS,
+        "tpu_operator/health/machine.py":
+            PROTOCOL_WRITER.format(record="record")
+            + "\n    def episode(client, events):\n"
+              "        publish(client, events)\n"
+              "        events.record(\"EpisodeDone\", \"finished\")\n",
+    }, "tpu_operator/health/machine.py", "exactly-once-event")
+    # flagged in the writer itself AND in its direct caller
+    assert rules_of(kept) == ["exactly-once-event"] * 2
+    msgs = " | ".join(f.message for f in kept)
+    assert "events.record in publish" in msgs
+    assert "events.record in episode" in msgs
+
+
+def test_exactly_once_event_negative_record_once_and_off_path():
+    kept, _ = lint_in_project({
+        "tpu_operator/consts.py": PROTOCOL_CONSTS,
+        "tpu_operator/health/machine.py":
+            PROTOCOL_WRITER.format(record="record_once"),
+    }, "tpu_operator/health/machine.py", "exactly-once-event")
+    assert kept == []  # the content-addressed form is the sanctioned one
+    kept, _ = lint_in_project({
+        "tpu_operator/consts.py": PROTOCOL_CONSTS,
+        "tpu_operator/health/machine.py": """
+            def note(events):
+                events.record("NodeSeen", "informational")
+        """,
+    }, "tpu_operator/health/machine.py", "exactly-once-event")
+    assert kept == []  # no protocol write anywhere near: not in scope
+
+
+def test_exactly_once_event_suppressed():
+    kept, dropped = lint_in_project({
+        "tpu_operator/consts.py": PROTOCOL_CONSTS,
+        "tpu_operator/health/machine.py":
+            PROTOCOL_WRITER.format(record="record").replace(
+                'events.record("RetilePlanned", "plan published")',
+                '# aggregated counter Event, duplicates intended\n'
+                '        # opalint: disable=exactly-once-event\n'
+                '        events.record("RetilePlanned", "plan published")'),
+    }, "tpu_operator/health/machine.py", "exactly-once-event")
+    assert kept == [] and dropped == 1
+
+
+# -- lock-order-inversion -----------------------------------------------------
+
+INVERTED_LOCKS = """
+    import threading
+
+    class Pool:
+        def __init__(self):
+            self._a_lock = threading.Lock()
+            self._b_lock = threading.Lock()
+
+        def fill(self):
+            with self._a_lock:
+                with self._b_lock:
+                    pass
+
+        def drain(self):
+            with self._b_lock:
+                with self._a_lock:
+                    pass
+"""
+
+
+def test_lock_order_inversion_positive_ab_ba():
+    kept, _ = lint_in_project(
+        {"tpu_operator/state/pool.py": INVERTED_LOCKS},
+        "tpu_operator/state/pool.py", "lock-order-inversion")
+    assert rules_of(kept) == ["lock-order-inversion"] * 2
+    assert "lock-order cycle" in kept[0].message
+    assert "Pool._a_lock" in kept[0].message
+    assert "Pool._b_lock" in kept[0].message
+
+
+def test_lock_order_inversion_negative_total_order():
+    src = INVERTED_LOCKS.replace(
+        "with self._b_lock:\n                with self._a_lock:",
+        "with self._a_lock:\n                with self._b_lock:")
+    kept, _ = lint_in_project(
+        {"tpu_operator/state/pool.py": src},
+        "tpu_operator/state/pool.py", "lock-order-inversion")
+    assert kept == []  # consistent A-before-B everywhere: acyclic
+
+
+def test_lock_order_inversion_suppressed():
+    src = INVERTED_LOCKS.replace(
+        "with self._b_lock:\n                with self._a_lock:",
+        "with self._b_lock:\n                "
+        "# shutdown path, fill() provably quiesced\n                "
+        "# opalint: disable=lock-order-inversion\n                "
+        "with self._a_lock:")
+    kept, dropped = lint_in_project(
+        {"tpu_operator/state/pool.py": src},
+        "tpu_operator/state/pool.py", "lock-order-inversion")
+    # the drain-side edge is suppressed; the fill-side edge of the same
+    # cycle is still reported — both sites must justify themselves
+    assert rules_of(kept) == ["lock-order-inversion"]
+    assert dropped == 1
+
+
 # -- CLI ----------------------------------------------------------------------
 
 POSITIVE_FIXTURES = {
@@ -771,6 +1152,28 @@ POSITIVE_FIXTURES = {
         "tpu_operator/manifests/state-telemetry/0500_daemonset.yaml":
             STRAY_WAIT_MANIFEST,
     },
+    # graph-backed rules: each fixture is the smallest project tree that
+    # arms the whole-program analysis
+    "annotation-registry": {
+        "tpu_operator/consts.py": REGISTRY_CONSTS,
+        "tpu_operator/controllers/drain.py": 'KEY = "tpu.ai/drain"\n',
+    },
+    "state-before-actuation": {
+        "tpu_operator/consts.py": AUTOSCALE_CONSTS,
+        "tpu_operator/autoscale/controller.py": ACTUATE_BODY_TEMPLATE.format(
+            body='client.create({"kind": "Node"})\n'
+                 '            self._persist(client)'),
+    },
+    "deadline-propagation": {
+        "tpu_operator/controllers/sync.py": DEADLINE_ENTRY,
+        "tpu_operator/validator/probe.py": DEADLINE_HELPER,
+    },
+    "exactly-once-event": {
+        "tpu_operator/consts.py": PROTOCOL_CONSTS,
+        "tpu_operator/health/machine.py":
+            PROTOCOL_WRITER.format(record="record"),
+    },
+    "lock-order-inversion": ("tpu_operator/state/pool.py", INVERTED_LOCKS),
 }
 
 
@@ -808,6 +1211,125 @@ def test_cli_json_format(tmp_path):
     doc = json.loads(out.getvalue())
     assert [f["rule"] for f in doc["findings"]] == ["blocking-call"]
     assert doc["files"] == 1
+
+
+def test_cli_sarif_format(tmp_path):
+    root = _tree(tmp_path, {"tpu_operator/controllers/sync.py": BAD_SYNC})
+    out = io.StringIO()
+    assert main(["--root", str(root), "--no-baseline", "--format", "sarif"],
+                out=out) == 1
+    doc = json.loads(out.getvalue())
+    assert doc["version"] == "2.1.0"
+    driver = doc["runs"][0]["tool"]["driver"]
+    assert driver["name"] == "opalint"
+    assert [r["id"] for r in driver["rules"]] == ["blocking-call"]
+    results = doc["runs"][0]["results"]
+    assert [r["ruleId"] for r in results] == ["blocking-call"]
+    loc = results[0]["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "tpu_operator/controllers/sync.py"
+    assert loc["region"]["startLine"] >= 1
+
+
+def test_cli_stale_baseline_entry_fails(tmp_path):
+    root = _tree(tmp_path, {"tpu_operator/controllers/sync.py": BAD_SYNC})
+    out = io.StringIO()
+    assert main(["--root", str(root), "--write-baseline"], out=out) == 0
+    # fixing the grandfathered finding turns its entry stale: that is RED
+    # (dead entries would otherwise mask a future regression at the same
+    # fingerprint), pruned via make lint-baseline
+    (root / "tpu_operator/controllers/sync.py").write_text(
+        "def reconcile(req):\n    return None\n")
+    out = io.StringIO()
+    assert main(["--root", str(root)], out=out) == 1
+    assert "stale baseline entry" in out.getvalue()
+    assert "FAIL" in out.getvalue()
+    assert main(["--root", str(root), "--write-baseline"],
+                out=io.StringIO()) == 0
+    assert main(["--root", str(root)], out=io.StringIO()) == 0
+
+
+def _git_seed(root):
+    subprocess.run(["git", "init", "-q"], cwd=root, check=True)
+    subprocess.run(["git", "add", "-A"], cwd=root, check=True)
+    subprocess.run(
+        ["git", "-c", "user.email=ci@example.com", "-c", "user.name=ci",
+         "-c", "commit.gpgsign=false", "commit", "-qm", "seed"],
+        cwd=root, check=True)
+
+
+def test_cli_changed_mode_lints_only_the_diff(tmp_path):
+    root = _tree(tmp_path, {
+        "tpu_operator/controllers/clean.py":
+            "def reconcile(req):\n    return None\n",
+        "tpu_operator/controllers/sync.py": BAD_SYNC,
+    })
+    _git_seed(root)
+    # nothing changed vs HEAD: nothing linted, green despite the finding
+    out = io.StringIO()
+    assert main(["--root", str(root), "--no-baseline", "--changed"],
+                out=out) == 0
+    assert "across 0 files" in out.getvalue()
+    # touching only the clean file keeps sync.py's finding out of scope
+    (root / "tpu_operator/controllers/clean.py").write_text(
+        "def reconcile(req):\n    return 1\n")
+    out = io.StringIO()
+    assert main(["--root", str(root), "--no-baseline", "--changed"],
+                out=out) == 0
+    assert "across 1 files" in out.getvalue()
+    # touching the bad file surfaces it
+    (root / "tpu_operator/controllers/sync.py").write_text(
+        textwrap.dedent(BAD_SYNC) + "\n")
+    out = io.StringIO()
+    assert main(["--root", str(root), "--no-baseline", "--changed"],
+                out=out) == 1
+    assert "[blocking-call]" in out.getvalue()
+    # a ref git cannot diff is a usage error, not a silently-empty lint
+    assert main(["--root", str(root), "--changed=no-such-ref"],
+                out=io.StringIO()) == 2
+
+
+def test_cli_changed_mode_graph_still_covers_full_tree(tmp_path):
+    root = _tree(tmp_path, {
+        "tpu_operator/consts.py": REGISTRY_CONSTS,
+        "tpu_operator/controllers/sync.py":
+            "def reconcile(req):\n    return None\n",
+    })
+    _git_seed(root)
+    # the new (untracked) file's raw literal resolves against the
+    # UNCHANGED consts.py: the graph is whole-program even when the lint
+    # set is one file
+    (root / "tpu_operator/controllers/drain.py").write_text(
+        'KEY = "tpu.ai/drain"\n')
+    out = io.StringIO()
+    assert main(["--root", str(root), "--no-baseline", "--changed"],
+                out=out) == 1
+    assert "[annotation-registry]" in out.getvalue()
+    assert "consts.DRAIN_LABEL" in out.getvalue()
+
+
+def test_cli_changed_mode_scopes_staleness_to_linted_files(tmp_path):
+    root = _tree(tmp_path, {
+        "tpu_operator/controllers/clean.py":
+            "def reconcile(req):\n    return None\n",
+        "tpu_operator/controllers/sync.py": BAD_SYNC,
+    })
+    assert main(["--root", str(root), "--write-baseline"],
+                out=io.StringIO()) == 0
+    # fix sync.py (its baseline entry goes stale), commit everything, then
+    # change only clean.py: the stale entry is out of the diff's scope
+    (root / "tpu_operator/controllers/sync.py").write_text(
+        "def reconcile(req):\n    return None\n")
+    _git_seed(root)
+    (root / "tpu_operator/controllers/clean.py").write_text(
+        "def reconcile(req):\n    return 2\n")
+    out = io.StringIO()
+    assert main(["--root", str(root), "--changed"], out=out) == 0
+    # ...but a diff touching the fixed file does surface it
+    (root / "tpu_operator/controllers/sync.py").write_text(
+        "def reconcile(req):\n    return 3\n")
+    out = io.StringIO()
+    assert main(["--root", str(root), "--changed"], out=out) == 1
+    assert "stale baseline entry" in out.getvalue()
 
 
 def test_cli_parse_error_is_a_finding(tmp_path):

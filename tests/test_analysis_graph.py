@@ -1,0 +1,311 @@
+"""opalint v2 graph layer: symbol/call/lock-graph resolution unit checks,
+the seeded two-class lock-inversion acceptance fixture, seeded
+property-style fuzzing of the builder over synthetic package trees
+(import cycles, relative imports, re-exports, syntax errors — no crashes,
+deterministic resolution), the self-lint gate over tpu_operator/analysis/,
+and the performance budgets (full tree < 30 s, single-file incremental
+< 5 s).
+"""
+
+import ast
+import io
+import os
+import random
+import textwrap
+import time
+from pathlib import Path
+
+import pytest
+
+from tpu_operator.analysis import graph as graph_mod
+from tpu_operator.analysis.core import (
+    FileContext,
+    LintConfig,
+    all_checkers,
+    apply_suppressions,
+    suppressions,
+)
+from tpu_operator.analysis.runner import main, run
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+def build(sources):
+    return graph_mod.build_from_sources(
+        {k: textwrap.dedent(v) for k, v in sources.items()}, LintConfig())
+
+
+# -- resolution unit checks ---------------------------------------------------
+
+def test_module_name_mapping():
+    assert graph_mod.module_name("tpu_operator/state/pool.py") == \
+        "tpu_operator.state.pool"
+    assert graph_mod.module_name("tpu_operator/api/__init__.py") == \
+        "tpu_operator.api"
+
+
+def test_reexport_chain_resolves_to_definer():
+    p = build({
+        "tpu_operator/core.py": "def make():\n    return 1\n",
+        "tpu_operator/api/__init__.py": "from ..core import make\n",
+        "tpu_operator/cmd/tool.py":
+            "from ..api import make\n\ndef main():\n    return make()\n",
+    })
+    assert p.resolve_symbol("tpu_operator.cmd.tool", "make") == \
+        ("func", "tpu_operator.core:make")
+    fn = p.functions["tpu_operator.cmd.tool:main"]
+    assert [c for c, _ in fn.calls] == ["tpu_operator.core:make"]
+
+
+def test_import_cycle_resolution_terminates():
+    p = build({
+        "tpu_operator/a.py": "from .b import thing\n",
+        "tpu_operator/b.py": "from .a import thing\n",
+    })
+    # a -> b -> a: the seen-set stops the chain instead of recursing
+    assert p.resolve_symbol("tpu_operator.a", "thing") is None
+
+
+def test_over_deep_relative_import_tolerated():
+    p = build({
+        "tpu_operator/a.py":
+            "from ...... import nothing\n\ndef f():\n    return nothing()\n"})
+    assert p.resolve_symbol("tpu_operator.a", "nothing") is None
+    assert p.functions["tpu_operator.a:f"].calls == []
+
+
+def test_constructor_call_and_self_dispatch_resolution():
+    p = build({
+        "tpu_operator/state/pool.py": """
+            class Pool:
+                def __init__(self):
+                    self.n = 0
+
+                def fill(self):
+                    self.bump()
+
+                def bump(self):
+                    self.n += 1
+
+            def make():
+                return Pool()
+        """,
+    })
+    make = p.functions["tpu_operator.state.pool:make"]
+    assert [c for c, _ in make.calls] == \
+        ["tpu_operator.state.pool:Pool.__init__"]
+    fill = p.functions["tpu_operator.state.pool:Pool.fill"]
+    assert [c for c, _ in fill.calls] == \
+        ["tpu_operator.state.pool:Pool.bump"]
+
+
+def test_syntax_error_files_are_skipped_not_fatal():
+    p = build({
+        "tpu_operator/good.py": "def f():\n    return 1\n",
+        "tpu_operator/bad.py": "def oops(:\n",
+    })
+    assert "tpu_operator.good" in p.modules
+    assert "tpu_operator.bad" not in p.modules
+
+
+# -- two-class lock inversion (the acceptance fixture) ------------------------
+
+TWO_CLASS_INVERSION = {
+    "tpu_operator/state/coord.py": """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._owner = Coordinator()
+
+            def step(self):
+                with self._lock:
+                    self._owner.kick()
+
+            def poke(self):
+                with self._lock:
+                    pass
+
+        class Coordinator:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._worker = Worker()
+
+            def kick(self):
+                with self._lock:
+                    pass
+
+            def run(self):
+                with self._lock:
+                    self._worker.poke()
+    """,
+}
+
+
+def test_two_class_lock_inversion_detected():
+    """Worker.step holds Worker._lock and (via the constructor-inferred
+    ``self._owner``) acquires Coordinator._lock; Coordinator.run does the
+    reverse through ``self._worker`` — an AB/BA deadlock no single file or
+    single class shows."""
+    p = build(TWO_CLASS_INVERSION)
+    edges = p.lock_cycle_edges()
+    labels = {(e.src.label(), e.dst.label()) for e, _ in edges}
+    assert ("Worker._lock", "Coordinator._lock") in labels
+    assert ("Coordinator._lock", "Worker._lock") in labels
+
+
+def test_two_class_lock_inversion_flagged_by_rule():
+    sources = {k: textwrap.dedent(v) for k, v in TWO_CLASS_INVERSION.items()}
+    config = LintConfig()
+    project = graph_mod.build_from_sources(sources, config)
+    relpath = "tpu_operator/state/coord.py"
+    src = sources[relpath]
+    ctx = FileContext(relpath, src, ast.parse(src), config, project=project)
+    found = list(all_checkers()["lock-order-inversion"]().check(ctx))
+    kept, _ = apply_suppressions(found, suppressions(src))
+    assert len(kept) == 2  # both directions of the cycle, each at its site
+    msgs = " | ".join(f.message for f in kept)
+    assert "Worker._lock" in msgs and "Coordinator._lock" in msgs
+
+
+def test_no_inversion_when_order_is_consistent():
+    src = TWO_CLASS_INVERSION["tpu_operator/state/coord.py"].replace(
+        "with self._lock:\n                    self._worker.poke()",
+        "self._worker.poke()")
+    p = build({"tpu_operator/state/coord.py": src})
+    assert p.lock_cycle_edges() == []
+
+
+# -- seeded builder fuzz ------------------------------------------------------
+
+def _synth_sources(rng):
+    """A random small package: modules importing each other (absolute,
+    from-, and relative forms — cycles welcome), re-export chains, classes
+    with locks and self-dispatch, and the occasional syntax error."""
+    n = rng.randint(3, 8)
+    mods = [f"m{i}" for i in range(n)]
+    sources = {"tpu_operator/__init__.py": ""}
+    for i, m in enumerate(mods):
+        lines = []
+        for j in sorted(rng.sample(range(n), rng.randint(0, n - 1))):
+            other = mods[j]
+            form = rng.randrange(3)
+            if form == 0:
+                lines.append(f"import tpu_operator.{other}")
+            elif form == 1:
+                lines.append(f"from tpu_operator import {other}")
+            else:
+                lines.append(f"from . import {other}")
+        if i and rng.random() < 0.5:
+            donor = mods[rng.randrange(i)]
+            lines.append(f"from .{donor} import f0 as exported_{i}")
+        lines.append(f"def f0():\n    return {rng.randrange(100)}")
+        calls = [f"    tpu_operator.{mods[j]}.f0()"
+                 if rng.random() < 0.5 else "    f0()"
+                 for j in sorted(rng.sample(range(n), rng.randint(0, 3)))]
+        lines.append("def f1():\n" + ("\n".join(calls) or "    pass"))
+        if rng.random() < 0.6:
+            lines.append(textwrap.dedent("""\
+                import threading
+
+                class C:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._gate_lock = threading.Lock()
+
+                    def a(self):
+                        with self._lock:
+                            self.b()
+
+                    def b(self):
+                        with self._gate_lock:
+                            pass
+                """))
+        src = "\n".join(lines) + "\n"
+        if rng.random() < 0.15:
+            src += "def broken(:\n"  # must be tolerated, never fatal
+        sources[f"tpu_operator/{m}.py"] = src
+    return sources
+
+
+def _fingerprint(project):
+    """Canonical, order-independent view of everything the rules consume."""
+    return {
+        "modules": sorted(project.modules),
+        "calls": {fid: [c for c, _ in fn.calls]
+                  for fid, fn in sorted(project.functions.items())},
+        "raw_calls": {fid: [d for d, _ in fn.raw_calls]
+                      for fid, fn in sorted(project.functions.items())},
+        "consts": dict(sorted(project.const_values.items())),
+        "lock_edges": [((e.src.cid, e.src.attr), (e.dst.cid, e.dst.attr),
+                        e.relpath, e.via) for e in project.lock_edges],
+        "attr_types": {cid: dict(sorted(c.attr_types.items()))
+                       for cid, c in sorted(project.classes.items())},
+    }
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_graph_fuzz_no_crash_and_deterministic(seed):
+    rng = random.Random(seed)
+    sources = _synth_sources(rng)
+    p1 = graph_mod.build_from_sources(sources)
+    # reversed insertion order must not change a single resolution
+    p2 = graph_mod.build_from_sources(dict(reversed(list(sources.items()))))
+    assert _fingerprint(p1) == _fingerprint(p2)
+    # the query layer survives whatever the generator produced (cycles,
+    # broken modules, dangling imports) without crashing
+    roots = sorted(p1.functions)[:3]
+    p1.reachable_from(roots)
+    for fid in sorted(p1.functions)[:5]:
+        p1.sample_path(roots, fid)
+    p1.lock_cycle_edges()
+
+
+def test_real_tree_graph_build_is_deterministic():
+    """Two builds over the actual package resolve identically — the
+    property the --changed mode's correctness rests on."""
+    sources = {}
+    pkg = REPO_ROOT / "tpu_operator"
+    for path in sorted(pkg.rglob("*.py")):
+        rel = path.relative_to(REPO_ROOT).as_posix()
+        if "__pycache__" in rel or "deviceplugin/proto" in rel:
+            continue
+        sources[rel] = path.read_text(encoding="utf-8")
+    p1 = graph_mod.build_from_sources(sources)
+    p2 = graph_mod.build_from_sources(dict(reversed(list(sources.items()))))
+    assert _fingerprint(p1) == _fingerprint(p2)
+    # and the shipped tree has no lock-order cycles
+    assert p1.lock_cycle_edges() == []
+
+
+# -- self-lint gate and performance budgets -----------------------------------
+
+def test_self_lint_analysis_package_clean():
+    """The linter lints its own implementation with zero findings and no
+    baseline help — dogfood gate for every new rule."""
+    out = io.StringIO()
+    code = main(["--root", str(REPO_ROOT), "--no-baseline",
+                 "tpu_operator/analysis"], out=out)
+    assert code == 0, out.getvalue()
+
+
+def test_full_tree_lint_under_budget():
+    start = time.monotonic()
+    code = main(["--root", str(REPO_ROOT)], out=io.StringIO())
+    elapsed = time.monotonic() - start
+    assert code == 0
+    assert elapsed < 30.0, f"full-tree lint took {elapsed:.1f}s"
+
+
+def test_incremental_single_file_under_budget():
+    # what --changed does for a one-file diff: full graph build + one file
+    # linted; the budget covers the graph build, the dominant cost
+    target = os.path.join(str(REPO_ROOT), "tpu_operator", "analysis",
+                          "runner.py")
+    start = time.monotonic()
+    _findings, _sup, nfiles = run(str(REPO_ROOT), ["tpu_operator"],
+                                  files=[target])
+    elapsed = time.monotonic() - start
+    assert nfiles == 1
+    assert elapsed < 5.0, f"single-file incremental lint took {elapsed:.1f}s"

@@ -4,9 +4,13 @@ and inline suppressions.
 A checker is a class with a ``name``, a ``description``, and a
 ``check(ctx)`` generator yielding :class:`Finding`. Checkers operate on one
 file at a time via :class:`FileContext` (parsed AST + source + path
-classification helpers); cross-file state they need — today only the
-operations-doc text for ``metrics-discipline`` — rides on
-:class:`LintConfig`, loaded once per run.
+classification helpers); cross-file state rides on :class:`LintConfig`
+(doc/manifest texts, loaded once per run) and — since v2 — on
+``ctx.project``, a :class:`tpu_operator.analysis.graph.ProjectContext`
+holding the whole-program symbol table, import/call graph, and lock graph
+built once from the full tree. ``ctx.project`` is ``None`` when a file is
+linted in isolation (unit-test helpers); graph-backed rules must then
+yield nothing, so file-local rules stay usable without a project build.
 """
 
 from __future__ import annotations
@@ -64,16 +68,22 @@ class LintConfig:
     client_dirs: Tuple[str, ...] = ("client",)
     #: composition roots additionally allowed to construct RestClient
     entrypoint_dirs: Tuple[str, ...] = ("cmd",)
+    #: dotted module holding the annotation/label-key registry; the
+    #: annotation-registry rule resolves raw ``tpu.ai/*`` literals to it
+    consts_module: str = "tpu_operator.consts"
 
 
 class FileContext:
     def __init__(self, relpath: str, src: str, tree: ast.Module,
-                 config: LintConfig):
+                 config: LintConfig, project=None):
         self.relpath = relpath.replace("\\", "/")
         self.src = src
         self.lines = src.splitlines()
         self.tree = tree
         self.config = config
+        #: graph.ProjectContext for the full tree, or None when linting a
+        #: lone string — interprocedural rules yield nothing without it
+        self.project = project
         self._dir_parts = tuple(self.relpath.split("/")[:-1])
 
     def in_dirs(self, dirnames: Iterable[str]) -> bool:

@@ -1,10 +1,22 @@
-"""opalint runner: walk a tree, run every checker, apply suppressions and
-the committed baseline, emit human or JSON output with CI exit codes.
+"""opalint runner: walk a tree, build the whole-program graph once, run
+every checker, apply suppressions and the committed baseline, emit human
+/ JSON / SARIF output with CI exit codes.
 
-Exit codes: 0 = no non-baselined findings; 1 = findings (or unparseable
-source); 2 = usage/internal error. ``--write-baseline`` regenerates the
-grandfathered-findings file and always exits 0 — that regeneration is a
-deliberate act (``make lint-baseline``), reviewed like any other diff.
+Exit codes: 0 = no non-baselined findings and no stale baseline entries;
+1 = findings or stale entries (a stale entry means the grandfathered
+finding was fixed — prune it with ``make lint-baseline`` so it can't
+mask a future regression at the same fingerprint); 2 = usage/internal
+error. ``--write-baseline`` regenerates the grandfathered-findings file
+and always exits 0 — that regeneration is a deliberate act
+(``make lint-baseline``), reviewed like any other diff.
+
+v2: every run parses the *full* package tree once (AST cache shared
+between the graph build and per-file linting) and hands checkers a
+``ProjectContext`` — so ``--changed[=REF]`` incremental mode lints only
+the files changed vs a git ref while interprocedural rules still see the
+whole program; a cross-file regression introduced by a changed file is
+reported if it surfaces in that file, and the full run on main catches
+the rest.
 """
 
 from __future__ import annotations
@@ -13,10 +25,13 @@ import argparse
 import ast
 import json
 import os
+import subprocess
 import sys
 from typing import Dict, Iterable, List, Optional, Tuple
 
 from . import baseline as baseline_mod
+from . import graph as graph_mod
+from . import sarif as sarif_mod
 from .core import (
     Checker,
     FileContext,
@@ -29,6 +44,8 @@ from .core import (
 
 DOCS_RELPATH = os.path.join("docs", "operations.md")
 MANIFESTS_RELPATH = os.path.join("tpu_operator", "manifests")
+#: the package tree the whole-program graph is always built from
+PROJECT_TREE = "tpu_operator"
 #: path fragments never linted: generated protobuf code and caches
 SKIP_PARTS = ("__pycache__", os.path.join("deviceplugin", "proto"))
 
@@ -68,31 +85,103 @@ def load_manifest_texts(root: str) -> Dict[str, str]:
     return out
 
 
-def lint_file(path: str, root: str, checkers: List[Checker],
-              config: LintConfig) -> Tuple[List[Finding], int]:
-    """(findings, suppressed_count) for one file."""
-    relpath = os.path.relpath(path, root).replace(os.sep, "/")
-    with open(path, encoding="utf-8") as fh:
-        src = fh.read()
-    try:
-        tree = ast.parse(src, filename=path)
-    except SyntaxError as e:
-        return [Finding(rule="parse-error", path=relpath,
-                        line=e.lineno or 1, col=(e.offset or 0) + 1,
-                        message=f"cannot parse: {e.msg}",
-                        line_text="")], 0
-    ctx = FileContext(relpath, src, tree, config)
+class _AstCache:
+    """relpath -> (src, tree-or-None, parse-error-Finding-or-None), parsed
+    at most once per run and shared by the graph build and the linter."""
+
+    def __init__(self, root: str):
+        self.root = root
+        self.entries: Dict[str, Tuple[str, Optional[ast.Module],
+                                      Optional[Finding]]] = {}
+
+    def load(self, path: str) -> str:
+        relpath = os.path.relpath(path, self.root).replace(os.sep, "/")
+        if relpath in self.entries:
+            return relpath
+        with open(path, encoding="utf-8") as fh:
+            src = fh.read()
+        try:
+            tree: Optional[ast.Module] = ast.parse(src, filename=path)
+            err: Optional[Finding] = None
+        except SyntaxError as e:
+            tree = None
+            err = Finding(rule="parse-error", path=relpath,
+                          line=e.lineno or 1, col=(e.offset or 0) + 1,
+                          message=f"cannot parse: {e.msg}", line_text="")
+        self.entries[relpath] = (src, tree, err)
+        return relpath
+
+
+def _build_project(root: str, cache: _AstCache,
+                   config: LintConfig) -> graph_mod.ProjectContext:
+    tree_dir = os.path.join(root, PROJECT_TREE)
+    roots = [PROJECT_TREE] if os.path.isdir(tree_dir) else []
+    parsed: Dict[str, Tuple[str, ast.Module]] = {}
+    if roots:
+        for path in iter_py_files(root, roots):
+            relpath = cache.load(path)
+            src, tree, _err = cache.entries[relpath]
+            if tree is not None:
+                parsed[relpath] = (src, tree)
+    return graph_mod.build_project(parsed, config)
+
+
+def lint_source(relpath: str, src: str, tree: Optional[ast.Module],
+                parse_err: Optional[Finding], checkers: List[Checker],
+                config: LintConfig, project) -> Tuple[List[Finding], int]:
+    """(findings, suppressed_count) for one already-parsed file."""
+    if tree is None:
+        return [parse_err] if parse_err else [], 0
+    ctx = FileContext(relpath, src, tree, config, project=project)
     found: List[Finding] = []
     for checker in checkers:
         found.extend(checker.check(ctx))
     return apply_suppressions(found, suppressions(src))
 
 
+def changed_files(root: str, ref: str) -> List[str]:
+    """Python files changed vs ``ref`` (committed diff + staged +
+    untracked), absolute paths, restricted to the project tree. Raises
+    RuntimeError when git can't answer (not a repo, bad ref)."""
+    def _git(*args: str) -> List[str]:
+        proc = subprocess.run(
+            ["git", "-C", root, *args],
+            capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"git {' '.join(args[:2])} failed: "
+                f"{proc.stderr.strip() or 'unknown error'}")
+        return [line for line in proc.stdout.splitlines() if line]
+
+    rels = set(_git("diff", "--name-only", ref, "--"))
+    rels.update(_git("ls-files", "--others", "--exclude-standard"))
+    out: List[str] = []
+    for rel in sorted(rels):
+        posix = rel.replace("\\", "/")
+        if not posix.endswith(".py"):
+            continue
+        if not posix.startswith(PROJECT_TREE + "/"):
+            continue
+        if any(part in posix for part in
+               (p.replace(os.sep, "/") for p in SKIP_PARTS)):
+            continue
+        full = os.path.join(root, rel)
+        if os.path.isfile(full):          # deleted files have no findings
+            out.append(full)
+    return out
+
+
 def run(root: str, paths: Iterable[str],
         rules: Optional[Iterable[str]] = None,
-        docs_path: Optional[str] = None
+        docs_path: Optional[str] = None,
+        files: Optional[List[str]] = None
         ) -> Tuple[List[Finding], int, int]:
-    """(findings, suppressed_total, files_linted) over a tree."""
+    """(findings, suppressed_total, files_linted) over a tree.
+
+    ``files`` overrides the lint set (absolute paths; used by --changed);
+    the whole-program graph is built from the full project tree either
+    way.
+    """
     registry = all_checkers()
     if rules is not None:
         unknown = sorted(set(rules) - set(registry))
@@ -110,15 +199,21 @@ def run(root: str, paths: Iterable[str],
     config = LintConfig(root=root, docs_text=docs_text,
                         manifest_texts=load_manifest_texts(root))
 
+    cache = _AstCache(root)
+    project = _build_project(root, cache, config)
+
     findings: List[Finding] = []
     suppressed_total = 0
-    files = iter_py_files(root, paths)
-    for path in files:
-        found, suppressed = lint_file(path, root, checkers, config)
+    lint_paths = files if files is not None else iter_py_files(root, paths)
+    for path in lint_paths:
+        relpath = cache.load(path)
+        src, tree, err = cache.entries[relpath]
+        found, suppressed = lint_source(relpath, src, tree, err, checkers,
+                                        config, project)
         findings.extend(found)
         suppressed_total += suppressed
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
-    return findings, suppressed_total, len(files)
+    return findings, suppressed_total, len(lint_paths)
 
 
 # -- CLI ----------------------------------------------------------------------
@@ -128,10 +223,10 @@ def _print_human(new: List[Finding], baselined: int, suppressed: int,
     for f in new:
         print(f"{f.location()}: [{f.rule}] {f.message}", file=out)
     for entry in stale:
-        print(f"note: stale baseline entry {entry['fingerprint']} "
+        print(f"stale baseline entry {entry['fingerprint']} "
               f"({entry['rule']} at {entry['path']}): finding no longer "
               f"present — run `make lint-baseline` to prune", file=out)
-    verdict = "FAIL" if new else "ok"
+    verdict = "FAIL" if new or stale else "ok"
     print(f"opalint: {verdict}: {len(new)} new finding(s), {baselined} "
           f"baselined, {suppressed} suppressed, {len(stale)} stale baseline "
           f"entr{'y' if len(stale) == 1 else 'ies'} across {nfiles} files",
@@ -142,13 +237,19 @@ def main(argv: Optional[List[str]] = None, out=None) -> int:
     out = out or sys.stdout
     parser = argparse.ArgumentParser(
         prog="python -m tpu_operator.cmd.lint",
-        description="opalint: AST-based operator invariant checker")
+        description="opalint: whole-program operator invariant checker")
     parser.add_argument("paths", nargs="*", default=None,
                         help="files/dirs to lint (default: tpu_operator)")
     parser.add_argument("--root", default=".",
                         help="project root (baseline + docs live here)")
-    parser.add_argument("--format", choices=("human", "json"),
+    parser.add_argument("--format", choices=("human", "json", "sarif"),
                         default="human")
+    parser.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                        metavar="REF",
+                        help="lint only files changed vs REF (default "
+                             "HEAD: committed+staged+untracked); the "
+                             "whole-program graph still covers the full "
+                             "tree")
     parser.add_argument("--baseline", default=None,
                         help=f"baseline file (default: "
                              f"<root>/{baseline_mod.DEFAULT_BASELINE})")
@@ -168,11 +269,19 @@ def main(argv: Optional[List[str]] = None, out=None) -> int:
         return 0
 
     root = os.path.abspath(args.root)
-    paths = args.paths or ["tpu_operator"]
+    paths = args.paths or [PROJECT_TREE]
     rules = ([r.strip() for r in args.rules.split(",") if r.strip()]
              if args.rules else None)
+    files: Optional[List[str]] = None
+    if args.changed is not None:
+        try:
+            files = changed_files(root, args.changed)
+        except RuntimeError as e:
+            print(f"opalint: error: {e}", file=sys.stderr)
+            return 2
     try:
-        findings, suppressed, nfiles = run(root, paths, rules=rules)
+        findings, suppressed, nfiles = run(root, paths, rules=rules,
+                                           files=files)
     except (ValueError, OSError) as e:
         print(f"opalint: error: {e}", file=sys.stderr)
         return 2
@@ -193,6 +302,12 @@ def main(argv: Optional[List[str]] = None, out=None) -> int:
             print(f"opalint: error: {e}", file=sys.stderr)
             return 2
     new, baselined, stale = baseline_mod.apply(findings, baseline)
+    if args.changed is not None:
+        # an incremental run sees only a slice of the tree: entries for
+        # unlinted files aren't stale, they're simply out of scope
+        linted = {os.path.relpath(p, root).replace(os.sep, "/")
+                  for p in (files or [])}
+        stale = [e for e in stale if e.get("path") in linted]
 
     if args.format == "json":
         json.dump({
@@ -203,6 +318,9 @@ def main(argv: Optional[List[str]] = None, out=None) -> int:
             "files": nfiles,
         }, out, indent=2)
         print(file=out)
+    elif args.format == "sarif":
+        json.dump(sarif_mod.to_sarif(new), out, indent=2)
+        print(file=out)
     else:
         _print_human(new, baselined, suppressed, stale, nfiles, out)
-    return 1 if new else 0
+    return 1 if new or stale else 0

@@ -14,6 +14,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Dict, List, Optional
 
+from .. import consts
 from .common import ComponentSpec, SpecValidationError, UpgradePolicySpec
 from .k8s_schemas import NODE_AFFINITY, TOLERATIONS
 from .specbase import spec_field
@@ -22,8 +23,8 @@ TPU_DRIVER_API_VERSION = "tpu.ai/v1alpha1"
 TPU_DRIVER_KIND = "TPUDriver"
 
 #: label every TPU node gets (analog of nvidia.com/gpu.present=true,
-#: reference state_manager.go:113-117)
-TPU_PRESENT_LABEL = "tpu.ai/tpu.present"
+#: reference state_manager.go:113-117); key registered in consts.py
+TPU_PRESENT_LABEL = consts.TPU_PRESENT_LABEL
 
 DRIVER_TYPES = ("standard",)  # reference has gpu/vgpu/vgpu-host-manager; TPU has one
 

@@ -416,6 +416,12 @@ class AutoscaleReconciler(Reconciler):
         self._persist_states(policy, states)
         self.metrics.autoscale_resizes.labels(
             pool=pool, direction="down").inc()
+        # Aggregated completion note: record() folds repeats into one
+        # Event's count, the path is unreachable on crash replay (the
+        # resize record was cleared by _persist_states above), and the
+        # protocol announcement is the content-addressed RetilePlanned
+        # record_once at episode start.
+        # opalint: disable=exactly-once-event
         events.record(self.client, self.namespace, policy.obj,
                       events.NORMAL, REASON_SCALED_DOWN,
                       f"pool {pool}: drained and removed {name} "
@@ -447,6 +453,15 @@ class AutoscaleReconciler(Reconciler):
                    "metadata": {"name": name, "labels": dict(template)},
                    "status": {}}
             try:
+                # Scale-UP converges by name idempotence instead of
+                # write-ahead intent: node names derive from the
+                # persisted seq, AlreadyExists on replay is absorbed
+                # below, and the next census counts landed nodes so
+                # decide() re-derives the same target (proven by the
+                # crash-point matrix); persisting cooldown first would
+                # instead strand a crash window where capacity was
+                # ordered but never created.
+                # opalint: disable=state-before-actuation
                 self.client.create(obj)
             except AlreadyExistsError:
                 pass  # crash replay: this node already landed
@@ -456,6 +471,11 @@ class AutoscaleReconciler(Reconciler):
                 pool=pool, direction="up").inc()
         state.cooldown_until = now + float(spec.cooldown_s)
         self._persist_states(policy, states)
+        # Aggregated informational Event: record() folds a replay into
+        # the existing Event's count (same reason/message stem), and
+        # scale-up multiplicity is not protocol-bearing — no peer acts
+        # on this announcement.
+        # opalint: disable=exactly-once-event
         events.record(self.client, self.namespace, policy.obj,
                       events.NORMAL, REASON_SCALED_UP,
                       f"pool {pool}: registered {len(created)} node(s): "
@@ -559,6 +579,11 @@ class AutoscaleReconciler(Reconciler):
                     0.25, below + spec.scale_down_delay_s - now + 0.05))
 
         if saturated and not self._last_saturated:
+            # Edge-triggered alert (fires on the False->True transition
+            # only) whose repeats across operator restarts are *wanted*:
+            # saturation is an ongoing operator-attention condition, not
+            # an episode step.
+            # opalint: disable=exactly-once-event
             events.record(self.client, self.namespace, policy.obj,
                           events.WARNING, REASON_SATURATED,
                           "demand exceeds every pool's maxNodes ceiling; "

@@ -1,8 +1,13 @@
 """``python -m tpu_operator.cmd.lint`` / ``tpuop-lint`` — opalint CLI.
 
-The operator-invariant checker (`make lint`): lock discipline, API-bypass,
-blocking calls in reconcile paths, exception & metrics hygiene. See
-``tpu_operator/analysis/`` and ``docs/static-analysis.md``.
+The whole-program operator-invariant checker (`make lint`): file-local
+rules (lock discipline, API-bypass, blocking calls, exception & metrics
+hygiene) plus graph-backed interprocedural rules (state-before-actuation,
+deadline-propagation, exactly-once-event, annotation-registry,
+lock-order-inversion). ``--changed[=REF]`` lints only changed files while
+the graph still covers the full tree; ``--format sarif`` emits
+code-scanning annotations. See ``tpu_operator/analysis/`` and
+``docs/static-analysis.md``.
 """
 
 from __future__ import annotations

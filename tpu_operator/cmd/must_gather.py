@@ -41,8 +41,8 @@ SECTIONS = ("cluster", "crs", "operands", "nodes", "validation",
 #: node label columns surfaced in the summary table (upgrade + identity)
 NODE_LABEL_COLUMNS = (
     consts.TPU_PRESENT_LABEL,
-    "tpu.ai/tpu.chip-type",
-    "tpu.ai/tpu.topology",
+    consts.TPU_CHIP_TYPE_LABEL,
+    consts.TPU_TOPOLOGY_LABEL,
     consts.UPGRADE_STATE_LABEL,
     consts.DRIVER_STACK_LABEL,
     consts.PLUGIN_STACK_LABEL,

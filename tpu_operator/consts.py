@@ -31,6 +31,10 @@ DRIFT_HEALS_ANNOTATION = "tpu.ai/operator-drift-heals"
 TPU_PRESENT_LABEL = "tpu.ai/tpu.present"
 #: per-operand node kill-switches (analog of nvidia.com/gpu.deploy.<operand>)
 DEPLOY_LABEL_PREFIX = "tpu.ai/tpu.deploy."
+#: DS label tying a rendered per-pool DaemonSet to its owning TPUDriver
+#: instance, and the node pool that rendering targeted
+DRIVER_INSTANCE_LABEL = "tpu.ai/driver-instance"
+NODE_POOL_LABEL = "tpu.ai/node-pool"
 #: chip/topology labels written by feature discovery
 TPU_CHIP_TYPE_LABEL = "tpu.ai/tpu.chip-type"
 TPU_CHIP_COUNT_LABEL = "tpu.ai/tpu.chip-count"
@@ -44,6 +48,12 @@ TPU_SLICE_STATE_LABEL = "tpu.ai/slice.config.state"
 TPU_SLICE_ID_LABEL = "tpu.ai/slice.id"
 #: slice-level validation stamp (value = hash of the validated config)
 MULTIHOST_VALIDATED_ANNOTATION = "tpu.ai/multihost-validated"
+#: multi-host validation workload coordinates: pods of one rendezvous run
+#: share the slice label and are numbered by worker id; each carries the
+#: config hash its run validated (hash mismatch => restart validation)
+MULTIHOST_SLICE_LABEL = "tpu.ai/slice"
+MULTIHOST_WORKER_ID_LABEL = "tpu.ai/worker-id"
+MULTIHOST_CONFIG_HASH_ANNOTATION = "tpu.ai/config-hash"
 #: upgrade state machine's per-node persistent state
 #: which stack provides the component on this node: "operator" objects are
 #: ours; "host" records adoption of a platform-preinstalled stack
@@ -100,6 +110,9 @@ WORKLOAD_HEALTH_ANNOTATION = "tpu.ai/workload-health"
 #: can stitch node-side spans into the end-to-end join trace. Bounded to
 #: joinprofile.records.MAX_ANNOTATION_BYTES encoded bytes, newest-first.
 TRACE_SPANS_ANNOTATION = "tpu.ai/trace-spans"
+#: Event annotation carrying the reconcile trace that emitted it
+#: (re-exported by tracing.py, which owns the span machinery)
+TRACE_ID_ANNOTATION = "tpu.ai/trace-id"
 #: unix-seconds stamp (string) the labeler writes the FIRST time it sees a
 #: TPU node, riding the same coalesced label patch. Kubelets (and the sim)
 #: treat it as "start pulling operand images now": by the time the operand
@@ -195,6 +208,11 @@ SERVING_SLO_LABEL = "tpu.ai/serving-slo"
 #: "p99_ms=3.1,tokens_per_s=5120,attainment=1.0" — an annotation because
 #: commas/decimals are not label-safe
 SERVING_SLO_ANNOTATION = "tpu.ai/serving-slo-detail"
+
+# -- testing harness -----------------------------------------------------------
+#: pod label tying a kubelet-sim "DaemonSet" pod to the DS that owns it
+#: (the sim's stand-in for ownerReferences-based DS pod adoption)
+KUBELET_SIM_DS_LABEL = "tpu.ai/kubelet-sim-ds"
 
 # -- labels read from the platform (GKE / device discovery) -------------------
 GKE_TPU_ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"

@@ -40,7 +40,7 @@ from .runtime import Controller, Reconciler, Request, Result
 log = logging.getLogger(__name__)
 
 #: DS label tying a DaemonSet to its owning TPUDriver instance
-INSTANCE_LABEL = "tpu.ai/driver-instance"
+INSTANCE_LABEL = consts.DRIVER_INSTANCE_LABEL
 
 NOT_READY_REQUEUE = 5.0
 
@@ -154,7 +154,7 @@ class TPUDriverReconciler(Reconciler):
                 libtpu_version=driver.spec.libtpu_version,
                 image=driver.spec.image_path(),
                 extra_labels={INSTANCE_LABEL: driver.name,
-                              "tpu.ai/node-pool": pool.name},
+                              consts.NODE_POOL_LABEL: pool.name},
             )
             with tracing.phase_span("render", pool=pool.name) as sp:
                 objs = self.state_driver.render_objects(policy, self.namespace,

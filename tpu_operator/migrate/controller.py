@@ -277,6 +277,11 @@ class MigrationReconciler(Reconciler):
         name = node["metadata"]["name"]
         dst = req.get("dst") or self._pick_destination(name)
         if dst is None:
+            # Holding-state alert emitted while the episode has NOT
+            # started (no durable state written yet): record() aggregates
+            # the re-fires into one Event's count, which is the desired
+            # "still blocked" signal.
+            # opalint: disable=exactly-once-event
             events.record(self.client, self.namespace, node,
                           events.WARNING, REASON_BLOCKED,
                           f"{name}: migration requested but no eligible "
@@ -434,6 +439,11 @@ class MigrationReconciler(Reconciler):
         lost = state["dst"]
         new_dst = self._pick_destination(state["src"], exclude=(lost,))
         if new_dst is None:
+            # Holding-state alert: the episode is parked (state
+            # unchanged, retried in 2 s) and record()'s count aggregation
+            # is the desired "still waiting for an eligible destination"
+            # signal, not a protocol step.
+            # opalint: disable=exactly-once-event
             events.record(self.client, self.namespace, node,
                           events.WARNING, REASON_BLOCKED,
                           f"{state['src']}: destination {lost} vanished "

@@ -93,10 +93,10 @@ class MultihostValidationState:
             "apiVersion": "v1",
             "kind": "Service",
             "metadata": {"name": self._svc_name(slice_id), "namespace": namespace,
-                         "labels": {"app": APP_LABEL, "tpu.ai/slice": slice_id}},
+                         "labels": {"app": APP_LABEL, consts.MULTIHOST_SLICE_LABEL: slice_id}},
             "spec": {
                 "clusterIP": "None",  # headless: per-pod DNS for rendezvous
-                "selector": {"app": APP_LABEL, "tpu.ai/slice": slice_id},
+                "selector": {"app": APP_LABEL, consts.MULTIHOST_SLICE_LABEL: slice_id},
                 "ports": [{"name": "coordinator", "port": COORDINATOR_PORT}],
             },
         }
@@ -120,9 +120,9 @@ class MultihostValidationState:
             "metadata": {
                 "name": self._pod_name(slice_id, worker),
                 "namespace": namespace,
-                "labels": {"app": APP_LABEL, "tpu.ai/slice": slice_id,
-                           "tpu.ai/worker-id": str(worker)},
-                "annotations": {"tpu.ai/config-hash": config_hash},
+                "labels": {"app": APP_LABEL, consts.MULTIHOST_SLICE_LABEL: slice_id,
+                           consts.MULTIHOST_WORKER_ID_LABEL: str(worker)},
+                "annotations": {consts.MULTIHOST_CONFIG_HASH_ANNOTATION: config_hash},
             },
             "spec": {
                 "restartPolicy": "Never",
@@ -178,7 +178,7 @@ class MultihostValidationState:
     def _teardown(self, slice_id: str, namespace: str, n_hint: int = 64) -> None:
         for pod in self.client.list("v1", "Pod", namespace,
                                     label_selector={"app": APP_LABEL,
-                                                    "tpu.ai/slice": slice_id}):
+                                                    consts.MULTIHOST_SLICE_LABEL: slice_id}):
             try:
                 self.client.delete("v1", "Pod", pod["metadata"]["name"], namespace)
             except NotFoundError:
@@ -200,9 +200,9 @@ class MultihostValidationState:
         resource = policy.spec.device_plugin.resource_name
         pods = self.client.list("v1", "Pod", namespace,
                                 label_selector={"app": APP_LABEL,
-                                                "tpu.ai/slice": slice_id})
+                                                consts.MULTIHOST_SLICE_LABEL: slice_id})
         stale = [p for p in pods
-                 if deep_get(p, "metadata", "annotations", "tpu.ai/config-hash")
+                 if deep_get(p, "metadata", "annotations", consts.MULTIHOST_CONFIG_HASH_ANNOTATION)
                  != config_hash]
         if stale:
             log.info("multihost %s: config changed, restarting validation", slice_id)

@@ -236,7 +236,7 @@ class KubeletSimulator:
         existing = {deep_get(p, "spec", "nodeName"): p
                     for p in self.client.list(
                         "v1", "Pod", self.namespace,
-                        label_selector={"tpu.ai/kubelet-sim-ds": ds_name})}
+                        label_selector={consts.KUBELET_SIM_DS_LABEL: ds_name})}
         node_names = {n["metadata"]["name"] for n in matching_nodes}
 
         # scale down: pods on nodes no longer matching
@@ -281,7 +281,7 @@ class KubeletSimulator:
                         updated += 1
             if pod is None:
                 labels = dict(deep_get(template, "metadata", "labels", default={}) or {})
-                labels["tpu.ai/kubelet-sim-ds"] = ds_name
+                labels[consts.KUBELET_SIM_DS_LABEL] = ds_name
                 new_pod = {
                     "apiVersion": "v1", "kind": "Pod",
                     "metadata": {

@@ -36,8 +36,11 @@ import uuid
 from collections import deque
 from typing import Dict, Iterator, List, Optional
 
+from . import consts
+
 #: Event annotation carrying the reconcile trace that emitted it
-TRACE_ID_ANNOTATION = "tpu.ai/trace-id"
+#: (key registered in consts.py; re-exported here for span-machinery users)
+TRACE_ID_ANNOTATION = consts.TRACE_ID_ANNOTATION
 
 #: env var carrying trace context into operand pods (stamped by the common
 #: manifest template from the reconciler's render data)
