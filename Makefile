@@ -82,6 +82,12 @@ MIGRATE_BENCH_SEED ?= 20260805
 migrate-bench:  ## end-to-end cross-node migration pair (cooperative drain-ack + wedged-trainer transparent snapshot) through the latency-injected simulator; fails unless both tenants resume on the destination at exactly the committed step (zero steps lost), the wedged one via the snapshot path (never a bare force-retile), inside the wall-clock budget
 	MIGRATE_BENCH_SEED=$(MIGRATE_BENCH_SEED) JAX_PLATFORMS=cpu $(PYTHON) bench.py --migrate
 
+FORENSICS_BENCH_SEED ?= 20260805
+
+.PHONY: forensics-bench
+forensics-bench:  ## causality-audited incident forensics: a seeded diurnal trough drives a migration-backed scale-down + recovery scale-up, then the audit proves every node delete / re-tile plan / snapshot / restore reachable from a complete cross-subsystem decision chain (zero orphans), the journal byte-deterministic across a record/replay double run, and the on-disk journal + episode convergent across an operator kill mid-episode
+	FORENSICS_BENCH_SEED=$(FORENSICS_BENCH_SEED) JAX_PLATFORMS=cpu $(PYTHON) bench.py --forensics
+
 .PHONY: generate
 generate:  ## regenerate CRDs into all install channels (reference: make manifests)
 	$(PYTHON) hack/gen-crds.py

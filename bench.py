@@ -400,6 +400,10 @@ AUTOSCALE_JOIN_DELAY_TICKS = 2
 #: capacity must be back within the replacement window
 AUTOSCALE_REVOKE_TICK = 70
 AUTOSCALE_REPLACEMENT_WINDOW_TICKS = 4
+#: un-measured tail: extra ticks granted after the diurnal curve so an
+#: in-flight scale-down can close its provenance episode before the
+#: causality audit runs (drains complete in <= 3 ticks with acks landing)
+AUTOSCALE_SETTLE_TICKS = 12
 
 
 class _ScaleDownAuditor:
@@ -470,6 +474,8 @@ def bench_autoscale(seed: int = None) -> dict:
     from tpu_operator.client.rest import RestClient
     from tpu_operator.controllers.runtime import Request
     from tpu_operator.health import drain as drain_protocol
+    from tpu_operator.provenance import (ActuationObserver, DecisionJournal,
+                                         causality_audit)
     from tpu_operator.testing import MiniApiServer, NodeChaos
     from tpu_operator.testing.kubelet import KubeletSimulator
     from tpu_operator.utils import deep_get
@@ -509,15 +515,20 @@ def bench_autoscale(seed: int = None) -> dict:
             "status": {"capacity": {consts.TPU_RESOURCE_NAME: str(chips)}}})
 
     clock = [0.0]
-    audit = _ScaleDownAuditor(RestClient(base_url=base), srv.backend)
+    # the causality observer wraps the INNERMOST client: batched writes
+    # are observed post-flush with their final merged bodies, exactly as
+    # they land on the apiserver
+    observer = ActuationObserver(RestClient(base_url=base))
+    audit = _ScaleDownAuditor(observer, srv.backend)
     # production chain shape minus the informer cache (the bench drives
     # sweeps synchronously on a simulated clock; the fence is unbound —
     # single replica, no elector — exactly the agent-passthrough mode)
     op_client = WriteBatcher(RetryingClient(FencedClient(audit)))
+    journal = DecisionJournal(client=op_client, now=lambda: clock[0])
     reconciler = AutoscaleReconciler(
         op_client, chips_per_node=chips,
         horizon_s=AUTOSCALE_JOIN_DELAY_TICKS * AUTOSCALE_TICK_S,
-        now=lambda: clock[0])
+        now=lambda: clock[0], journal=journal)
     chaos = NodeChaos(KubeletSimulator(feeder), seed=seed)
 
     def demand_at(tick: int) -> float:
@@ -526,6 +537,19 @@ def bench_autoscale(seed: int = None) -> dict:
         phase = 2.0 * math.pi * tick / AUTOSCALE_PERIOD_TICKS
         return max(0.0, 4.0 + 28.0 * (0.5 - 0.5 * math.cos(phase))
                    + rng.uniform(-1.5, 1.5))
+
+    def resize_in_flight() -> bool:
+        # read the durable decision state straight off the backend: the
+        # settle loop below must not end while a scale-down's provenance
+        # episode is still open (plan published, node not yet removed)
+        raw = deep_get(
+            srv.backend.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy"),
+            "metadata", "annotations", consts.AUTOSCALE_STATE_ANNOTATION)
+        try:
+            data = json.loads(raw) if raw else {}
+        except ValueError:
+            return False
+        return any((st or {}).get("resize") for st in data.values())
 
     try:
         first_seen: dict = {}
@@ -537,7 +561,15 @@ def bench_autoscale(seed: int = None) -> dict:
         replaced_at = None
         pre_revoke_count = None
         last_target = None
-        for tick in range(AUTOSCALE_TICKS):
+        tick = 0
+        # the measured episode is exactly AUTOSCALE_TICKS; the bounded
+        # settle tail (un-measured) lets a scale-down that was mid-drain
+        # at the curve's end finish, so the causality audit judges whole
+        # episodes instead of flagging an honest in-flight one
+        while tick < AUTOSCALE_TICKS or (
+                tick < AUTOSCALE_TICKS + AUTOSCALE_SETTLE_TICKS
+                and resize_in_flight()):
+            measuring = tick < AUTOSCALE_TICKS
             clock[0] = tick * AUTOSCALE_TICK_S
             if tick == AUTOSCALE_REVOKE_TICK:
                 pre_revoke_count = len(srv.backend.list("v1", "Node")) - 1
@@ -564,14 +596,15 @@ def bench_autoscale(seed: int = None) -> dict:
                        or tick - first_seen[n] >= AUTOSCALE_JOIN_DELAY_TICKS]
             capacity = len(serving) * chips
             demand = demand_at(tick)
-            peak_demand_nodes = max(peak_demand_nodes,
-                                    math.ceil(demand / chips))
             outstanding = queue + demand
             served = min(outstanding, capacity)
             attain = served / outstanding if outstanding > 0 else 1.0
             queue = outstanding - served
-            attainments.append(attain)
-            node_counts.append(len(names))
+            if measuring:
+                peak_demand_nodes = max(peak_demand_nodes,
+                                        math.ceil(demand / chips))
+                attainments.append(attain)
+                node_counts.append(len(names))
             # the traffic feed: per-tick snapshot annotation (the patch
             # doubles as the reconciler's watch wake in production)
             feeder.patch("tpu.ai/v1", "ClusterPolicy", "cluster-policy", {
@@ -597,6 +630,11 @@ def bench_autoscale(seed: int = None) -> dict:
             decisions = reconciler.debug_state()["autoscale"]["decisions"]
             if decisions:
                 last_target = sum(d["target"] for d in decisions)
+            tick += 1
+        # every audited actuation (node deletes, plan publishes) must be
+        # reachable from a complete decision chain in the journal — the
+        # forensics gate the ISSUE's "fleet black box" stands on
+        causality = causality_audit(journal, observer.observed)
         ups = sum(1 for name, t in first_seen.items() if t > 0)
         hours = AUTOSCALE_TICK_S / 3600.0
         node_hours = sum(node_counts) * hours
@@ -630,6 +668,9 @@ def bench_autoscale(seed: int = None) -> dict:
                     AUTOSCALE_REPLACEMENT_WINDOW_TICKS,
             },
             "final_queue_chips": round(queue, 3),
+            "settle_ticks": tick - AUTOSCALE_TICKS,
+            "causality": causality,
+            "journal": journal.debug_state(),
             "debug": reconciler.debug_state()["autoscale"],
         }
     finally:
@@ -673,6 +714,8 @@ def bench_migrate(seed: int = None) -> dict:
     from tpu_operator.health import drain as drain_protocol
     from tpu_operator.migrate import MigrationReconciler, migration_state
     from tpu_operator.migrate import agent as migrate_agent
+    from tpu_operator.provenance import (ActuationObserver, DecisionJournal,
+                                         causality_audit)
     from tpu_operator.testing import MiniApiServer
     from tpu_operator.testing.kubelet import KubeletSimulator
     from tpu_operator.testing.trainjob import SimulatedTrainingJob
@@ -705,9 +748,14 @@ def bench_migrate(seed: int = None) -> dict:
             "status": {"capacity": {consts.TPU_RESOURCE_NAME: str(chips)}}})
 
     clock = [0.0]
-    op_client = WriteBatcher(RetryingClient(FencedClient(
-        RestClient(base_url=base))))
-    reconciler = MigrationReconciler(op_client, now=lambda: clock[0])
+    # causality observer at the very bottom of the chain (post-flush
+    # bodies), decision journal shared with the reconciler — the audit
+    # below must chain every plan/snapshot/restore to a recorded decision
+    observer = ActuationObserver(RestClient(base_url=base))
+    op_client = WriteBatcher(RetryingClient(FencedClient(observer)))
+    journal = DecisionJournal(client=op_client, now=lambda: clock[0])
+    reconciler = MigrationReconciler(op_client, now=lambda: clock[0],
+                                     journal=journal)
     kubelet = KubeletSimulator(feeder)
     statuses = {}
     for name in ("tpu-a", "tpu-b", "tpu-c", "tpu-d"):
@@ -769,6 +817,7 @@ def bench_migrate(seed: int = None) -> dict:
         namespace = consts.DEFAULT_NAMESPACE
         reasons = [e.get("reason") for e in
                    srv.backend.list("v1", "Event", namespace)]
+        causality = causality_audit(journal, observer.observed)
         return {
             "simulated": True,
             "seed": seed,
@@ -780,6 +829,8 @@ def bench_migrate(seed: int = None) -> dict:
             "snapshot_used": "snapshotting" in ep2["phases"],
             "event_reasons": sorted(set(r for r in reasons if r)),
             "force_retiles": reasons.count("RetileDeadlineExpired"),
+            "causality": causality,
+            "journal": journal.debug_state(),
         }
     finally:
         op_client.stop()
@@ -789,6 +840,261 @@ def bench_migrate(seed: int = None) -> dict:
         else:
             os.environ[migrate_agent.TRANSFER_DIR_ENV] = prior_transfer
         shutil.rmtree(tmp, ignore_errors=True)
+
+
+#: seed for `make forensics-bench` (overridable via $FORENSICS_BENCH_SEED):
+#: pins the demand jitter; the scenario is synchronous, single-threaded,
+#: and clock-free, so two runs under one seed must journal identically
+FORENSICS_BENCH_SEED = 20260805
+FORENSICS_TICK_S = 10.0
+FORENSICS_TICKS = 48
+#: demand drops at this tick: the scale-down decision lands ~2 ticks
+#: later and the delegated migration completes a few ticks after that
+FORENSICS_TROUGH_TICK = 6
+#: demand returns here -> a scale-up episode after the scale-down closes
+FORENSICS_RECOVER_TICK = 34
+#: the operator kill lands strictly mid-episode: after the scale-down
+#: decision was recorded (~tick 8), before its outcome record (>= tick 10's
+#: reconciles — the kill fires at the top of the tick, ahead of them)
+FORENSICS_KILL_TICK = 10
+
+
+def _forensics_pass(seed: int, kill_at_tick: int = None) -> dict:
+    """One synchronous pass of the forensics scenario: a 2-node fleet with
+    a training tenant on tpu-a, driven tick-by-tick on a simulated clock.
+    Demand drops, the REAL autoscaler begins a migration-backed scale-down
+    of tpu-a (recording its decision and stamping the episode annotation),
+    the REAL MigrationReconciler adopts the episode and chains its
+    drain/transfer/restore records into it, the node is deleted, and a
+    later demand return scales back up — one cross-subsystem episode plus
+    a scale-up episode, every actuation journaled write-ahead.
+
+    With ``kill_at_tick`` the operator is killed mid-episode: journal and
+    reconcilers are discarded and rebuilt, the journal reloading from its
+    on-disk JSONL. Content-addressed record ids make the replay converge
+    on the exact same canonical export as an uninterrupted run — the
+    bench's record/replay determinism gate."""
+    import random as _random
+    import tempfile
+
+    from tpu_operator import consts
+    from tpu_operator.api.clusterpolicy import new_cluster_policy
+    from tpu_operator.autoscale import AutoscaleReconciler
+    from tpu_operator.client.batch import WriteBatcher
+    from tpu_operator.client.fenced import FencedClient
+    from tpu_operator.client.resilience import RetryingClient
+    from tpu_operator.client.rest import RestClient
+    from tpu_operator.controllers.runtime import Request
+    from tpu_operator.health import drain as drain_protocol
+    from tpu_operator.migrate import MigrationReconciler
+    from tpu_operator.migrate import agent as migrate_agent
+    from tpu_operator.provenance import (ActuationObserver, DecisionJournal,
+                                         causality_audit, render_explain)
+    from tpu_operator.testing import MiniApiServer
+    from tpu_operator.testing.kubelet import KubeletSimulator
+    from tpu_operator.testing.trainjob import SimulatedTrainingJob
+    from tpu_operator.validator.status import StatusFiles
+
+    rng = _random.Random(seed)
+    chips = 4
+    accelerator = "tpu-v5-lite-podslice"
+    tmp = tempfile.mkdtemp(prefix="forensics-bench-")
+    journal_path = os.path.join(tmp, "journal.jsonl")
+    prior_transfer = os.environ.get(migrate_agent.TRANSFER_DIR_ENV)
+    os.environ[migrate_agent.TRANSFER_DIR_ENV] = tmp
+    srv = MiniApiServer()  # zero injected latency: determinism over realism
+    base = srv.start()
+    feeder = RestClient(base_url=base)  # node agents + trainer + ack mirror
+    feeder.create(new_cluster_policy(spec={
+        "autoscale": {"enabled": True, "targetSloAttainment": 0.95,
+                      "headroomPct": 20.0,
+                      "scaleDownDelayS": 15,      # 1.5 ticks of trough
+                      "cooldownS": 10,            # one tick
+                      "windowS": 100,             # 10-tick forecast window
+                      "minNodes": {"default": 1},
+                      "maxNodes": {"default": 3}},
+        "migrate": {"enabled": True, "snapshotWaitS": 20,
+                    "restoreWaitS": 60},
+        "health": {"drainDeadlineS": 30},
+    }))
+    for name in ("tpu-a", "tpu-b"):
+        feeder.create({
+            "apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": name, "labels": {
+                consts.GKE_TPU_ACCELERATOR_LABEL: accelerator,
+                consts.GKE_TPU_TOPOLOGY_LABEL: "2x2"}},
+            "status": {"capacity": {consts.TPU_RESOURCE_NAME: str(chips)}}})
+
+    clock = [0.0]
+    observer = ActuationObserver(RestClient(base_url=base))
+    op_client = WriteBatcher(RetryingClient(FencedClient(observer)))
+    journal = DecisionJournal(client=op_client, path=journal_path,
+                              now=lambda: clock[0])
+
+    def build_reconcilers(j):
+        return (AutoscaleReconciler(op_client, chips_per_node=chips,
+                                    horizon_s=FORENSICS_TICK_S,
+                                    now=lambda: clock[0], journal=j),
+                MigrationReconciler(op_client, now=lambda: clock[0],
+                                    journal=j))
+
+    autoscaler, migrator = build_reconcilers(journal)
+    kubelet = KubeletSimulator(feeder)
+    statuses = {}
+    for name in ("tpu-a", "tpu-b"):
+        statuses[name] = StatusFiles(os.path.join(tmp, name))
+        kubelet.attach_migrate_agent(name, statuses[name],
+                                     accelerator=accelerator,
+                                     total_chips=chips)
+    job = SimulatedTrainingJob(feeder, "tpu-a", statuses["tpu-a"],
+                               partition="2x2")
+
+    def demand_at(tick: int) -> float:
+        high = (tick < FORENSICS_TROUGH_TICK
+                or tick >= FORENSICS_RECOVER_TICK)
+        # 5 chips needs 2 nodes with 20% headroom, 1 chip needs 1; the
+        # jitter stays far from either threshold so seeded runs make the
+        # same DECISIONS (the determinism gate compares canonical records,
+        # which exclude the forecast enrichment)
+        return (5.0 if high else 1.0) + rng.uniform(-0.2, 0.2)
+
+    records_at_reload = None
+    try:
+        for tick in range(FORENSICS_TICKS):
+            clock[0] = tick * FORENSICS_TICK_S
+            if kill_at_tick is not None and tick == kill_at_tick:
+                # the operator kill: every in-memory structure is dropped;
+                # the journal reloads from its on-disk JSONL and the
+                # rebuilt reconcilers resume the half-finished episode
+                # from cluster state alone
+                journal = DecisionJournal(client=op_client,
+                                          path=journal_path,
+                                          now=lambda: clock[0])
+                records_at_reload = journal.debug_state()["records"]
+                autoscaler, migrator = build_reconcilers(journal)
+            names = {n["metadata"]["name"]
+                     for n in srv.backend.list("v1", "Node")}
+            feeder.patch("tpu.ai/v1", "ClusterPolicy", "cluster-policy", {
+                "metadata": {"annotations": {
+                    consts.TRAFFIC_SNAPSHOT_ANNOTATION: json.dumps({
+                        "ts": clock[0],
+                        "queue_depth": 0.0,
+                        "backlog_chips": round(demand_at(tick), 3),
+                        "attainment": 1.0})}}})
+            if "tpu-a" in names:
+                job.tick()
+            for name in statuses:
+                if name not in names:
+                    continue  # source already scaled away
+                ack = drain_protocol.read_drain_ack(statuses[name])
+                value = drain_protocol.ack_annotation_value(ack)
+                if value:
+                    feeder.patch("v1", "Node", name, {
+                        "metadata": {"annotations": {
+                            consts.DRAIN_ACK_ANNOTATION: value}}})
+            kubelet.tick()
+            autoscaler.reconcile(Request(name="cluster-policy"))
+            for name in sorted(n["metadata"]["name"]
+                               for n in srv.backend.list("v1", "Node")):
+                migrator.reconcile(Request(name=name))
+        causality = causality_audit(journal, observer.observed)
+        return {
+            "observed_actuations": len(observer.observed),
+            "causality": causality,
+            "journal": journal.debug_state(),
+            "export": journal.canonical_export(),
+            "episodes": journal.episodes(),
+            "records_at_reload": records_at_reload,
+            "explain": render_explain(journal.timeline(node="tpu-a"),
+                                      node="tpu-a"),
+            "nodes_final": sorted(
+                n["metadata"]["name"]
+                for n in srv.backend.list("v1", "Node")),
+        }
+    finally:
+        op_client.stop()
+        srv.stop()
+        if prior_transfer is None:
+            os.environ.pop(migrate_agent.TRANSFER_DIR_ENV, None)
+        else:
+            os.environ[migrate_agent.TRANSFER_DIR_ENV] = prior_transfer
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def bench_forensics(seed: int = None) -> dict:
+    """`make forensics-bench`: the decision-provenance journal's end-to-end
+    audit (seed-pinned). Three passes of the synchronous cross-subsystem
+    scenario: a record run, a replay run (identical seed — the canonical
+    exports must match byte-for-byte), and a crash run with the operator
+    killed mid-episode (the journal must reload from disk, the replay must
+    dedupe into the same content-addressed records, and the final export
+    must equal the uninterrupted run's)."""
+    seed = int(os.environ.get("FORENSICS_BENCH_SEED",
+                              FORENSICS_BENCH_SEED)) if seed is None else seed
+    wall0 = time.monotonic()
+    record = _forensics_pass(seed)
+    replay = _forensics_pass(seed)
+    crash = _forensics_pass(seed, kill_at_tick=FORENSICS_KILL_TICK)
+    subsystems_by_episode: dict = {}
+    for rec in record["export"]:
+        subsystems_by_episode.setdefault(
+            rec["episode"], set()).add(rec["subsystem"])
+    return {
+        "simulated": True,
+        "seed": seed,
+        "tick_s": FORENSICS_TICK_S,
+        "ticks": FORENSICS_TICKS,
+        "wall_s": round(time.monotonic() - wall0, 3),
+        "observed_actuations": record["observed_actuations"],
+        "causality": record["causality"],
+        "journal": record["journal"],
+        "episodes": record["episodes"],
+        "nodes_final": record["nodes_final"],
+        "cross_subsystem_episode": any(
+            len(s) > 1 for s in subsystems_by_episode.values()),
+        "journal_deterministic": record["export"] == replay["export"],
+        "crash": {
+            "kill_at_tick": FORENSICS_KILL_TICK,
+            "records_at_reload": crash["records_at_reload"],
+            "replayed_total": crash["journal"]["replayed_total"],
+            "causality": crash["causality"],
+            "consistent_with_record_run":
+                crash["export"] == record["export"],
+        },
+        "explain": record["explain"],
+    }
+
+
+def forensics_bench_main() -> int:
+    """`make forensics-bench`: one JSON line; exit 0 iff zero orphan
+    actuations with every episode complete, at least one episode crossed a
+    subsystem boundary (autoscale -> migrate), the record/replay double
+    run exported identical canonical journals, the mid-episode operator
+    kill preserved the journal (non-empty reload, audit still clean,
+    export identical to the uninterrupted run), and `tpuop-cfg explain`'s
+    renderer produced the full causal chain for the bench's episode."""
+    out = bench_forensics()
+    causality = out["causality"]
+    crash = out["crash"]
+    explain = out["explain"]
+    gates = {
+        "zero_orphans": not causality["orphans"],
+        "zero_incomplete": not causality["incomplete"],
+        "all_episodes_complete": (
+            causality["episodes"] > 0
+            and causality["complete_episodes"] == causality["episodes"]),
+        "cross_subsystem_episode": out["cross_subsystem_episode"],
+        "journal_deterministic": out["journal_deterministic"],
+        "crash_journal_survived": (crash["records_at_reload"] or 0) > 0,
+        "crash_causality_ok": crash["causality"]["ok"],
+        "crash_replay_consistent": crash["consistent_with_record_run"],
+        "explain_renders_chain": ("scale-down" in explain
+                                  and "migrate" in explain
+                                  and "outcome: node-deleted" in explain),
+    }
+    line = {"metric": "forensics_bench", "gates": gates, "forensics": out}
+    print(json.dumps(line))
+    return 0 if all(gates.values()) else 1
 
 
 #: matrix dim for the join bench's real node-side ICI sweep: small enough
@@ -1433,6 +1739,13 @@ def autoscale_bench_main() -> int:
             and rev["revoked_at_tick"] is not None
             and rev["replaced_at_tick"] - rev["revoked_at_tick"]
             <= rev["replacement_window_ticks"]),
+        # forensics: every node delete and plan publish reachable from a
+        # complete decision chain — zero orphan actuations
+        "causality_audit_ok": out["causality"]["ok"],
+        "all_episodes_complete": (
+            out["causality"]["episodes"] > 0
+            and out["causality"]["complete_episodes"]
+            == out["causality"]["episodes"]),
     }
     line = {"metric": "autoscale_episode", "autoscale": out,
             "gates": gates}
@@ -1463,6 +1776,13 @@ def migrate_bench_main() -> int:
         "snapshot_path_used": out["snapshot_used"],
         "no_bare_force_retile": out["force_retiles"] == 0,
         "wall_under_budget": out["wall_s"] <= out["wall_budget_s"],
+        # forensics: every plan/snapshot/restore actuation reachable from
+        # a complete decision chain — zero orphan actuations
+        "causality_audit_ok": out["causality"]["ok"],
+        "all_episodes_complete": (
+            out["causality"]["episodes"] > 0
+            and out["causality"]["complete_episodes"]
+            == out["causality"]["episodes"]),
     }
     line = {"metric": "migration_episode", "migrate": out, "gates": gates}
     print(json.dumps(line))
@@ -1518,4 +1838,6 @@ if __name__ == "__main__":
         sys.exit(autoscale_bench_main())
     if "--migrate" in _argv:
         sys.exit(migrate_bench_main())
+    if "--forensics" in _argv:
+        sys.exit(forensics_bench_main())
     sys.exit(main())

@@ -751,7 +751,7 @@ def lint_in_project(sources, relpath, rule, docs_text=None):
 
 GRAPH_RULES = ("annotation-registry", "deadline-propagation",
                "exactly-once-event", "lock-order-inversion",
-               "state-before-actuation")
+               "provenance-discipline", "state-before-actuation")
 
 
 @pytest.mark.parametrize("rule", GRAPH_RULES)
@@ -915,6 +915,107 @@ def test_state_before_actuation_suppressed():
             '            client.create({"kind": "Node"})\n'
             '            self._persist(client)'),
         "tpu_operator/autoscale/controller.py", "state-before-actuation")
+    assert kept == [] and dropped == 1
+
+
+# -- provenance-discipline ----------------------------------------------------
+
+PROVENANCE_BODY_TEMPLATE = """
+    class Machine:
+        def reconcile(self, client):
+            {body}
+
+        def _record_and_recycle(self, client, pod):
+            self.journal.record_decision(
+                "health", "recycle", "ep-1", {{"reason": "unhealthy"}})
+            self._recycle(client, pod)
+
+        def _recycle(self, client, pod):
+            client.delete("v1", "Pod", pod)
+
+        def _publish_plan(self, node):
+            pass
+"""
+
+
+def _provenance_tree(body, relpath="tpu_operator/health/machine.py"):
+    return {relpath: PROVENANCE_BODY_TEMPLATE.format(body=body)}
+
+
+def test_provenance_discipline_positive_direct_delete():
+    # health/ is in scope even though LintConfig.reconcile_dirs omits it
+    kept, _ = lint_in_project(
+        _provenance_tree('client.delete("v1", "Node", "n")'),
+        "tpu_operator/health/machine.py", "provenance-discipline")
+    assert rules_of(kept) == ["provenance-discipline"]
+    assert "orphan actuation" in kept[0].message
+
+
+def test_provenance_discipline_positive_uncovered_helper():
+    # the caller's resolved call is not a verb, but the helper's own
+    # delete is — and no recorder anywhere in the tree reaches it
+    # (contrast with _recycle, which _record_and_recycle covers)
+    kept, _ = lint_in_project({
+        "tpu_operator/health/sweep.py": """
+            class Sweeper:
+                def reconcile(self, client):
+                    self._rogue_delete(client, "p")
+
+                def _rogue_delete(self, client, pod):
+                    client.delete("v1", "Pod", pod)
+        """,
+    }, "tpu_operator/health/sweep.py", "provenance-discipline")
+    assert rules_of(kept) == ["provenance-discipline"]
+    assert "Sweeper._rogue_delete actuates" in kept[0].message
+
+
+def test_provenance_discipline_positive_plan_publish():
+    # _publish_plan is actuating even though it resolves in-project
+    kept, _ = lint_in_project(
+        _provenance_tree('self._publish_plan("n")'),
+        "tpu_operator/health/machine.py", "provenance-discipline")
+    assert rules_of(kept) == ["provenance-discipline"]
+    assert "_publish_plan()" in kept[0].message
+
+
+def test_provenance_discipline_negative_recorder_reaches_helper():
+    # _record_and_recycle records, so _recycle is reachable from a
+    # recorder: the delete is licensed by the write-ahead record
+    kept, _ = lint_in_project(
+        _provenance_tree('self._record_and_recycle(client, "p")'),
+        "tpu_operator/health/machine.py", "provenance-discipline")
+    assert kept == []
+
+
+def test_provenance_discipline_negative_recorder_actuates_inline():
+    kept, _ = lint_in_project(
+        _provenance_tree('self.journal.record_decision(\n'
+                         '                "health", "recycle", "ep-1", {})\n'
+                         '            client.delete("v1", "Node", "n")'),
+        "tpu_operator/health/machine.py", "provenance-discipline")
+    assert kept == []
+
+
+def test_provenance_discipline_negative_events_and_out_of_scope():
+    # Event GC is not fleet actuation
+    kept, _ = lint_in_project(
+        _provenance_tree('events.delete(client, "stale")'),
+        "tpu_operator/health/machine.py", "provenance-discipline")
+    assert kept == []
+    # same shape in cmd/: out of scope
+    kept, _ = lint_in_project(
+        _provenance_tree('client.delete("v1", "Node", "n")',
+                         relpath="tpu_operator/cmd/tool.py"),
+        "tpu_operator/cmd/tool.py", "provenance-discipline")
+    assert kept == []
+
+
+def test_provenance_discipline_suppressed():
+    kept, dropped = lint_in_project(
+        _provenance_tree(
+            '# opalint: disable=provenance-discipline — scratch-object GC\n'
+            '            client.delete("v1", "ConfigMap", "tmp")'),
+        "tpu_operator/health/machine.py", "provenance-discipline")
     assert kept == [] and dropped == 1
 
 
@@ -1163,6 +1264,10 @@ POSITIVE_FIXTURES = {
         "tpu_operator/autoscale/controller.py": ACTUATE_BODY_TEMPLATE.format(
             body='client.create({"kind": "Node"})\n'
                  '            self._persist(client)'),
+    },
+    "provenance-discipline": {
+        "tpu_operator/health/machine.py": PROVENANCE_BODY_TEMPLATE.format(
+            body='client.delete("v1", "Node", "n")'),
     },
     "deadline-propagation": {
         "tpu_operator/controllers/sync.py": DEADLINE_ENTRY,

@@ -392,3 +392,50 @@ partitions:
     assert run(["validate-partitions", str(good),
                 "--accelerator", "tpu-v4-podslice", "--chips", "4"]) == 0
     assert "1x1x1" in capsys.readouterr().out
+
+
+def test_explain_renders_chain_from_disk_journal(tmp_path, capsys):
+    from tpu_operator.cfgtool.main import run as cfg_run
+    from tpu_operator.provenance import DecisionJournal
+
+    path = str(tmp_path / "journal.jsonl")
+    j = DecisionJournal(path=path, now=lambda: 100.0)
+    j.record_decision(
+        "autoscale", "scale-down", "ep-disk",
+        {"type": "traffic-snapshot"}, decision={"victim": "tpu-a"},
+        alternatives=[{"option": "hold", "reason": "forecast low"}],
+        actuations=[{"verb": "delete", "kind": "Node", "name": "tpu-a"}],
+        outcome="node-deleted", node="tpu-a")
+
+    assert cfg_run(["explain", "node", "tpu-a",
+                    "--journal-path", path]) == 0
+    text = capsys.readouterr().out
+    assert "episode ep-disk" in text and "outcome: node-deleted" in text
+    # unknown node: exit 1, friendly message
+    assert cfg_run(["explain", "node", "ghost",
+                    "--journal-path", path]) == 1
+    assert "no decision records" in capsys.readouterr().out
+
+
+def test_explain_falls_back_to_mirror_configmaps(capsys):
+    from tpu_operator.cfgtool.main import run as cfg_run
+    from tpu_operator.client.rest import RestClient
+    from tpu_operator.provenance import DecisionJournal
+    from tpu_operator.testing import MiniApiServer
+
+    srv = MiniApiServer()
+    base = srv.start()
+    try:
+        j = DecisionJournal(client=RestClient(base_url=base),
+                            namespace="tpu-operator", now=lambda: 50.0)
+        j.record_decision(
+            "migrate", "migrate", "ep-cm", {"type": "annotation"},
+            decision={"src": "tpu-a", "dst": "tpu-b"},
+            actuations=[{"verb": "plan", "kind": "Node", "name": "tpu-a"}],
+            outcome="restored", node="tpu-a")
+        assert cfg_run(["explain", "episode", "ep-cm",
+                        "--base-url", base]) == 0
+        text = capsys.readouterr().out
+        assert "episode ep-cm" in text and "migrate/migrate" in text
+    finally:
+        srv.stop()

@@ -259,3 +259,90 @@ def test_tpudriver_reconcile_produces_cross_referenced_trace(monkeypatch):
         cached.stop()
         kubelet.stop()
         srv.stop()
+
+
+# -- /debug/timeline & must-gather parity -------------------------------------
+
+def test_debug_timeline_serves_journal_with_filters(monkeypatch):
+    """/debug/timeline renders the decision journal newest-first with
+    ?node=/?episode=/?limit= filters — the same records `tpuop-cfg
+    explain` and must-gather consume."""
+    for env in OPERAND_IMAGE_ENVS:
+        monkeypatch.setenv(env, "gcr.io/tpu/x:0.1.0")
+    srv = MiniApiServer()
+    base = srv.start()
+    hport = _free_port()
+    app = OperatorApp(RestClient(base_url=base), health_port=hport)
+    app.start_servers()
+    debug = f"http://127.0.0.1:{hport}"
+    try:
+        app.journal.record_decision(
+            "autoscale", "scale-down", "ep-t1",
+            {"source": "traffic-snapshot"}, node="node-a",
+            decision={"victim": "node-a"},
+            actuations=[{"verb": "delete", "kind": "Node",
+                         "name": "node-a"}])
+        app.journal.record_decision(
+            "migrate", "migrate-complete", "ep-t1",
+            {"source": "annotation"}, node="node-a", outcome="restored")
+        app.journal.record_decision(
+            "health", "drain", "ep-t2",
+            {"source": "chip-health"}, node="node-b")
+
+        body = rq.get(f"{debug}/debug/timeline", timeout=5).json()
+        assert body["count"] == 3
+        assert {"stats", "episodes", "records"} <= set(body)
+        # newest-first: the health record landed last
+        assert body["records"][0]["episode"] == "ep-t2"
+        assert body["stats"]["open_episodes"] == 1  # ep-t2 has no outcome
+
+        by_node = rq.get(f"{debug}/debug/timeline?node=node-a",
+                         timeout=5).json()
+        assert by_node["count"] == 2
+        assert {r["episode"] for r in by_node["records"]} == {"ep-t1"}
+
+        by_ep = rq.get(f"{debug}/debug/timeline?episode=ep-t2",
+                       timeout=5).json()
+        assert by_ep["count"] == 1
+        assert by_ep["records"][0]["subsystem"] == "health"
+
+        limited = rq.get(f"{debug}/debug/timeline?limit=1",
+                         timeout=5).json()
+        assert limited["count"] == 1
+    finally:
+        app.stop()
+        srv.stop()
+
+
+def test_must_gather_snapshots_every_debug_route(monkeypatch):
+    """Endpoint parity: every /debug/* route the health server answers
+    must be snapshotted by must-gather. Both sides derive from
+    controllers.manager.DEBUG_ROUTES, so a new route added to the server
+    but dropped from the bundle (or vice versa) fails here, not in an
+    incident."""
+    from tpu_operator.cmd.must_gather import debug_endpoint_files
+    from tpu_operator.controllers.manager import DEBUG_ROUTES
+
+    covered = dict(debug_endpoint_files())
+    assert set(covered) == set(DEBUG_ROUTES)
+    assert "/debug/timeline" in covered  # the provenance surface rides along
+    # bundle filenames are unique and carry a parseable extension
+    fnames = list(covered.values())
+    assert len(set(fnames)) == len(fnames)
+    assert all(f.endswith((".json", ".txt")) for f in fnames)
+
+    # and the server really answers every route DEBUG_ROUTES declares
+    for env in OPERAND_IMAGE_ENVS:
+        monkeypatch.setenv(env, "gcr.io/tpu/x:0.1.0")
+    srv = MiniApiServer()
+    base = srv.start()
+    hport = _free_port()
+    app = OperatorApp(RestClient(base_url=base), health_port=hport)
+    app.start_servers()
+    try:
+        for route in DEBUG_ROUTES:
+            resp = rq.get(f"http://127.0.0.1:{hport}{route}", timeout=5)
+            assert resp.status_code == 200, route
+    finally:
+        app.stop()
+        srv.stop()

@@ -1,0 +1,363 @@
+"""Decision-provenance journal (tpu_operator/provenance/, docs/design.md
+§17): the fleet black box.
+
+Four layers, mirroring the package's split:
+
+* the journal — content-addressed record identity (crash replays dedupe
+  instead of forking history), episode chaining and closure, the
+  closed-episodes-first prune bound, JSONL persistence with torn-line
+  tolerance, and the ConfigMap mirror's AlreadyExists stand-down;
+* the audit — the ActuationObserver's wire-level classification and
+  ``causality_audit``'s orphan / incomplete verdicts;
+* the surfaces — metrics wiring (`wire_provenance`) and the
+  ``tpuop-cfg explain`` renderer;
+* the protocol contract — every autoscale/migration protocol Event
+  carries ``tpu.ai/trace-id``, even when the reconciler is driven
+  outside a runtime worker (the ``ensure_trace`` fallback root).
+
+The end-to-end story — diurnal scale-down, cross-subsystem episode,
+operator kill mid-episode, zero orphans — is ``make forensics-bench``.
+"""
+
+import json
+
+from tpu_operator import consts, tracing
+from tpu_operator.api.clusterpolicy import new_cluster_policy
+from tpu_operator.autoscale.controller import AutoscaleReconciler
+from tpu_operator.client.errors import AlreadyExistsError
+from tpu_operator.client.fake import FakeClient
+from tpu_operator.controllers.metrics import OperatorMetrics
+from tpu_operator.controllers.runtime import Request
+from tpu_operator.health import drain
+from tpu_operator.migrate.controller import MigrationReconciler, migration_state
+from tpu_operator.provenance import (
+    ActuationObserver,
+    DecisionJournal,
+    ObservedActuation,
+    causality_audit,
+    episode_id,
+    render_explain,
+)
+
+NS = "tpu-operator"
+
+TPU_LABELS = {
+    consts.TPU_PRESENT_LABEL: "true",
+    consts.GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice",
+    consts.GKE_TPU_TOPOLOGY_LABEL: "2x2",
+}
+
+
+class Clock:
+    def __init__(self, t=1_000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def mk_node(name):
+    return {"apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": name, "labels": dict(TPU_LABELS)},
+            "status": {"capacity": {consts.TPU_RESOURCE_NAME: "4"}}}
+
+
+def record_scale_down(j, episode="ep-1", victim="tpu-a", inputs=None):
+    return j.record_decision(
+        "autoscale", "scale-down", episode,
+        {"type": "traffic-snapshot", "pool": "p"},
+        inputs=inputs or {"attainment": 0.99},
+        decision={"victim": victim},
+        alternatives=[{"option": "hold", "reason": "forecast below target"}],
+        actuations=[{"verb": "plan", "kind": "Node", "name": victim}],
+        node=victim)
+
+
+def close_episode(j, episode="ep-1", victim="tpu-a"):
+    return j.record_decision(
+        "autoscale", "scale-down-complete", episode,
+        {"type": "drain-ack"}, decision={"node": victim},
+        actuations=[{"verb": "delete", "kind": "Node", "name": victim}],
+        outcome="node-deleted", node=victim)
+
+
+# -- record identity ----------------------------------------------------------
+
+def test_replayed_decision_dedupes_on_content():
+    """A crash-restarted reconciler re-deciding the same step recomputes
+    slightly different inputs but the SAME canonical decision — the
+    replay dedupes onto the original record instead of forking."""
+    clock = Clock()
+    j = DecisionJournal(now=clock)
+    first = record_scale_down(j, inputs={"attainment": 0.99})
+    clock.t += 30.0
+    replay = record_scale_down(j, inputs={"attainment": 0.97})
+    assert replay is first  # same id, same ts, no second append
+    assert j.recorded_total == 1 and j.replayed_total == 1
+    # a genuinely different decision is a new record
+    other = record_scale_down(j, victim="tpu-b", episode="ep-2")
+    assert other.record_id != first.record_id
+
+
+def test_episode_id_is_content_addressed():
+    assert episode_id("scale-down", "tpu-a") == episode_id(
+        "scale-down", "tpu-a")
+    assert episode_id("scale-down", "tpu-a") != episode_id(
+        "scale-down", "tpu-b")
+    assert episode_id("x").startswith("ep-")
+
+
+# -- episode chaining & closure -----------------------------------------------
+
+def test_episode_chains_and_closes_across_subsystems():
+    clock = Clock()
+    j = DecisionJournal(now=clock)
+    record_scale_down(j)
+    clock.t += 10.0
+    j.record_decision("migrate", "migrate", "ep-1",
+                      {"type": "annotation"}, node="tpu-a")
+    assert not j.episode_complete("ep-1")
+    assert j.oldest_open_age() == 10.0
+    clock.t += 20.0
+    close_episode(j)
+    chain = j.chain("ep-1")
+    assert [r.subsystem for r in chain] == ["autoscale", "migrate",
+                                            "autoscale"]
+    assert [r.seq for r in chain] == [0, 1, 2]
+    assert j.episode_complete("ep-1")
+    assert j.oldest_open_age() == 0.0
+    (ep,) = j.episodes()
+    assert ep["closed"] and ep["duration_s"] == 30.0 and ep["kind"] == \
+        "scale-down"
+
+
+def test_prune_evicts_closed_episodes_before_open_ones():
+    """Past the bound, oldest CLOSED episodes go first — the open episode
+    is exactly the one an operator will ask about."""
+    clock = Clock()
+    j = DecisionJournal(now=clock, bound=4)
+    record_scale_down(j, episode="ep-open", victim="tpu-z")  # stays open
+    for i in range(3):
+        clock.t += 1.0
+        record_scale_down(j, episode=f"ep-{i}", victim=f"tpu-{i}")
+        close_episode(j, episode=f"ep-{i}", victim=f"tpu-{i}")
+    assert len(j.records()) <= 4 and j.pruned_total > 0
+    assert j.chain("ep-open"), "open episode must survive pruning"
+    assert not j.episodes()[0]["closed"] or j.chain("ep-open")
+
+
+# -- persistence & crash semantics --------------------------------------------
+
+def test_disk_roundtrip_skips_torn_final_line(tmp_path):
+    path = str(tmp_path / "journal.jsonl")
+    clock = Clock()
+    j = DecisionJournal(now=clock, path=path)
+    record_scale_down(j)
+    close_episode(j)
+    # a crash mid-append leaves a torn final line: costs that line only
+    with open(path, "a", encoding="utf-8") as f:
+        f.write('{"episode": "ep-torn", "subsys')
+    j2 = DecisionJournal(now=clock, path=path)
+    assert len(j2.records()) == 2
+    assert j2.episode_complete("ep-1")
+    assert j2.canonical_export() == j.canonical_export()
+
+
+def test_crash_mid_episode_reloads_and_converges(tmp_path):
+    """Kill after the decision, before the outcome: the reloaded journal
+    carries the open episode; the replayed decision dedupes and the
+    late outcome closes the ORIGINAL episode."""
+    path = str(tmp_path / "journal.jsonl")
+    clock = Clock()
+    j = DecisionJournal(now=clock, path=path)
+    record_scale_down(j)
+    # -- operator dies here; a fresh process reloads from disk --
+    j2 = DecisionJournal(now=clock, path=path)
+    assert len(j2.records()) == 1 and not j2.episode_complete("ep-1")
+    record_scale_down(j2)          # crash replay of the same decision
+    assert j2.replayed_total == 1 and j2.recorded_total == 0
+    close_episode(j2)
+    assert j2.episode_complete("ep-1")
+
+
+def test_configmap_mirror_and_already_exists_stand_down():
+    client = FakeClient()
+    j = DecisionJournal(client=client, namespace=NS)
+    rec = record_scale_down(j)
+    cm = client.get("v1", "ConfigMap", f"prov-{rec.record_id}", NS)
+    assert cm["metadata"]["labels"][consts.PROVENANCE_LABEL] == "autoscale"
+    assert json.loads(cm["data"]["record"])["episode"] == "ep-1"
+    # a second journal (restarted operator, empty memory) re-records:
+    # the mirror already exists — stand down, not an error
+    j2 = DecisionJournal(client=client, namespace=NS)
+    record_scale_down(j2)
+    assert j2.mirror_errors_total == 0
+    # the mirror really does collide (guard against a silent rename)
+    try:
+        client.create(cm)
+        raise AssertionError("expected AlreadyExistsError")
+    except AlreadyExistsError:
+        pass
+
+
+# -- causality audit ----------------------------------------------------------
+
+def test_observer_classifies_wire_actuations():
+    client = FakeClient()
+    client.create(mk_node("tpu-a"))
+    client.create(mk_node("tpu-b"))
+    obs = ActuationObserver(client)
+    obs.patch("v1", "Node", "tpu-a", {"metadata": {"annotations": {
+        consts.RETILE_PLAN_ANNOTATION: "{}"}}})
+    obs.patch("v1", "Node", "tpu-a", {"metadata": {"annotations": {
+        consts.MIGRATE_SNAPSHOT_REQUEST_ANNOTATION: "{}"}}})
+    obs.patch("v1", "Node", "tpu-b", {"metadata": {"annotations": {
+        consts.MIGRATION_INBOUND_ANNOTATION: "{}"}}})
+    # clearing a key is bookkeeping, not actuation
+    obs.patch("v1", "Node", "tpu-a", {"metadata": {"annotations": {
+        consts.RETILE_PLAN_ANNOTATION: None}}})
+    client.create({"apiVersion": "v1", "kind": "Pod",
+                   "metadata": {"name": "some-pod", "namespace": NS}})
+    obs.delete("v1", "Node", "tpu-a")
+    obs.delete("v1", "Pod", "some-pod", NS)  # pods are not audited
+    assert [o.verb for o in obs.observed] == [
+        "plan", "snapshot", "restore", "delete"]
+
+
+def test_causality_audit_orphans_and_incomplete():
+    j = DecisionJournal()
+    record_scale_down(j)                      # claims plan/Node/tpu-a, open
+    observed = [
+        ObservedActuation("plan", "Node", "tpu-a"),       # claimed, open
+        ObservedActuation("delete", "Node", "tpu-ghost"),  # nobody claims
+    ]
+    report = causality_audit(j, observed)
+    assert not report["ok"]
+    assert [o["name"] for o in report["orphans"]] == ["tpu-ghost"]
+    assert [i["name"] for i in report["incomplete"]] == ["tpu-a"]
+    # closing the episode turns "incomplete" into covered
+    close_episode(j)
+    report = causality_audit(j, [ObservedActuation("plan", "Node", "tpu-a"),
+                                 ObservedActuation("delete", "Node",
+                                                   "tpu-a")])
+    assert report["ok"] and report["covered"] == 2
+    assert report["complete_episodes"] == report["episodes"] == 1
+
+
+# -- surfaces: metrics & explain ----------------------------------------------
+
+def _sample(metrics, name, **labels):
+    value = metrics.registry.get_sample_value(name, labels or None)
+    return 0.0 if value is None else value
+
+
+def test_wire_provenance_feeds_all_four_families():
+    clock = Clock()
+    metrics = OperatorMetrics()
+    j = DecisionJournal(now=clock)
+    metrics.wire_provenance(j)
+    record_scale_down(j)
+    clock.t += 12.0
+    close_episode(j)
+    assert _sample(metrics, "tpu_operator_decision_records_total",
+                   subsystem="autoscale") == 2.0
+    assert _sample(metrics, "tpu_operator_episode_duration_seconds_count",
+                   kind="scale-down") == 1.0
+    assert _sample(metrics, "tpu_operator_episode_duration_seconds_sum",
+                   kind="scale-down") == 12.0
+    causality_audit(j, [ObservedActuation("delete", "Node", "tpu-ghost")])
+    assert _sample(metrics,
+                   "tpu_operator_provenance_orphans_total") == 1.0
+    # open-age is pull-based: a fresh open episode ages at scrape time
+    record_scale_down(j, episode="ep-stuck", victim="tpu-s")
+    clock.t += 900.0
+    assert _sample(metrics,
+                   "tpu_operator_episode_open_age_seconds") == 900.0
+
+
+def test_render_explain_shows_causal_chain():
+    clock = Clock()
+    j = DecisionJournal(now=clock)
+    record_scale_down(j)
+    clock.t += 30.0
+    close_episode(j)
+    text = render_explain(j.timeline(), node="tpu-a")
+    assert "episode ep-1  scale-down  node=tpu-a  CLOSED in 30.0s" in text
+    assert "autoscale/scale-down" in text
+    assert "rejected: hold — forecast below target" in text
+    assert "actuation: delete Node/tpu-a" in text
+    assert "outcome: node-deleted" in text
+    # unknown node: empty string, callers print their own message
+    assert render_explain(j.timeline(), node="nope") == ""
+    # open episodes render as OPEN
+    record_scale_down(j, episode="ep-open", victim="tpu-o")
+    assert "OPEN" in render_explain(j.timeline(), episode="ep-open")
+
+
+# -- protocol Events carry the trace annotation -------------------------------
+
+def setup_migration_cluster(client):
+    client.create(new_cluster_policy(spec={
+        "migrate": {"enabled": True, "snapshotWaitS": 10,
+                    "restoreWaitS": 30},
+        "health": {"drainDeadlineS": 60}}))
+    for name in ("tpu-a", "tpu-b"):
+        client.create(mk_node(name))
+
+
+def test_every_protocol_event_carries_trace_id():
+    """Drive a full migration episode OUTSIDE a runtime worker (no active
+    trace): ensure_trace opens a fallback root, so every protocol Event
+    still carries tpu.ai/trace-id — Event -> /debug/traces navigation
+    never dead-ends."""
+    client = FakeClient()
+    clock = Clock()
+    setup_migration_cluster(client)
+    rec = MigrationReconciler(client, namespace=NS, now=clock)
+    client.patch("v1", "Node", "tpu-a", {"metadata": {"annotations": {
+        consts.MIGRATE_REQUEST_ANNOTATION:
+            json.dumps({"reason": "test", "dst": "tpu-b"})}}})
+    rec.reconcile(Request(name="tpu-a"))
+    fp = migration_state(client.get("v1", "Node", "tpu-a"))["plan"]
+    client.patch("v1", "Node", "tpu-a", {"metadata": {"annotations": {
+        consts.DRAIN_ACK_ANNOTATION:
+            drain.ack_annotation_value({"plan": fp, "step": 17})}}})
+    rec.reconcile(Request(name="tpu-a"))
+    client.patch("v1", "Node", "tpu-b", {"metadata": {"annotations": {
+        consts.MIGRATION_RESTORE_ANNOTATION:
+            json.dumps({"plan": fp, "ok": True, "step": 17,
+                        "src": "tpu-a"})}}})
+    rec.reconcile(Request(name="tpu-a"))
+
+    events = client.list("v1", "Event", NS)
+    assert {e["reason"] for e in events} >= {
+        "RetilePlanned", "MigrationRestored", "MigrationCompleted"}
+    for e in events:
+        annotations = e["metadata"].get("annotations") or {}
+        assert tracing.TRACE_ID_ANNOTATION in annotations, e["reason"]
+        assert annotations[tracing.TRACE_ID_ANNOTATION]
+
+
+def test_autoscale_events_carry_trace_id():
+    """Same contract on the autoscaler's protocol Events, driven directly
+    with no active trace."""
+    client = FakeClient()
+    clock = Clock()
+    client.create(new_cluster_policy(spec={
+        "autoscale": {"enabled": True, "scaleDownDelayS": 0, "cooldownS": 0,
+                      "minNodes": {"default": 1},
+                      "maxNodes": {"default": 8}},
+        "health": {"drainDeadlineS": 60}}))
+    client.create(mk_node("tpu-a"))
+    client.patch("tpu.ai/v1", "ClusterPolicy", "cluster-policy",
+                 {"metadata": {"annotations": {
+                     consts.TRAFFIC_SNAPSHOT_ANNOTATION: json.dumps({
+                         "ts": clock.t, "queue_depth": 0,
+                         "backlog_chips": 40.0, "attainment": 0.5})}}})
+    rec = AutoscaleReconciler(client, namespace=NS, now=clock)
+    rec.reconcile(Request(name="cluster-policy"))
+    events = client.list("v1", "Event", NS)
+    assert events, "autoscaler emitted no Events"
+    for e in events:
+        annotations = e["metadata"].get("annotations") or {}
+        assert tracing.TRACE_ID_ANNOTATION in annotations, e["reason"]

@@ -13,6 +13,7 @@ from . import (  # noqa: F401
     lock_order,
     metrics_discipline,
     operand_dag,
+    provenance_discipline,
     span_discipline,
     state_before_actuation,
     unbatched_sweep_write,

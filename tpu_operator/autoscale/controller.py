@@ -40,7 +40,7 @@ import os
 import time
 from typing import Dict, List, Optional, Tuple
 
-from .. import consts, events
+from .. import consts, events, tracing
 from ..api.clusterpolicy import AutoscaleSpec, ClusterPolicy
 from ..client.batch import batch_window
 from ..client.errors import AlreadyExistsError, NotFoundError
@@ -51,6 +51,7 @@ from ..controllers.predicates import filtered_node_mapper
 from ..controllers.runtime import Controller, Reconciler, Request, Result
 from ..health import drain as drain_protocol
 from ..migrate import controller as migrate_protocol
+from ..provenance import DecisionJournal, episode_id
 from ..state.nodepool import get_node_pools
 from ..utils import deep_get
 from .engine import PoolDecision, PoolState, decide
@@ -108,11 +109,13 @@ class AutoscaleReconciler(Reconciler):
                  metrics: Optional[OperatorMetrics] = None,
                  chips_per_node: int = 4,
                  horizon_s: float = DEFAULT_HORIZON_S,
-                 now=time.time):
+                 now=time.time,
+                 journal: Optional[DecisionJournal] = None):
         self.client = client
         self.namespace = namespace or os.environ.get(
             consts.NAMESPACE_ENV, consts.DEFAULT_NAMESPACE)
         self.metrics = metrics or OperatorMetrics()
+        self.journal = journal or DecisionJournal()
         self.default_chips_per_node = chips_per_node
         self.horizon_s = horizon_s
         self.now = now
@@ -286,6 +289,20 @@ class AutoscaleReconciler(Reconciler):
 
         preconditioned_patch(self.client, "v1", "Node", node_name, build)
 
+    def _stamp_episode(self, node_name: str, eid: str) -> None:
+        """Chain downstream subsystems into this scale-down's provenance
+        episode: the migration reconciler and the health machine read the
+        node's episode annotation and tag their own decision records with
+        the same id instead of forking a parallel episode."""
+        def build(fresh: dict) -> Optional[dict]:
+            if deep_get(fresh, "metadata", "annotations",
+                        consts.PROVENANCE_EPISODE_ANNOTATION) == eid:
+                return None
+            return {"metadata": {"annotations": {
+                consts.PROVENANCE_EPISODE_ANNOTATION: eid}}}
+
+        preconditioned_patch(self.client, "v1", "Node", node_name, build)
+
     def _migration_verdict(self, node: dict) -> Optional[bool]:
         """Terminal outcome of a delegated migration episode: True once
         the tenant restored on its destination, False when the episode
@@ -324,6 +341,29 @@ class AutoscaleReconciler(Reconciler):
         # operator resumes from; the plan annotation and Event repair
         # idempotently behind it
         self._persist_states(policy, states)
+        eid = episode_id("scale-down", name, fingerprint)
+        self._stamp_episode(name, eid)
+        self.journal.record_decision(
+            "autoscale", "scale-down", eid,
+            trigger={"type": "traffic-snapshot", "pool": pool},
+            inputs={"backlog_forecast_chips":
+                    round(self._backlog.forecast(self.horizon_s), 3),
+                    "attainment": round(self._attainment.level, 4),
+                    "drain_deadline_s":
+                    float(policy.spec.health.drain_deadline_s)},
+            decision={"pool": pool, "victim": name, "plan": fingerprint,
+                      "path": "migrate" if migrate else "drain"},
+            alternatives=[
+                {"option": "hold", "reason": "forecast stayed below the "
+                 "pool target past scaleDownDelayS"},
+                ({"option": "drain-in-place", "reason": "spec.migrate "
+                  "enabled: the tenant moves instead of checkpointing "
+                  "to a deadline"} if migrate else
+                 {"option": "migrate", "reason": "spec.migrate disabled"})],
+            actuations=([{"verb": "migrate-request", "kind": "Node",
+                          "name": name}] if migrate else
+                        [{"verb": "plan", "kind": "Node", "name": name}]),
+            node=name)
         if migrate:
             # scale-down rides the migration subsystem: the migration
             # reconciler drains the tenant and restores it on another
@@ -356,7 +396,17 @@ class AutoscaleReconciler(Reconciler):
         node = nodes_by_name.get(rec.get("node", ""))
         if node is None:
             # node gone: the resize completed (possibly in a previous
-            # incarnation of this process) — retire the record
+            # incarnation of this process) — retire the record and close
+            # the provenance episode so it cannot read as stuck forever
+            if rec.get("fingerprint"):
+                self.journal.record_decision(
+                    "autoscale", "scale-down-complete",
+                    episode_id("scale-down", rec.get("node", ""),
+                               rec["fingerprint"]),
+                    trigger={"type": "node-gone"},
+                    decision={"pool": pool, "node": rec.get("node", "")},
+                    outcome="node-deleted",
+                    node=rec.get("node") or None)
             state.resize = None
             state.cooldown_until = now + float(spec.cooldown_s)
             self._persist_states(policy, states)
@@ -397,6 +447,19 @@ class AutoscaleReconciler(Reconciler):
         if not acked:
             self.metrics.drain_deadline_missed.inc()
         name = node["metadata"]["name"]
+        # write-ahead provenance: the closing record (with the node-delete
+        # actuation it licenses) lands before the delete itself, so a kill
+        # between record and delete replays into the same content-addressed
+        # record and the chain never shows an unexplained delete
+        self.journal.record_decision(
+            "autoscale", "scale-down-complete",
+            episode_id("scale-down", name, rec.get("fingerprint", "")),
+            trigger={"type": "drain-ack" if acked else "deadline"},
+            inputs={"detail": detail},
+            decision={"pool": pool, "node": name, "forced": not acked},
+            actuations=[{"verb": "delete", "kind": "Node", "name": name}],
+            outcome="node-deleted",
+            node=name)
         # the drain either completed or timed out (fail-safe): remove the
         # node, then its (exclusively drain-exempt) leftover pods —
         # DaemonSet pods a real apiserver would garbage-collect
@@ -471,6 +534,18 @@ class AutoscaleReconciler(Reconciler):
                 pool=pool, direction="up").inc()
         state.cooldown_until = now + float(spec.cooldown_s)
         self._persist_states(policy, states)
+        self.journal.record_decision(
+            "autoscale", "scale-up", episode_id("scale-up", pool, created),
+            trigger={"type": "traffic-snapshot", "pool": pool},
+            inputs={"backlog_forecast_chips":
+                    round(self._backlog.forecast(self.horizon_s), 3),
+                    "attainment": round(self._attainment.level, 4)},
+            decision={"pool": pool, "registered": created},
+            alternatives=[{"option": "hold", "reason": "forecast demand "
+                           "above capacity headroom for the horizon"}],
+            actuations=[{"verb": "create", "kind": "Node", "name": n}
+                        for n in created],
+            outcome="nodes-registered")
         # Aggregated informational Event: record() folds a replay into
         # the existing Event's count (same reason/message stem), and
         # scale-up multiplicity is not protocol-bearing — no peer acts
@@ -485,8 +560,13 @@ class AutoscaleReconciler(Reconciler):
 
     # -- the sweep ------------------------------------------------------------
     def reconcile(self, request: Request) -> Result:
-        with batch_window(self.client):
-            return self._reconcile(request)
+        # fallback root span: protocol Events (RetilePlanned & co.) must
+        # carry tpu.ai/trace-id even when this sweep runs outside the
+        # runtime worker's root (benches, direct drives)
+        with tracing.ensure_trace("reconcile", controller=self.name,
+                                  request=request.name):
+            with batch_window(self.client):
+                return self._reconcile(request)
 
     def _reconcile(self, request: Request) -> Result:
         policy = self._resolve_policy(request)

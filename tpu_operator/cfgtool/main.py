@@ -10,6 +10,9 @@ Subcommands:
                             against the generation's physical chip grid
   sample [clusterpolicy|tpudriver]   print a complete sample CR
   status [--base-url URL]   live-cluster triage summary (exit 0 iff ready)
+  explain node <X> | episode <id>   render the decision-provenance causal
+                            chain (trigger -> decision -> actuations ->
+                            outcome) from the journal / mirror ConfigMaps
 """
 
 from __future__ import annotations
@@ -557,6 +560,70 @@ def _status(client, namespace, out) -> int:
     return 0 if ready else 1
 
 
+def explain(kind, name, base_url=None, token=None,
+            namespace="tpu-operator", journal_path=None, out=None) -> int:
+    """``tpuop-cfg explain node <X>`` / ``explain episode <id>``: render
+    the causal chain a decision episode followed — trigger, inputs,
+    decision, rejected alternatives, actuations (with trace ids + leader
+    epoch), outcome — from the decision-provenance journal. Reads the
+    on-disk journal when one is reachable (operator pod / harness),
+    otherwise the cluster-side mirror ConfigMaps, so the same command
+    works on-node and from a support laptop."""
+    import json
+    import os
+
+    from ..provenance import DecisionJournal
+    from ..provenance.explain import render_explain
+
+    out = out or sys.stdout
+    journal_path = journal_path or os.environ.get(
+        "TPU_OPERATOR_JOURNAL_PATH")
+    records = []
+    if journal_path and os.path.isfile(journal_path):
+        records = DecisionJournal(path=journal_path).timeline()
+    else:
+        import requests
+
+        from .. import consts
+        from ..client.errors import ApiError
+        from ..client.rest import RestClient
+
+        try:
+            # raw RestClient by design: read-once triage CLI, same
+            # rationale as `status` above
+            if base_url:
+                client = RestClient(base_url=base_url, token=token)  # opalint: disable=api-bypass
+            else:
+                client = RestClient()  # opalint: disable=api-bypass
+            for cm in client.list("v1", "ConfigMap", namespace):
+                labels = (cm.get("metadata", {}).get("labels") or {})
+                if consts.PROVENANCE_LABEL not in labels:
+                    continue
+                raw = (cm.get("data") or {}).get("record")
+                if not raw:
+                    continue
+                try:
+                    records.append(json.loads(raw))
+                except ValueError:
+                    continue
+        except ApiError as e:
+            print(f"explain: apiserver returned {e.code}: {e}",
+                  file=sys.stderr)
+            return 2
+        except (requests.RequestException, OSError) as e:
+            print(f"explain: cannot reach the cluster: {e}", file=sys.stderr)
+            return 2
+    rendered = render_explain(
+        records,
+        node=name if kind == "node" else None,
+        episode=name if kind == "episode" else None)
+    if not rendered:
+        print(f"no decision records for {kind} {name!r}", file=out)
+        return 1
+    print(rendered, file=out)
+    return 0
+
+
 def run(argv=None) -> int:
     p = argparse.ArgumentParser(prog="tpuop-cfg")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -580,11 +647,29 @@ def run(argv=None) -> int:
     st.add_argument("--token", default=None,
                     help="bearer token for --base-url (off-cluster use)")
     st.add_argument("--namespace", default="tpu-operator")
+    ex = sub.add_parser("explain",
+                        help="render a node's (or episode's) decision-"
+                             "provenance chain from the journal")
+    ex.add_argument("kind", choices=["node", "episode"])
+    ex.add_argument("name", help="node name or episode id")
+    ex.add_argument("--base-url", default=None,
+                    help="API server URL (default: in-cluster config)")
+    ex.add_argument("--token", default=None)
+    ex.add_argument("--namespace", default="tpu-operator")
+    ex.add_argument("--journal-path", default=None,
+                    help="on-disk journal JSONL (default: "
+                         "$TPU_OPERATOR_JOURNAL_PATH, else the cluster's "
+                         "mirror ConfigMaps)")
     args = p.parse_args(argv)
 
     if args.cmd == "status":
         return status(base_url=args.base_url, namespace=args.namespace,
                       token=args.token)
+
+    if args.cmd == "explain":
+        return explain(args.kind, args.name, base_url=args.base_url,
+                       token=args.token, namespace=args.namespace,
+                       journal_path=args.journal_path)
 
     if args.cmd == "validate-csv":
         return validate_csv(args.csv)

@@ -36,7 +36,23 @@ from ..client.rest import RestClient
 from ..utils import deep_get
 
 SECTIONS = ("cluster", "crs", "operands", "nodes", "validation",
-            "telemetry", "events", "operator")
+            "telemetry", "events", "operator", "provenance")
+
+
+def debug_endpoint_files():
+    """(route, bundle filename) for every /debug/* route the operator's
+    health server answers — derived from the server's own route table
+    (controllers.manager.DEBUG_ROUTES), so a route added there is
+    snapshotted here without a second edit; the endpoint-parity test in
+    tests/test_debug_endpoints.py enforces exactly this property."""
+    from ..controllers.manager import DEBUG_ROUTES
+
+    out = []
+    for route in DEBUG_ROUTES:
+        stem = route.rsplit("/", 1)[-1]
+        out.append((route,
+                    f"{stem}.txt" if stem == "threads" else f"{stem}.json"))
+    return out
 
 #: node label columns surfaced in the summary table (upgrade + identity)
 NODE_LABEL_COLUMNS = (
@@ -54,10 +70,13 @@ class MustGather:
                  status_dir: Optional[str] = None,
                  telemetry_urls: Optional[List[str]] = None,
                  operator_metrics_port: int = 8080,
-                 operator_health_port: int = 8081):
+                 operator_health_port: int = 8081,
+                 journal_path: Optional[str] = None):
         self.client = client
         self.namespace = namespace
         self.out_dir = out_dir
+        self.journal_path = journal_path or os.environ.get(
+            "TPU_OPERATOR_JOURNAL_PATH") or None
         self.status_dir = status_dir or (
             consts.VALIDATION_STATUS_DIR
             if os.path.isdir(consts.VALIDATION_STATUS_DIR) else None)
@@ -237,18 +256,13 @@ class MustGather:
             self._write("operator", "README.txt",
                         "no running operator pods with an IP found\n")
             return
+        # every /debug/* route the health server answers, derived from its
+        # own route table — the flight recorder, queue/state introspection,
+        # join traces, and the decision-provenance timeline all ride along
+        # automatically when a new route lands
         endpoints = ((self.operator_metrics_port, "/metrics", "metrics.prom"),
-                     (self.operator_health_port, "/debug/threads", "threads.txt"),
-                     (self.operator_health_port, "/debug/informers", "informers.json"),
-                     # the flight recorder + queue/state introspection: the
-                     # per-reconcile story (what did each attempt do, what is
-                     # each worker stuck on) that metrics alone can't carry
-                     (self.operator_health_port, "/debug/traces", "traces.json"),
-                     # merged per-node join traces with critical-path
-                     # attribution (operator sweeps + node span records)
-                     (self.operator_health_port, "/debug/join-traces", "join-traces.json"),
-                     (self.operator_health_port, "/debug/queue", "queue.json"),
-                     (self.operator_health_port, "/debug/state", "state.json"))
+                     *((self.operator_health_port, route, fname)
+                       for route, fname in debug_endpoint_files()))
         for name, ip in targets:
             sources = []
             for port, path, fname in endpoints:
@@ -266,6 +280,38 @@ class MustGather:
             if sources:
                 self._write("operator", f"{name}/sources.txt",
                             "\n".join(sources) + "\n")
+
+    def gather_provenance(self) -> None:
+        """The fleet black box: the decision journal's cluster-side mirror
+        ConfigMaps (one per decision record, labelled with the recording
+        subsystem) and, when reachable, the on-disk JSONL journal itself.
+        The live /debug/timeline snapshot rides the operator section (it is
+        one of the health server's debug routes)."""
+        cms = self._try("provenance mirrors", self.client.list, "v1",
+                        "ConfigMap", self.namespace) or []
+        records = []
+        for cm in cms:
+            labels = deep_get(cm, "metadata", "labels", default={}) or {}
+            if consts.PROVENANCE_LABEL not in labels:
+                continue
+            raw = deep_get(cm, "data", "record")
+            if not raw:
+                continue
+            try:
+                records.append(json.loads(raw))
+            except ValueError:
+                records.append({"unparseable": cm["metadata"]["name"]})
+        records.sort(key=lambda r: (r.get("episode", ""), r.get("seq", 0)))
+        self._write("provenance", "decision-records.yaml", records)
+        path = self.journal_path
+        if path and os.path.isfile(path):
+            with open(path) as f:
+                self._write("provenance", "journal.jsonl", f.read())
+        else:
+            self._write("provenance", "journal.README.txt",
+                        "no on-disk journal reachable from this process "
+                        "(run in the operator pod or pass "
+                        "--journal-path)\n")
 
     def gather_events(self) -> None:
         events = self._try("events", self.client.list, "v1", "Event",
@@ -304,6 +350,9 @@ def main(argv=None) -> int:
                    help="telemetry exporter /metrics URL (repeatable)")
     p.add_argument("--operator-metrics-port", type=int, default=8080)
     p.add_argument("--operator-health-port", type=int, default=8081)
+    p.add_argument("--journal-path", default=None,
+                   help="on-disk decision journal to include "
+                        "(default: $TPU_OPERATOR_JOURNAL_PATH)")
     p.add_argument("--no-tar", action="store_true")
     args = p.parse_args(argv)
 
@@ -315,7 +364,8 @@ def main(argv=None) -> int:
                         status_dir=args.status_dir,
                         telemetry_urls=args.telemetry_url,
                         operator_metrics_port=args.operator_metrics_port,
-                        operator_health_port=args.operator_health_port)
+                        operator_health_port=args.operator_health_port,
+                        journal_path=args.journal_path)
     index = gather.run()
     print(f"gathered {sum(len(v) for v in index['sections'].values())} "
           f"files into {out}")

@@ -192,6 +192,19 @@ MIGRATION_RESTORE_ANNOTATION = "tpu.ai/migration-restore"
 #: cooperation (CRIUgpu, arXiv 2502.16631)
 MIGRATE_PROCESS_STATE_FILE = "process-state.json"
 
+# -- decision provenance -------------------------------------------------------
+#: the cross-subsystem episode id a node's current incident belongs to.
+#: Stamped by whichever reconciler OPENS an episode (autoscale scale-down,
+#: health remediation, admin migrate request); downstream subsystems read
+#: it so their decision records chain into the same episode instead of
+#: forking a parallel one. Cleared when the episode's terminal outcome is
+#: recorded (or the node is deleted with it).
+PROVENANCE_EPISODE_ANNOTATION = "tpu.ai/episode-id"
+#: label on the journal's mirror ConfigMaps (value = recording subsystem),
+#: so `kubectl get cm -l tpu.ai/provenance` lists the cluster-side journal
+#: and must-gather/pruning can select it without name conventions
+PROVENANCE_LABEL = "tpu.ai/provenance"
+
 # -- leader fencing ------------------------------------------------------------
 #: monotonic leader epoch on the election Lease (metadata.annotations).
 #: Bumped on every acquisition (create or takeover), never on renewal; the

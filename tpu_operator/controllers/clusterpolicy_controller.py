@@ -82,10 +82,15 @@ class ClusterPolicyReconciler(Reconciler):
     def __init__(self, client: Client, namespace: Optional[str] = None,
                  metrics: Optional[OperatorMetrics] = None,
                  cluster_info=None, requeue_after: float = NOT_READY_REQUEUE,
-                 join_profiler=None):
+                 join_profiler=None, journal=None):
+        from ..provenance import DecisionJournal
+
         self.client = client
         self.namespace = namespace or os.environ.get(consts.NAMESPACE_ENV, consts.DEFAULT_NAMESPACE)
         self.metrics = metrics or OperatorMetrics()
+        #: shared decision-provenance journal, threaded into every health
+        #: machine this sweep builds (per-shard machines, one journal)
+        self.journal = journal or DecisionJournal()
         self.cluster_info = cluster_info
         self.requeue_after = requeue_after
         #: joinprofile.JoinProfiler (None outside the assembled operator):
@@ -348,7 +353,8 @@ class ClusterPolicyReconciler(Reconciler):
         if not policy.spec.health.enabled:
             machines = [HealthStateMachine(self.client, self.namespace,
                                            policy.spec.health,
-                                           migrate=policy.spec.migrate)]
+                                           migrate=policy.spec.migrate,
+                                           journal=self.journal)]
             machines[0].clear_all(nodes)
             counts = HealthCounts(healthy=len(nodes))
         else:
@@ -356,7 +362,8 @@ class ClusterPolicyReconciler(Reconciler):
                                     else get_node_pools(nodes))
             machines = [HealthStateMachine(self.client, self.namespace,
                                            policy.spec.health,
-                                           migrate=policy.spec.migrate)
+                                           migrate=policy.spec.migrate,
+                                           journal=self.journal)
                         for _ in shards]
             with tracing.phase_span("health-sweep") as sp:
                 shard_counts = self._pool_parallel(

@@ -22,6 +22,14 @@ from .runtime import ControllerManager, Request
 
 log = logging.getLogger(__name__)
 
+#: every /debug/* route the health server answers (single source of truth:
+#: must-gather snapshots exactly this set, and the endpoint-parity test in
+#: tests/test_debug_endpoints.py fails when a route is added here but not
+#: there)
+DEBUG_ROUTES = ("/debug/informers", "/debug/traces", "/debug/join-traces",
+                "/debug/queue", "/debug/state", "/debug/threads",
+                "/debug/timeline")
+
 
 def serve_health_and_metrics(metrics: OperatorMetrics, metrics_port: int,
                              health_port: int, app: "OperatorApp" = None):
@@ -132,6 +140,24 @@ def serve_health_and_metrics(metrics: OperatorMetrics, metrics_port: int,
             if path == "/debug/state" and debug_on:
                 self._send_json(app.debug_state())
                 return
+            if path == "/debug/timeline" and debug_on:
+                # the decision-provenance journal: episode timelines across
+                # subsystem boundaries; ?node=<name>&episode=<id>&limit=
+                node = (query.get("node") or [None])[0]
+                episode = (query.get("episode") or [None])[0]
+                try:
+                    limit = int((query.get("limit") or ["100"])[0])
+                except ValueError:
+                    limit = 100
+                records = app.journal.timeline(node=node, episode=episode,
+                                               limit=limit)
+                self._send_json({
+                    "stats": app.journal.debug_state(),
+                    "count": len(records),
+                    "episodes": app.journal.episodes(),
+                    "records": records,
+                })
+                return
             if path == "/debug/threads" and debug_on:
                 # pprof-style goroutine-dump analog for the threaded runtime
                 import sys
@@ -177,9 +203,25 @@ class OperatorApp:
 
     def __init__(self, client, namespace=None, metrics_port: int = 0, health_port: int = 0,
                  trace_buffer_size: int = tracing.DEFAULT_BUFFER_SIZE,
-                 debug_endpoints: bool = True):
+                 debug_endpoints: bool = True, journal_path=None):
+        import os
+
         self.client = client
         self.metrics = OperatorMetrics()
+        # decision-provenance journal, shared by every actuating reconciler:
+        # ConfigMap mirror rides the same batched/fenced client chain the
+        # actuations do; the on-disk JSONL (when a path is configured)
+        # survives operator restarts
+        from .. import consts
+        from ..provenance import DecisionJournal
+
+        self.journal = DecisionJournal(
+            client=client,
+            namespace=namespace or os.environ.get(consts.NAMESPACE_ENV,
+                                                  consts.DEFAULT_NAMESPACE),
+            path=journal_path
+            or os.environ.get("TPU_OPERATOR_JOURNAL_PATH") or None)
+        self.metrics.wire_provenance(self.journal)
         # reconcile tracing: every worker loop roots a trace here, completed
         # traces land in the flight recorder behind /debug/traces
         self.recorder = tracing.FlightRecorder(trace_buffer_size)
@@ -199,7 +241,7 @@ class OperatorApp:
         self.manager = ControllerManager(client)
         self.clusterpolicy_reconciler = ClusterPolicyReconciler(
             client, namespace=namespace, metrics=self.metrics,
-            join_profiler=self.join_profiler)
+            join_profiler=self.join_profiler, journal=self.journal)
         self.clusterpolicy_controller = self.manager.add(
             setup_clusterpolicy_controller(client, self.clusterpolicy_reconciler))
         from .tpudriver_controller import TPUDriverReconciler, setup_tpudriver_controller
@@ -210,19 +252,22 @@ class OperatorApp:
         from .upgrade_controller import UpgradeReconciler, setup_upgrade_controller
 
         self.upgrade_reconciler = UpgradeReconciler(client, namespace=namespace,
-                                                    metrics=self.metrics)
+                                                    metrics=self.metrics,
+                                                    journal=self.journal)
         self.upgrade_controller = self.manager.add(
             setup_upgrade_controller(client, self.upgrade_reconciler))
         from ..autoscale import AutoscaleReconciler, setup_autoscale_controller
 
         self.autoscale_reconciler = AutoscaleReconciler(
-            client, namespace=namespace, metrics=self.metrics)
+            client, namespace=namespace, metrics=self.metrics,
+            journal=self.journal)
         self.autoscale_controller = self.manager.add(
             setup_autoscale_controller(client, self.autoscale_reconciler))
         from ..migrate import MigrationReconciler, setup_migration_controller
 
         self.migration_reconciler = MigrationReconciler(
-            client, namespace=namespace, metrics=self.metrics)
+            client, namespace=namespace, metrics=self.metrics,
+            journal=self.journal)
         self.migration_controller = self.manager.add(
             setup_migration_controller(client, self.migration_reconciler))
         for controller in self.manager.controllers:
@@ -326,6 +371,7 @@ class OperatorApp:
             "controllers": [c.debug_state() for c in self.manager.controllers],
             "flight_recorder": self.recorder.stats(),
             "join_profiler": self.join_profiler.stats(),
+            "journal": self.journal.debug_state(),
         }
 
     def stop(self) -> None:

@@ -173,6 +173,31 @@ class OperatorMetrics:
             "calling thread (monotonic; mirrored from the tracing module "
             "via set_function, hence a gauge)", registry=self.registry)
 
+        # decision-provenance journal (provenance.DecisionJournal feeds
+        # these through wire_provenance; the fleet black box's vitals)
+        self.decision_records = Counter(
+            "tpu_operator_decision_records_total",
+            "Decision records appended to the provenance journal, by the "
+            "subsystem that recorded them (autoscale / migrate / health / "
+            "upgrade / partitioner)", ["subsystem"], registry=self.registry)
+        self.episode_duration = Histogram(
+            "tpu_operator_episode_duration_seconds",
+            "End-to-end duration of a closed provenance episode (first "
+            "decision record to terminal outcome record), by the episode's "
+            "root decision kind (scale-down / migrate / drain / remediate / "
+            "upgrade)", ["kind"], registry=self.registry,
+            buckets=(.1, .5, 1, 5, 15, 60, 300, 900, 3600))
+        self.provenance_orphans = Counter(
+            "tpu_operator_provenance_orphans_total",
+            "Audited actuations (node delete / re-tile plan / snapshot / "
+            "restore) found unclaimed by any decision record — each one is "
+            "an actuation with no recorded 'why'", registry=self.registry)
+        self.episode_open_age = Gauge(
+            "tpu_operator_episode_open_age_seconds",
+            "Age of the oldest provenance episode still awaiting a terminal "
+            "outcome record (0 when none open) — the TPUEpisodeStuck alert "
+            "signal", registry=self.registry)
+
         # controller-runtime/client-go equivalents (workqueue + rest client)
         self.workqueue_depth = Gauge(
             "tpu_operator_workqueue_depth",
@@ -287,6 +312,20 @@ class OperatorMetrics:
         the split-brain smoking gun (docs/operations.md runbook)."""
         fenced.on_fenced = (
             lambda verb: self.fenced_writes.labels(verb=verb).inc())
+
+    def wire_provenance(self, journal) -> None:
+        """Attach the decision journal's hooks: per-subsystem record
+        counter, closed-episode duration histogram, audit-fed orphan
+        counter, and the stuck-episode age gauge (pull — openness is a
+        scrape-time question, not a mutation-time one)."""
+        journal.on_record = (
+            lambda subsystem:
+            self.decision_records.labels(subsystem=subsystem).inc())
+        journal.on_episode_closed = (
+            lambda kind, duration_s:
+            self.episode_duration.labels(kind=kind).observe(duration_s))
+        journal.on_orphan = self.provenance_orphans.inc
+        self.episode_open_age.set_function(journal.oldest_open_age)
 
     def wire_batching(self, batcher) -> None:
         """Attach the WriteBatcher's hooks: deferred-write counter plus the

@@ -38,11 +38,17 @@ class UpgradeReconciler(Reconciler):
 
     def __init__(self, client: Client, namespace: Optional[str] = None,
                  metrics: Optional[OperatorMetrics] = None,
-                 requeue_after: float = PLANNED_REQUEUE):
+                 requeue_after: float = PLANNED_REQUEUE,
+                 journal=None):
+        from ..provenance import DecisionJournal
+
         self.client = client
         self.namespace = namespace or os.environ.get(consts.NAMESPACE_ENV, consts.DEFAULT_NAMESPACE)
         self.metrics = metrics or OperatorMetrics()
         self.requeue_after = requeue_after
+        #: shared decision-provenance journal, threaded into every machine
+        #: this reconciler builds (per-sweep machines, one durable journal)
+        self.journal = journal or DecisionJournal()
 
     def _policy(self) -> Optional[ClusterPolicy]:
         policies = self.client.list("tpu.ai/v1", "ClusterPolicy")
@@ -102,7 +108,8 @@ class UpgradeReconciler(Reconciler):
             # instance upgrade policies must not label/cordon nodes either —
             # every node is ungoverned and gets cleared (failed labels too:
             # they describe upgrades of a driver that no longer exists)
-            machine = UpgradeStateMachine(self.client, self.namespace, None)
+            machine = UpgradeStateMachine(self.client, self.namespace, None,
+                                          journal=self.journal)
             # every node comes back settled and uncordoned — published as
             # available so the gauge keeps meaning "schedulable TPU nodes"
             # whether or not a policy object exists
@@ -117,7 +124,9 @@ class UpgradeReconciler(Reconciler):
         retry_hints: List[float] = []
         with tracing.phase_span("process", groups=len(groups)):
             for group_policy, members in groups:
-                machine = UpgradeStateMachine(self.client, self.namespace, group_policy)
+                machine = UpgradeStateMachine(self.client, self.namespace,
+                                              group_policy,
+                                              journal=self.journal)
                 if group_policy is None or not group_policy.auto_upgrade:
                     # frozen pool: upgrade-failed nodes keep their label and
                     # stay in the failed gauge (freezing must not launder a

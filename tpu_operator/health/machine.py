@@ -43,6 +43,7 @@ from .. import consts, events
 from ..client.errors import ApiError, FencedError, NotFoundError
 from ..client.interface import Client
 from ..client.preconditions import preconditioned_patch
+from ..provenance import DecisionJournal, episode_id
 from ..utils import deep_get
 from . import drain
 
@@ -114,12 +115,16 @@ class HealthCounts:
 
 class HealthStateMachine:
     def __init__(self, client: Client, namespace: str, policy=None,
-                 now=time.time, migrate=None):
+                 now=time.time, migrate=None, journal=None):
         from ..api.clusterpolicy import HealthSpec
 
         self.client = client
         self.namespace = namespace
         self.policy = policy or HealthSpec()
+        #: decision-provenance journal: every actuating edge of the machine
+        #: (plan publish, snapshot request, counted force, pod recycle,
+        #: terminal recover/failed) records the decision that licensed it
+        self.journal = journal or DecisionJournal()
         #: MigrateSpec (or None): when enabled with snapshotWaitS > 0, an
         #: expired drain deadline requests a transparent snapshot through
         #: the node's migrate agent before any counted force-retile
@@ -227,6 +232,8 @@ class HealthStateMachine:
             ann_patch[consts.DRAIN_ACK_ANNOTATION] = None
             ann_patch[consts.MIGRATE_SNAPSHOT_REQUEST_ANNOTATION] = None
             ann_patch[consts.MIGRATE_SNAPSHOT_RESULT_ANNOTATION] = None
+            # episode over: the next degrade mints a fresh chain
+            ann_patch[consts.PROVENANCE_EPISODE_ANNOTATION] = None
         ann_patch.update(extra_annotations or {})
         declined = []
 
@@ -264,6 +271,25 @@ class HealthStateMachine:
         fresh = preconditioned_patch(self.client, "v1", "Node",
                                      node["metadata"]["name"], build)
         self._mirror(node, fresh)
+
+    def _episode_for(self, node: dict) -> str:
+        """Adopt the node's stamped episode (an autoscale scale-down or a
+        prior sweep of this machine already opened one) or mint a
+        deterministic one from the verdict that started the episode and
+        stamp it — the id must replay identically after a crash, so it is
+        content-derived, never clock- or uuid-derived."""
+        eid = deep_get(node, "metadata", "annotations",
+                       consts.PROVENANCE_EPISODE_ANNOTATION)
+        if eid:
+            return eid
+        verdict_raw = deep_get(node, "metadata", "annotations",
+                               consts.WORKLOAD_HEALTH_ANNOTATION) or ""
+        eid = episode_id("health", node["metadata"]["name"], verdict_raw)
+        try:
+            self._annotate(node, consts.PROVENANCE_EPISODE_ANNOTATION, eid)
+        except ApiError:
+            pass  # stamping is best-effort; the journal still chains on eid
+        return eid
 
     def _cordon(self, node: dict, unschedulable: bool) -> None:
         def build(fresh: dict) -> Optional[dict]:
@@ -390,6 +416,18 @@ class HealthStateMachine:
         (the forced local revalidation). Attempts >= 2 escalate: also
         restart the driver pods (libtpu reinstall) before revalidating."""
         name = node["metadata"]["name"]
+        # recorded from inside the actuating function so the crash-repair
+        # re-fire in _process_node replays into the SAME content-addressed
+        # record (trigger/decision are keyed on the attempt number only)
+        self.journal.record_decision(
+            "health", "remediate", self._episode_for(node),
+            trigger={"type": "attempt", "n": attempt},
+            inputs={"limit": self.policy.max_remediation_attempts},
+            decision={"attempt": attempt, "node": name,
+                      "action": ("validator-recycle" if attempt <= 1
+                                 else "driver-restart+revalidation")},
+            actuations=[{"verb": "recycle", "kind": "Node", "name": name}],
+            node=name)
         self.attempts_fired += 1
         if attempt >= 2:
             for pod in self._pods_on(name, DRIVER_COMPONENT):
@@ -437,6 +475,22 @@ class HealthStateMachine:
                 fingerprint=fingerprint,
                 deadline=self._now() + deadline_s,
                 reason=reason, blocked=blocked)
+            # decision record lands before the plan annotation it licenses
+            # (write-ahead provenance: a crash between the two replays into
+            # the same content-addressed record, never a duplicate)
+            self.journal.record_decision(
+                "health", "drain-plan", self._episode_for(node),
+                trigger={"type": "verdict", "plan": fingerprint},
+                inputs={"blocked_chips": blocked,
+                        "deadline_s": deadline_s},
+                decision={"reason": reason, "plan": fingerprint,
+                          "node": name},
+                alternatives=[{"option": "force-immediate",
+                               "rejected": "drain window configured; "
+                                           "workloads get the deadline to "
+                                           "checkpoint and ack"}],
+                actuations=[{"verb": "plan", "kind": "Node", "name": name}],
+                node=name)
             self._annotate(node, consts.RETILE_PLAN_ANNOTATION,
                            new_plan.to_json())
             self._event(node, events.NORMAL, "RetilePlanned",
@@ -476,6 +530,21 @@ class HealthStateMachine:
         failure degrades to (fail-safe: the machine is never wedged)."""
         name = node["metadata"]["name"]
         self.deadline_misses += 1
+        # the force is a decision in its own right (not just the tail of
+        # the plan decision): it records the deadline trigger and the
+        # rejected wait alternative so `tpuop-cfg explain` shows WHY the
+        # workload lost its window
+        self.journal.record_decision(
+            "health", "drain-force", self._episode_for(node),
+            trigger={"type": "deadline", "plan": fingerprint},
+            inputs={"detail": detail},
+            decision={"forced": True, "plan": fingerprint, "node": name},
+            alternatives=[{"option": "keep-waiting",
+                           "rejected": "deadline expired; the machine is "
+                                       "never wedged"}],
+            actuations=[{"verb": "force-retile", "kind": "Node",
+                         "name": name}],
+            node=name)
         self._event(node, events.WARNING, "RetileDeadlineExpired",
                     f"{name}: {detail} for plan {fingerprint}; "
                     f"force-proceeding", token=fingerprint)
@@ -513,6 +582,18 @@ class HealthStateMachine:
                 {"plan": fingerprint,
                  "deadline": round(self._now() + wait, 3)},
                 sort_keys=True)
+            self.journal.record_decision(
+                "health", "snapshot-request", self._episode_for(node),
+                trigger={"type": "deadline", "plan": fingerprint},
+                inputs={"snapshot_wait_s": wait},
+                decision={"plan": fingerprint, "node": name,
+                          "path": "transparent-snapshot"},
+                alternatives=[{"option": "force-retile",
+                               "rejected": "migrate agent can capture a "
+                                           "restorable checkpoint first"}],
+                actuations=[{"verb": "snapshot", "kind": "Node",
+                             "name": name}],
+                node=name)
             self._annotate(node,
                            consts.MIGRATE_SNAPSHOT_REQUEST_ANNOTATION,
                            payload)
@@ -754,6 +835,14 @@ class HealthStateMachine:
                 return REMEDIATING  # give the attempt time to produce a verdict
             if attempts >= self.policy.max_remediation_attempts:
                 ds = self._driver_ds_for(node)
+                # outcome record ahead of the sticky transition: a crash
+                # between the two replays into the same record, and the
+                # episode still closes
+                self.journal.record_decision(
+                    "health", "health-failed", self._episode_for(node),
+                    trigger={"type": "budget", "attempts": attempts},
+                    decision={"node": name, "sticky": True},
+                    outcome="failed", node=name)
                 if not self._set_state(node, FAILED, extra_annotations={
                         consts.HEALTH_FAILED_TEMPLATE_ANNOTATION:
                             self._template_fingerprint(ds)}):
@@ -811,6 +900,14 @@ class HealthStateMachine:
 
     def _recover(self, node: dict) -> str:
         name = node["metadata"]["name"]
+        # closing outcome lands before the transition (write-ahead): a kill
+        # between record and label write replays into the same record, and
+        # an episode whose node recovered never reads as stuck-open
+        self.journal.record_decision(
+            "health", "health-recover", self._episode_for(node),
+            trigger={"type": "verdict", "value": "passed"},
+            decision={"node": name},
+            outcome="recovered", node=name)
         if self.policy.cordon_on_quarantine:
             self._cordon(node, False)
         if not self._set_state(node, RECOVERED, extra_annotations={

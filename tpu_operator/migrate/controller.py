@@ -30,7 +30,7 @@ import os
 import time
 from typing import Dict, List, Optional, Tuple
 
-from .. import consts, events
+from .. import consts, events, tracing
 from ..api.clusterpolicy import ClusterPolicy
 from ..client.batch import batch_window
 from ..client.errors import NotFoundError
@@ -40,6 +40,7 @@ from ..controllers.metrics import OperatorMetrics
 from ..controllers.predicates import filtered_node_mapper
 from ..controllers.runtime import Controller, Reconciler, Request, Result
 from ..health import drain as drain_protocol
+from ..provenance import DecisionJournal, episode_id
 from ..utils import deep_get
 from .checkpoint import dumps_compact
 
@@ -116,12 +117,14 @@ class MigrationReconciler(Reconciler):
 
     def __init__(self, client: Client, namespace: Optional[str] = None,
                  metrics: Optional[OperatorMetrics] = None,
-                 now=time.time):
+                 now=time.time,
+                 journal: Optional[DecisionJournal] = None):
         self.client = client
         self.namespace = namespace or os.environ.get(
             consts.NAMESPACE_ENV, consts.DEFAULT_NAMESPACE)
         self.metrics = metrics or OperatorMetrics()
         self.now = now
+        self.journal = journal or DecisionJournal()
         #: process-local census of in-flight episodes (src -> phase) for
         #: the migrations_in_progress gauge; rebuilt from annotations as
         #: requests arrive, so a restart under-counts for at most one sweep
@@ -263,6 +266,22 @@ class MigrationReconciler(Reconciler):
         events.record_once(self.client, self.namespace, involved, type_,
                            reason, message, token=token)
 
+    # -- provenance -----------------------------------------------------------
+    def _episode_for(self, node: dict, state: dict) -> str:
+        """The episode this migration belongs to: adopt the node's episode
+        annotation when an upstream subsystem (the autoscaler's scale-down,
+        a health remediation) opened one, otherwise mint a deterministic id
+        from the plan and stamp it so downstream records chain here. Runs
+        idempotently every pass — a crash replay re-derives the same id."""
+        eid = deep_get(node, "metadata", "annotations",
+                       consts.PROVENANCE_EPISODE_ANNOTATION)
+        if eid:
+            return str(eid)
+        eid = episode_id("migrate", state["src"], state["plan"])
+        self._annotate(state["src"], consts.PROVENANCE_EPISODE_ANNOTATION,
+                       eid)
+        return eid
+
     # -- the episode ----------------------------------------------------------
     def _publish_plan(self, node_name: str, fingerprint: str,
                       deadline: float) -> None:
@@ -313,11 +332,26 @@ class MigrationReconciler(Reconciler):
         fp = state["plan"]
         spec = policy.spec.migrate
         phase = state["phase"]
+        eid = self._episode_for(node, state)
 
         if phase == PHASE_DRAINING:
             deadline = float(state["deadline"])
             # repair the plan + announcement halves idempotently: a crash
-            # between the state write and either publish lands here
+            # between the state write and either publish lands here. The
+            # decision record rides the same idempotent repair — content-
+            # addressed, so every pass converges to exactly one record.
+            self.journal.record_decision(
+                "migrate", "migrate", eid,
+                trigger={"type": "annotation",
+                         "key": consts.MIGRATE_REQUEST_ANNOTATION,
+                         "reason": state.get("reason", "manual")},
+                decision={"src": name, "dst": state["dst"], "plan": fp},
+                alternatives=[
+                    {"option": "force-retile", "reason": "migration moves "
+                     "the tenant with zero lost steps; force is the "
+                     "counted fallback, not the plan"}],
+                actuations=[{"verb": "plan", "kind": "Node", "name": name}],
+                node=name)
             self._publish_plan(name, fp, deadline)
             self._once(node, events.NORMAL, REASON_PLANNED,
                        f"migration of {name} -> {state['dst']}: drain "
@@ -348,6 +382,17 @@ class MigrationReconciler(Reconciler):
 
         if phase == PHASE_SNAPSHOTTING:
             snap_deadline = float(state.get("snapshot_deadline", now))
+            self.journal.record_decision(
+                "migrate", "migrate-snapshot", eid,
+                trigger={"type": "deadline", "plan": fp},
+                decision={"src": name, "dst": state["dst"], "plan": fp},
+                alternatives=[
+                    {"option": "bare-force-retile", "reason": "spec."
+                     "migrate.snapshotWaitS > 0: a transparent snapshot "
+                     "preserves the tenant's steps"}],
+                actuations=[{"verb": "snapshot", "kind": "Node",
+                             "name": name}],
+                node=name)
             self._annotate(
                 name, consts.MIGRATE_SNAPSHOT_REQUEST_ANNOTATION,
                 dumps_compact({"plan": fp,
@@ -391,6 +436,13 @@ class MigrationReconciler(Reconciler):
             # the transfer record is the restore's durable intent: it
             # lives on the DESTINATION, so the restore half survives the
             # source node vanishing (preemptible revocation)
+            self.journal.record_decision(
+                "migrate", "migrate-restore", eid,
+                trigger={"type": "phase", "from": PHASE_TRANSFERRING},
+                decision={"src": name, "dst": dst, "plan": fp},
+                actuations=[{"verb": "restore", "kind": "Node",
+                             "name": dst}],
+                node=name)
             self._annotate(dst, consts.MIGRATION_INBOUND_ANNOTATION,
                            dumps_compact(self._inbound_payload(state)))
             state = dict(state, phase=PHASE_RESTORING,
@@ -452,6 +504,13 @@ class MigrationReconciler(Reconciler):
             return state, 2.0
         log.info("migrate: destination %s vanished; re-targeting %s -> %s",
                  lost, state["src"], new_dst)
+        self.journal.record_decision(
+            "migrate", "migrate-retarget",
+            self._episode_for(node, state),
+            trigger={"type": "watch", "what": "destination-gone"},
+            decision={"src": state["src"], "lost": lost, "dst": new_dst,
+                      "plan": state["plan"]},
+            node=state["src"])
         state = dict(state, phase=PHASE_TRANSFERRING, dst=new_dst,
                      seq=state["seq"] + 1)
         self._persist_state(state["src"], state)
@@ -470,6 +529,14 @@ class MigrationReconciler(Reconciler):
         # record stays for cfgtool/autoscaler until the node itself goes
         state = dict(state, phase=PHASE_DONE, step=step,
                      seq=state["seq"] + 1)
+        self.journal.record_decision(
+            "migrate", "migrate-complete",
+            self._episode_for(node, state),
+            trigger={"type": "annotation",
+                     "key": consts.MIGRATION_RESTORE_ANNOTATION},
+            decision={"src": name, "dst": dst, "plan": fp, "step": step},
+            outcome="restored",
+            node=name)
         self._repair_done_cleanup(state)
         self._persist_state(name, state)
         self.metrics.migrations_total.labels(outcome="completed").inc()
@@ -490,6 +557,14 @@ class MigrationReconciler(Reconciler):
         self._clear(name, [consts.MIGRATE_SNAPSHOT_REQUEST_ANNOTATION])
         state = dict(state, phase=PHASE_FAILED, error=message,
                      seq=state["seq"] + 1)
+        self.journal.record_decision(
+            "migrate", "migrate-failed",
+            self._episode_for(node, state),
+            trigger={"type": "deadline", "plan": fp},
+            inputs={"error": message},
+            decision={"src": name, "dst": state.get("dst"), "plan": fp},
+            outcome="failed",
+            node=name)
         self._persist_state(name, state)
         self.metrics.migrations_total.labels(outcome="failed").inc()
         log.warning("migrate: %s failed: %s (plan %s)", name, message, fp)
@@ -497,8 +572,13 @@ class MigrationReconciler(Reconciler):
 
     # -- the sweep ------------------------------------------------------------
     def reconcile(self, request: Request) -> Result:
-        with batch_window(self.client):
-            return self._reconcile(request)
+        # fallback root span: protocol Events (RetilePlanned, Migration*)
+        # must carry tpu.ai/trace-id even when this sweep runs outside
+        # the runtime worker's root (benches, direct drives)
+        with tracing.ensure_trace("reconcile", controller=self.name,
+                                  request=request.name):
+            with batch_window(self.client):
+                return self._reconcile(request)
 
     def _reconcile(self, request: Request) -> Result:
         try:

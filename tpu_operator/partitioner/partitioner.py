@@ -188,7 +188,8 @@ def sync_once(client, node_name: str, config_path: str,
               handoff_dir: str = DEFAULT_HANDOFF_DIR,
               total_chips: Optional[int] = None,
               status_dir: Optional[str] = None,
-              drain_deadline_s: Optional[int] = None) -> Optional[str]:
+              drain_deadline_s: Optional[int] = None,
+              journal=None) -> Optional[str]:
     """One reconcile pass; returns the state written (None = nothing to do).
 
     ``drain_deadline_s`` > 0 enables the coordinated drain protocol for
@@ -376,6 +377,29 @@ def sync_once(client, node_name: str, config_path: str,
                         "consumer check unavailable" if busy is None
                         else f"{busy} TPU-consuming pod(s) running")
             return STATE_PENDING
+        if journal is not None:
+            # optional decision-provenance hook (the node agent records
+            # only when the caller wires a journal — benches and the
+            # in-process simulator do): a re-tile chains onto the health
+            # machine's episode via the node's stamped id; a plain apply
+            # opens and closes its own
+            from ..provenance import episode_id
+            eid = (deep_get(node, "metadata", "annotations",
+                            consts.PROVENANCE_EPISODE_ANNOTATION)
+                   or episode_id("retile", node_name, desired,
+                                 ",".join(str(c) for c in blocked)))
+            journal.record_decision(
+                "partitioner", "re-tile" if blocked else "partition-apply",
+                eid,
+                trigger={"type": "layout", "partition": desired,
+                         "blocked": blocked},
+                decision={"node": node_name, "groups": len(groups),
+                          "blocked": blocked},
+                actuations=[{"verb": "force-retile" if blocked
+                             else "apply", "kind": "Node",
+                             "name": node_name}],
+                outcome=None if blocked else "applied",
+                node=node_name)
         set_state(STATE_PENDING)
         write_handoff(groups, desired, handoff_dir, grid=grid,
                       blocked=blocked)

@@ -1,0 +1,19 @@
+"""Decision-provenance journal: the fleet black box.
+
+Every actuating reconciler (autoscale, migration, health/drain, upgrade,
+partitioner re-tile) records a structured :class:`~.journal.DecisionRecord`
+— trigger, input snapshot, decision + alternatives, actuations with trace
+ids + leader epoch, outcome — chained into **episodes** that cross
+subsystem boundaries (traffic snapshot → autoscale target → migrate
+request → drain plan → snapshot/restore → node delete).
+
+Surfaces: ``/debug/timeline`` on the health server, ``tpuop-cfg explain
+node <X>``, the ``tpu_operator_decision_records_total`` /
+``tpu_operator_episode_duration_seconds`` / ``tpu_operator_provenance_
+orphans_total`` metric families, and the bench causality audit
+(:func:`~.audit.causality_audit`).
+"""
+
+from .journal import DecisionJournal, DecisionRecord, episode_id  # noqa: F401
+from .audit import ActuationObserver, ObservedActuation, causality_audit  # noqa: F401
+from .explain import render_explain  # noqa: F401

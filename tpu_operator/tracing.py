@@ -264,6 +264,23 @@ def span(name: str, kind: str = "internal", **attributes):
         _current_span.reset(token)
 
 
+@contextlib.contextmanager
+def ensure_trace(name: str, controller: str, **attributes):
+    """Guarantee an active trace for the body: yield the current span when
+    one is already open (the runtime worker's root), otherwise open a
+    fallback root on the default tracer. Protocol Events must ALWAYS carry
+    ``tpu.ai/trace-id`` — emitters reached outside the runtime worker
+    (benches driving a reconciler directly, timer threads) get a real
+    recorded trace instead of a silent annotation gap."""
+    current = _current_span.get()
+    if current is not None:
+        yield current
+        return
+    with _default_tracer.trace(name, controller=controller,
+                               **attributes) as root:
+        yield root
+
+
 def phase_span(phase: str, **attributes):
     """A reconcile-phase child span (render / apply / status-update / …):
     feeds ``tpu_operator_reconcile_phase_seconds{controller,phase}`` when

@@ -35,6 +35,7 @@ from ..api.common import UpgradePolicySpec
 from ..client.batch import coalesced_patch
 from ..client.errors import ApiError, NotFoundError, TooManyRequestsError
 from ..client.interface import Client
+from ..provenance import DecisionJournal, episode_id
 from ..utils import deep_get, pod_requests_resource
 
 log = logging.getLogger(__name__)
@@ -96,11 +97,15 @@ class UpgradeStateCounts:
 class UpgradeStateMachine:
     def __init__(self, client: Client, namespace: str,
                  policy: Optional[UpgradePolicySpec] = None,
-                 now=time.time):
+                 now=time.time, journal=None):
         self.client = client
         self.namespace = namespace
         self.policy = policy or UpgradePolicySpec()
         self._now = now  # injectable clock for timeout tests
+        #: decision-provenance journal: the upgrade-start cordon, every
+        #: force-delete escalation, and the done/failed outcomes record the
+        #: decision that licensed them
+        self.journal = journal or DecisionJournal()
         #: smallest server-requested ``Retry-After`` seen this sweep (PDB-
         #: blocked evictions carry one): the controller requeues the next
         #: sweep after exactly this instead of the full planned period
@@ -199,6 +204,8 @@ class UpgradeStateMachine:
             # leaving the machine entirely: drop failure bookkeeping too
             ann_patch[consts.UPGRADE_FAILED_TEMPLATE_ANNOTATION] = None
             ann_patch[consts.UPGRADE_REVALIDATED_ANNOTATION] = None
+            # episode over: the next template drift mints a fresh chain
+            ann_patch[consts.PROVENANCE_EPISODE_ANNOTATION] = None
         ann_patch.update(extra_annotations or {})
         coalesced_patch(self.client, "v1", "Node", name, {"metadata": {
             "labels": {consts.UPGRADE_STATE_LABEL: state or None},
@@ -229,11 +236,35 @@ class UpgradeStateMachine:
         return deep_get(tpl, "metadata", "labels",
                         consts.TEMPLATE_HASH_LABEL) or template_fingerprint(tpl)
 
+    def _episode_for(self, node: dict, ds: Optional[dict]) -> str:
+        """Adopt the node's stamped episode or mint a deterministic one
+        from the driver template this upgrade rolls toward (content-derived
+        so a crash replays into the same chain) and stamp it."""
+        eid = deep_get(node, "metadata", "annotations",
+                       consts.PROVENANCE_EPISODE_ANNOTATION)
+        if eid:
+            return eid
+        eid = episode_id("upgrade", node["metadata"]["name"],
+                         self._template_fingerprint(ds))
+        try:
+            self._annotate(node, consts.PROVENANCE_EPISODE_ANNOTATION, eid)
+        except ApiError:
+            pass  # stamping is best-effort; the journal still chains on eid
+        return eid
+
     def _mark_failed(self, node: dict, ds: Optional[dict]) -> None:
         """FAILED + the failing template's fingerprint, in one patch: the
         FAILED recovery branch only retries when the template has CHANGED
         since the failure, so a drain timeout is sticky (admin-visible)
         instead of looping cordon->evict->fail forever."""
+        # closing outcome ahead of the sticky transition (write-ahead
+        # provenance; a crash between the two replays into the same record)
+        self.journal.record_decision(
+            "upgrade", "upgrade-failed", self._episode_for(node, ds),
+            trigger={"type": "budget",
+                     "template": self._template_fingerprint(ds)},
+            decision={"node": node["metadata"]["name"], "sticky": True},
+            outcome="failed", node=node["metadata"]["name"])
         self._set_state(node, FAILED, extra_annotations={
             consts.UPGRADE_FAILED_TEMPLATE_ANNOTATION:
                 self._template_fingerprint(ds)})
@@ -412,6 +443,23 @@ class UpgradeStateMachine:
                         f"began despite force-delete")
                     self._mark_failed(node, ds)
                     return FAILED
+                # the escalation is a decision in its own right: record the
+                # budget trigger and the exact pods force-deleted BEFORE
+                # the deletes land (write-ahead provenance)
+                self.journal.record_decision(
+                    "upgrade", "drain-force", self._episode_for(node, ds),
+                    trigger={"type": "deadline", "what": what},
+                    inputs={"timeout_s": timeout_s,
+                            "pdb_blocked": len(pdb_blocked),
+                            "terminating": len(terminating)},
+                    decision={"forced": True, "node": name, "what": what},
+                    alternatives=[{"option": "keep-evicting",
+                                   "rejected": "budget expired with "
+                                               "force=true"}],
+                    actuations=[{"verb": "delete", "kind": "Pod",
+                                 "name": p["metadata"]["name"]}
+                                for p in pdb_blocked + terminating],
+                    node=name)
                 for pod in pdb_blocked + terminating:
                     self._delete_pod(pod)
                 self._force_annotation(node, what)
@@ -563,6 +611,23 @@ class UpgradeStateMachine:
                 # driver the upgrade replaces would otherwise block its own
                 # fix, livelocking the pool at a small maxUnavailable.)
                 return state
+            # root decision of the upgrade episode, recorded before the
+            # cordon it licenses: everything downstream (evictions, driver
+            # pod restarts, validator recycles) chains from this record
+            self.journal.record_decision(
+                "upgrade", "upgrade", self._episode_for(node, ds),
+                trigger={"type": "template-drift",
+                         "template": self._template_fingerprint(ds)},
+                inputs={"max_parallel": max_parallel,
+                        "max_unavailable": max_unavailable},
+                decision={"node": name,
+                          "template": self._template_fingerprint(ds)},
+                alternatives=[{"option": "hold",
+                               "rejected": "parallelism and availability "
+                                           "budgets permit the upgrade"}],
+                actuations=[{"verb": "cordon", "kind": "Node",
+                             "name": name}],
+                node=name)
             self._cordon(node, True)
             # fresh upgrade: any previous revalidation marker belongs to an
             # older attempt and must not suppress this one's recycle
@@ -701,6 +766,14 @@ class UpgradeStateMachine:
             state = UNCORDON_REQUIRED
 
         if state == UNCORDON_REQUIRED:
+            self.journal.record_decision(
+                "upgrade", "upgrade-done", self._episode_for(node, ds),
+                trigger={"type": "validation",
+                         "template": self._template_fingerprint(ds)},
+                decision={"node": name},
+                actuations=[{"verb": "uncordon", "kind": "Node",
+                             "name": name}],
+                outcome="done", node=name)
             self._cordon(node, False)
             self._set_state(node, DONE)
             return DONE
