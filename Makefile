@@ -88,6 +88,18 @@ FORENSICS_BENCH_SEED ?= 20260805
 forensics-bench:  ## causality-audited incident forensics: a seeded diurnal trough drives a migration-backed scale-down + recovery scale-up, then the audit proves every node delete / re-tile plan / snapshot / restore reachable from a complete cross-subsystem decision chain (zero orphans), the journal byte-deterministic across a record/replay double run, and the on-disk journal + episode convergent across an operator kill mid-episode
 	FORENSICS_BENCH_SEED=$(FORENSICS_BENCH_SEED) JAX_PLATFORMS=cpu $(PYTHON) bench.py --forensics
 
+SCENARIO_SEED ?= 20260806
+SCENARIO_FUZZ_BUDGET ?= 25
+
+.PHONY: scenario-fuzz
+scenario-fuzz:  ## adversarial fleet simulator CI gate: sample+run $(SCENARIO_FUZZ_BUDGET) composed failure scenarios through the REAL reconcilers at the pinned seed, judge every run with the universal oracles, then run the whole sweep AGAIN and require byte-identical canonical event logs (docs/design.md §18). Failures are delta-minimized and land as runnable bundles under tests/cases/scenarios/ with exact repro commands.
+	SCENARIO_SEED=$(SCENARIO_SEED) $(PYTHON) -m tpu_operator.cmd.sim fuzz \
+		--budget $(SCENARIO_FUZZ_BUDGET) --double-run
+
+.PHONY: scenario-replay
+scenario-replay:  ## tier-1 smoke for the committed compound-failure regression cases: replay every tests/cases/scenarios/*.yaml through the simulator, all oracles green
+	SCENARIO_SEED=$(SCENARIO_SEED) $(PYTHON) -m pytest tests/test_simulator.py -q
+
 .PHONY: generate
 generate:  ## regenerate CRDs into all install channels (reference: make manifests)
 	$(PYTHON) hack/gen-crds.py

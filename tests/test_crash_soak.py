@@ -54,7 +54,7 @@ from tpu_operator.partitioner import sync_once
 from tpu_operator.partitioner.partitioner import read_handoff
 from tpu_operator.testing import MiniApiServer, SimulatedTrainingJob
 from tpu_operator.testing.kubelet import KubeletSimulator
-from tpu_operator.utils import deep_get
+from tpu_operator.utils import clock, deep_get
 from tpu_operator.validator.feature_discovery import sync_node_labels
 from tpu_operator.validator.status import StatusFiles
 
@@ -77,6 +77,17 @@ EVENT_REASONS = ("RetilePlanned", "NodeHealthFlapping",
                  "NodeHealthRemediating", "NodeHealthDegraded",
                  "NodeHealthQuarantined", "NodeHealthRecovered",
                  "RetileDeadlineExpired")
+
+
+@pytest.fixture(autouse=True)
+def pinned_wall_clock():
+    """Terminal-state fingerprints compare annotation *values*, and the
+    image-prepull stamp is a wall-clock timestamp — under real time every
+    replay diverges from the baseline by however many seconds the episodes
+    are apart. Pin the injectable stamp clock so timestamps are a pure
+    function of the episode, not of when CI happened to run it."""
+    with clock.pinned(lambda: 1_700_000_000.0):
+        yield
 
 
 @pytest.fixture(autouse=True)

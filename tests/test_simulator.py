@@ -1,0 +1,316 @@
+"""Adversarial fleet simulator: DSL, seeding, minimizer, determinism,
+and the committed compound-failure regression scenarios.
+
+The replay tests here ARE the tier-1 smoke for `tests/cases/scenarios/`:
+every committed case runs through the real reconcilers on every CI pass,
+so a regression that re-breaks a fuzzer-found failure mode fails loudly
+with its repro command. The full fuzz sweep lives in the separate
+`scenario-fuzz` CI job (tests/tpu-ci.yaml)."""
+
+import glob
+import os
+
+import pytest
+
+from tpu_operator.simulator import (
+    DEFAULT_SCENARIO_SEED,
+    FleetSimulator,
+    Injection,
+    Scenario,
+    ScenarioError,
+    parse,
+    parse_file,
+    repro_command,
+    resolve_seed,
+    seed_for,
+)
+from tpu_operator.simulator.fuzz import sample_scenario
+from tpu_operator.simulator.minimize import minimize
+
+SCENARIO_DIR = os.path.join(os.path.dirname(__file__), "cases", "scenarios")
+SCENARIOS = sorted(glob.glob(os.path.join(SCENARIO_DIR, "*.yaml")))
+
+
+# -- seeds --------------------------------------------------------------------
+
+def test_seed_for_is_stable_across_processes():
+    # sha256-derived, NOT hash(): these exact values must hold on every
+    # machine forever — committed repro cases depend on them
+    assert seed_for(20260806, "node-chaos") == seed_for(20260806,
+                                                        "node-chaos")
+    assert seed_for(20260806, "node-chaos") != seed_for(20260806,
+                                                        "pod-chaos")
+    assert seed_for(1, "traffic") != seed_for(2, "traffic")
+    assert 0 <= seed_for(20260806, "traffic") < 2 ** 32
+
+
+def test_resolve_seed_precedence(monkeypatch):
+    monkeypatch.delenv("SCENARIO_SEED", raising=False)
+    assert resolve_seed(None) == DEFAULT_SCENARIO_SEED
+    monkeypatch.setenv("SCENARIO_SEED", "99")
+    assert resolve_seed(None) == 99
+    assert resolve_seed(7) == 7  # explicit flag beats env
+
+
+def test_repro_command_forms():
+    assert repro_command(5, case="tests/cases/scenarios/x.yaml") == (
+        "SCENARIO_SEED=5 python -m tpu_operator.cmd.sim run "
+        "tests/cases/scenarios/x.yaml")
+    cmd = repro_command(5, budget=25, index=3)
+    assert "--seed 5" in cmd and "--budget 25" in cmd and "--index 3" in cmd
+
+
+# -- DSL ----------------------------------------------------------------------
+
+def test_injection_compact_string_forms():
+    i = Injection.from_string("az_loss(frac=0.3) at t=drain_open")
+    assert i.kind == "az_loss" and i.params["frac"] == 0.3
+    assert i.when == "drain_open" and i.at is None
+
+    i = Injection.from_string(
+        "apiserver_brownout(p=0.4, dur=60) during migration.restoring")
+    assert i.params == {"p": 0.4, "dur": 60}
+    assert i.when == "migration.restoring"
+
+    i = Injection.from_string("thundering_herd(join=1000) during upgrade")
+    assert i.params["join"] == 1000 and i.when == "upgrade"
+
+    i = Injection.from_string("revocation_wave(frac=0.2) at scale_up")
+    assert i.when == "scale_up"
+
+    i = Injection.from_string("pod_chaos(kills=3) at t=12")
+    assert i.at == 12 and i.when is None
+
+    i = Injection.from_string("az_loss(frac=0.5)")
+    assert i.when == "start"  # unplaced injections fire at t=0
+
+
+def test_injection_rejects_garbage():
+    with pytest.raises(ScenarioError):
+        Injection.from_string("launch_missiles(frac=1.0) at 3")
+    with pytest.raises(ScenarioError):
+        Injection.from_string("az_loss(blast_radius=1.0)")
+    with pytest.raises(ScenarioError):
+        Injection.from_string("az_loss(frac=0.5) during no_such_condition")
+    with pytest.raises(ScenarioError):
+        Injection(kind="az_loss", params={}, at=3, when="start")
+
+
+def test_scenario_parse_round_trips():
+    sc = parse({
+        "name": "rt", "operation": "autoscale",
+        "fleet": {"size": 6, "preemptible": False, "zones": 3},
+        "ticks": 32,
+        "injections": [
+            "az_loss(frac=0.5) at t=drain_open",
+            {"apiserver_brownout": {"p": 0.2, "dur": 30}, "at": 4},
+        ],
+    })
+    assert sc.fleet == 6 and not sc.preemptible and sc.zones == 3
+    assert sc.injections[0].when == "drain_open"
+    assert sc.injections[1].at == 4
+    # dict -> yaml -> parse -> dict is the identity
+    assert parse(sc.to_yaml()).to_dict() == sc.to_dict()
+
+
+def test_scenario_validation():
+    with pytest.raises(ScenarioError):
+        parse({"name": "x", "operation": "defragment"})
+    with pytest.raises(ScenarioError):
+        parse({"name": "x", "operation": "migrate", "fleet": 1})
+    with pytest.raises(ScenarioError):
+        parse("][ not yaml }{")
+    with pytest.raises(ScenarioError):
+        parse("just a string")
+
+
+def test_committed_scenarios_parse():
+    assert len(SCENARIOS) >= 4, (
+        "the four compound regression cases must stay committed")
+    names = set()
+    for path in SCENARIOS:
+        sc = parse_file(path)
+        assert sc.name == os.path.splitext(os.path.basename(path))[0], (
+            f"{path}: scenario name must match its filename")
+        names.add(sc.name)
+    assert {"az-loss-mid-drain", "brownout-mid-migration",
+            "herd-join-mid-upgrade",
+            "revocation-wave-mid-scale-up"} <= names
+
+
+# -- fuzzer sampling ----------------------------------------------------------
+
+def test_sample_scenario_is_deterministic():
+    a = sample_scenario(20260806, 3)
+    b = sample_scenario(20260806, 3)
+    assert a.to_dict() == b.to_dict()
+    assert sample_scenario(20260806, 4).to_dict() != a.to_dict()
+    # sampling index i does not depend on earlier indices having been
+    # sampled — the --index replay contract
+    assert sample_scenario(20260806, 7).to_dict() == \
+        sample_scenario(20260806, 7).to_dict()
+
+
+# -- minimizer ----------------------------------------------------------------
+
+def test_minimize_shrinks_to_the_guilty_injection():
+    sc = Scenario(name="min", operation="autoscale", fleet=8, ticks=64,
+                  injections=[
+                      Injection(kind="az_loss", params={}, at=2),
+                      Injection(kind="pod_chaos", params={}, at=3),
+                      Injection(kind="thundering_herd", params={}, at=4),
+                  ])
+
+    # synthetic predicate: the failure needs az_loss and fleet >= 4
+    def failing(candidate, seed):
+        return (any(i.kind == "az_loss" for i in candidate.injections)
+                and candidate.fleet >= 4)
+
+    shrunk, runs = minimize(sc, seed=1, failing=failing)
+    assert [i.kind for i in shrunk.injections] == ["az_loss"]
+    assert shrunk.fleet == 4
+    assert shrunk.ticks < sc.ticks
+    assert runs <= 24
+
+
+def test_minimize_keeps_fixed_tick_injections_inside_timeline():
+    sc = Scenario(name="min2", operation="autoscale", fleet=4, ticks=64,
+                  injections=[Injection(kind="az_loss", params={}, at=20)])
+    shrunk, _ = minimize(sc, seed=1, failing=lambda c, s: True)
+    assert shrunk.ticks > 20  # the injection still fits
+
+
+def test_minimize_tolerates_erroring_candidates():
+    sc = Scenario(name="min3", operation="autoscale", fleet=8, ticks=32,
+                  injections=[Injection(kind="az_loss", params={}, at=1)])
+
+    def failing(candidate, seed):
+        if candidate.fleet < 8:
+            raise RuntimeError("boom")
+        return True
+
+    shrunk, _ = minimize(sc, seed=1, failing=failing)
+    assert shrunk.fleet == 8  # errored candidates are not reproductions
+
+
+# -- engine: determinism ------------------------------------------------------
+
+def test_double_run_is_byte_identical():
+    sc = parse({
+        "name": "det", "operation": "autoscale",
+        "fleet": {"size": 3, "preemptible": True, "zones": 2},
+        "ticks": 12,
+        "injections": ["revocation_wave(frac=0.34) at 4"],
+    })
+    r1 = FleetSimulator(sc, seed=11).run()
+    r2 = FleetSimulator(sc, seed=11).run()
+    assert r1["canonical"] == r2["canonical"]
+    assert r1["injections_applied"] == r2["injections_applied"]
+
+
+def test_different_seeds_diverge():
+    sc = parse({
+        "name": "div", "operation": "autoscale",
+        "fleet": {"size": 4, "preemptible": True, "zones": 2},
+        "ticks": 12,
+        "injections": ["revocation_wave(frac=0.5) at 4"],
+    })
+    r1 = FleetSimulator(sc, seed=1).run()
+    r2 = FleetSimulator(sc, seed=2).run()
+    # different seeds pick different revocation victims
+    assert (r1["injections_applied"] != r2["injections_applied"]
+            or r1["canonical"] != r2["canonical"])
+
+
+# -- engine: committed regression scenarios (the tier-1 smoke) ----------------
+
+@pytest.mark.parametrize("path", SCENARIOS,
+                         ids=[os.path.splitext(os.path.basename(p))[0]
+                              for p in SCENARIOS])
+def test_committed_scenario_replays_green(path):
+    seed = resolve_seed(None)
+    scenario = parse_file(path)
+    report = FleetSimulator(scenario, seed=seed).run()
+    failed = [o for o in report["oracles"] if not o["ok"]]
+    assert report["ok"], (
+        f"committed scenario {scenario.name!r} regressed: "
+        + "; ".join(f"{o['name']}: {o['detail']}" for o in failed)
+        + f"\n  repro: {repro_command(seed, case=path)}")
+    # every committed case must actually fire its injections — a case
+    # whose condition never comes true is testing nothing
+    assert not report["injections_unfired"], (
+        f"{scenario.name}: injections never fired: "
+        f"{report['injections_unfired']}"
+        f"\n  repro: {repro_command(seed, case=path)}")
+
+
+# -- satellite: revocation during the upgrade drain window --------------------
+
+def test_revocation_during_upgrade_drain_window():
+    """NodeChaos eats a node at the exact moment the upgrade machine has
+    it inside the drain window (cordoned, waiting on jobs/pod deletion).
+    The machine must neither wedge (no node stuck in an in-progress
+    state) nor double-emit protocol Events for the victim."""
+    seed = resolve_seed(None)
+    scenario = parse({
+        "name": "revoke-in-upgrade-drain",
+        "operation": "upgrade",
+        "fleet": {"size": 4, "preemptible": True, "zones": 2},
+        "ticks": 48,
+        "injections": [
+            {"revocation_wave": {"frac": 0.25, "target": "draining"},
+             "when": "upgrade.draining"},
+        ],
+    })
+    report = FleetSimulator(scenario, seed=seed).run()
+    oracles = {o["name"]: o for o in report["oracles"]}
+    repro = repro_command(seed)
+
+    fired = [r for r in report["injections_applied"]
+             if r["kind"] == "revocation_wave"]
+    assert fired and fired[0]["victims"], (
+        "the revocation must land on a node inside the drain window"
+        f"\n  repro: {repro}")
+    victim = fired[0]["victims"][0]
+    assert victim not in report["terminal"], (
+        f"revoked node {victim} should be gone\n  repro: {repro}")
+
+    assert oracles["no_stuck_upgrade"]["ok"], (
+        f"upgrade machine wedged: {oracles['no_stuck_upgrade']['detail']}"
+        f"\n  repro: {repro}")
+    assert oracles["exactly_once_events"]["ok"], (
+        f"duplicate protocol Events: "
+        f"{oracles['exactly_once_events']['detail']}\n  repro: {repro}")
+    assert oracles["converged"]["ok"], (
+        f"fleet never quiesced: {oracles['converged']['detail']}"
+        f"\n  repro: {repro}")
+    # survivors (minus the victim) all finished the rollout
+    assert oracles["upgrade_rolled"]["ok"], (
+        f"rollout incomplete: {oracles['upgrade_rolled']['detail']}"
+        f"\n  repro: {repro}")
+
+
+# -- artifacts ----------------------------------------------------------------
+
+def test_failure_bundle_contents(tmp_path):
+    from tpu_operator.simulator.artifacts import dump, failure_banner
+
+    sc = parse({
+        "name": "bundle-check", "operation": "autoscale",
+        "fleet": {"size": 3, "preemptible": True, "zones": 2},
+        "ticks": 8,
+    })
+    sim = FleetSimulator(sc, seed=3)
+    report = sim.run()
+    bundle = dump(str(tmp_path), sc, report, seed=3, sim=sim)
+    for name in ("scenario.yaml", "repro.txt", "report.json",
+                 "journal.jsonl", "timeline.json", "nodes.json",
+                 "events.json", "canonical.log"):
+        assert os.path.exists(os.path.join(bundle, name)), name
+    with open(os.path.join(bundle, "repro.txt")) as f:
+        assert "SCENARIO_SEED=3" in f.read()
+    # the dumped scenario is itself runnable
+    assert parse_file(os.path.join(bundle, "scenario.yaml")).name == \
+        "bundle-check"
+    banner = failure_banner(sc, report, seed=3, bundle=bundle)
+    assert "repro:" in banner and "SCENARIO_SEED=3" in banner

@@ -63,11 +63,11 @@ class LintConfig:
     manifest_texts: Optional[Dict[str, str]] = None
     #: directory names that mark a file as part of a reconcile path
     reconcile_dirs: Tuple[str, ...] = ("controllers", "state", "upgrade",
-                                       "autoscale", "migrate")
+                                       "autoscale", "migrate", "simulator")
     #: directory names allowed to touch raw HTTP / RestClient
     client_dirs: Tuple[str, ...] = ("client",)
     #: composition roots additionally allowed to construct RestClient
-    entrypoint_dirs: Tuple[str, ...] = ("cmd",)
+    entrypoint_dirs: Tuple[str, ...] = ("cmd", "simulator")
     #: dotted module holding the annotation/label-key registry; the
     #: annotation-registry rule resolves raw ``tpu.ai/*`` literals to it
     consts_module: str = "tpu_operator.consts"

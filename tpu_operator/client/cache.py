@@ -29,6 +29,7 @@ from __future__ import annotations
 import copy
 import logging
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from .. import tracing
@@ -95,6 +96,10 @@ class _Informer:
         self._store: Dict[Tuple[str, str], dict] = {}
         self._lock = threading.Lock()
         self.synced = threading.Event()
+        #: newest resourceVersion this informer has observed (relist
+        #: envelope or watch event) — the high watermark synchronous
+        #: harnesses compare against the backend's per-kind event rv
+        self.max_rv = -1
         #: set after a full sync-timeout expired once: later reads stop
         #: paying the timeout and degrade to direct reads immediately
         self.sync_wait_failed = False
@@ -180,6 +185,10 @@ class _Informer:
             self._store = {self._key(o): o for o in items}
             vanished = [obj for key, obj in old.items()
                         if key not in self._store]
+            try:
+                self.max_rv = max(self.max_rv, int(rv))
+            except (TypeError, ValueError):
+                pass
         self.synced.set()
         # controller-runtime Replace semantics: subscribers get ADDED for the
         # surviving set AND tombstone DELETEDs for objects removed during the
@@ -194,9 +203,21 @@ class _Informer:
         self.apply(event.type, event.object)
         self._fanout(event.type, event.object)
 
+    def caught_up(self, rv: int) -> bool:
+        """True once the initial relist landed and every event up to
+        ``rv`` (the backend's newest event for this watch scope) has been
+        applied. ``rv <= 0`` means the scope never emitted an event."""
+        if not self.synced.is_set():
+            return False
+        with self._lock:
+            return rv <= 0 or self.max_rv >= rv
+
     def apply(self, event_type: str, obj: dict) -> None:
         key = self._key(obj)
         with self._lock:
+            observed = _rv_int(obj)
+            if observed >= 0:
+                self.max_rv = max(self.max_rv, observed)
             if event_type == "DELETED":
                 self._store.pop(key, None)
                 return
@@ -426,6 +447,26 @@ class CachedClient(Client):
             # a concurrent superset creation retired this scoped informer
             # between resolve and subscribe; re-resolve onto the superset
             sub.stop()
+
+    def wait_caught_up(self, rv_for: Callable[[str, str, Optional[str]], int],
+                       timeout: float = 5.0) -> bool:
+        """Deterministic read barrier for synchronous harnesses (the fleet
+        simulator, benches): block until every active informer has applied
+        the newest event its watch scope has emitted. ``rv_for(api_version,
+        kind, namespace)`` returns that scope's event high watermark —
+        ``FakeClient.last_event_rv`` is the canonical source. Returns False
+        on timeout (an informer's watch stream is wedged or lagging)."""
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._lock:
+                informers = list(self._informers.values())
+            lagging = [i for i in informers if not i.caught_up(
+                int(rv_for(i.api_version, i.kind, i.namespace) or 0))]
+            if not lagging:
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.001)
 
     def server_version(self) -> str:
         return self.inner.server_version()

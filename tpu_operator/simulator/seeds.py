@@ -1,0 +1,74 @@
+"""Unified scenario seeding.
+
+One root seed — ``SCENARIO_SEED`` (env) or ``--seed`` (flag) — fans out to
+every randomness consumer in a run through :func:`seed_for`, a stable
+content-addressed derivation: ``seed_for(root, "node-chaos")`` is the same
+integer on every machine, every Python, every run. Injectors therefore
+never share an RNG (consuming an extra sample in one cannot perturb the
+others), yet the whole composition replays from the single root printed in
+every failure message.
+
+Derived-seed names used by the engine (documented contract, stable across
+releases so committed repro cases keep replaying):
+
+==================  =====================================================
+name                consumer
+==================  =====================================================
+``traffic``         ``serving/traffic.py`` demand generator
+``pod-chaos``       ``testing/chaos.PodChaos``
+``node-chaos``      ``testing/chaos.NodeChaos``
+``client-chaos``    ``client/chaos.ChaosPolicy``
+``brownout``        the apiserver-brownout fault coin flips
+``injections``      injection-level victim choices (AZ pick, herd names)
+``fuzz-<i>``        the fuzzer's sampler for sweep index ``i``
+``scenario-<i>``    the root seed of sampled scenario ``i``'s run
+==================  =====================================================
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Optional
+
+SCENARIO_SEED_ENV = "SCENARIO_SEED"
+#: the CI-pinned default (tests/tpu-ci.yaml `scenario-fuzz` job)
+DEFAULT_SCENARIO_SEED = 20260806
+
+
+def resolve_seed(explicit: Optional[int] = None) -> int:
+    """Root-seed precedence: explicit flag > $SCENARIO_SEED > pinned
+    default."""
+    if explicit is not None:
+        return int(explicit)
+    raw = os.environ.get(SCENARIO_SEED_ENV)
+    if raw:
+        return int(raw)
+    return DEFAULT_SCENARIO_SEED
+
+
+def seed_for(root: int, name: str) -> int:
+    """Derive the per-consumer seed for ``name`` from the root seed.
+
+    sha256-based (not ``hash()``: that is salted per-process) and truncated
+    to 32 bits so it fits every consumer's ``random.Random(seed)``."""
+    digest = hashlib.sha256(f"{int(root)}:{name}".encode()).digest()
+    return int.from_bytes(digest[:4], "big")
+
+
+def repro_command(seed: int, budget: Optional[int] = None,
+                  index: Optional[int] = None,
+                  case: Optional[str] = None) -> str:
+    """The exact command line that replays a failure — printed verbatim in
+    every simulator failure message (satellite contract: no failure
+    without its repro line)."""
+    if case:
+        return (f"{SCENARIO_SEED_ENV}={seed} python -m tpu_operator.cmd.sim "
+                f"run {case}")
+    parts = [f"{SCENARIO_SEED_ENV}={seed}",
+             "python -m tpu_operator.cmd.sim", "fuzz", f"--seed {seed}"]
+    if budget is not None:
+        parts.append(f"--budget {budget}")
+    if index is not None:
+        parts.append(f"--index {index}")
+    return " ".join(parts)

@@ -81,6 +81,13 @@ class MiniApiServer:
         # how long an event-less watch stream stays open before the server
         # closes it — real apiservers do this on a timer; clients must resume
         self.watch_idle_timeout_s = watch_idle_timeout_s
+        #: optional fault injector, called as ``fault(method, path)`` before
+        #: a request is processed; a truthy HTTP status code fails the
+        #: request with that code (the simulator's apiserver-brownout
+        #: injection: a seeded fraction of requests answered 503 for a
+        #: window — RetryingClient's budget/breaker must absorb it). Watch
+        #: streams are exempt: stream-level failure is ChaosSession's job.
+        self.fault = None
         #: total HTTP requests served — read-amplification accounting for
         #: tests and the control-plane bench
         self.request_count = 0
@@ -130,7 +137,29 @@ class MiniApiServer:
             def _fail(self, err: ApiError) -> None:
                 self._send(err.code, {"kind": "Status", "message": str(err), "code": err.code})
 
+            def _faulted(self, method: str) -> bool:
+                fault = server.fault
+                if fault is None:
+                    return False
+                if "watch=true" in urlparse(self.path).query:
+                    return False
+                code = fault(method, self.path)
+                if code:
+                    # drain the unread request body first: the connection
+                    # is keep-alive, and leaving body bytes on the socket
+                    # would corrupt the NEXT request's framing
+                    length = int(self.headers.get("Content-Length", 0))
+                    if length:
+                        self.rfile.read(length)
+                if not code:
+                    return False
+                self._fail(ApiError("injected fault: apiserver brownout",
+                                    int(code)))
+                return True
+
             def do_GET(self):
+                if self._faulted("GET"):
+                    return
                 try:
                     url = urlparse(self.path)
                     if url.path == "/version":
@@ -223,6 +252,8 @@ class MiniApiServer:
                     handle.stop()
 
             def do_POST(self):
+                if self._faulted("POST"):
+                    return
                 try:
                     api_version, kind, ns, name, sub = server._router.resolve(urlparse(self.path).path)
                     if kind == "Pod" and name and sub == "eviction":
@@ -240,6 +271,8 @@ class MiniApiServer:
                     self._fail(e)
 
             def do_PUT(self):
+                if self._faulted("PUT"):
+                    return
                 try:
                     api_version, kind, ns, name, sub = server._router.resolve(urlparse(self.path).path)
                     obj = self._body()
@@ -251,6 +284,8 @@ class MiniApiServer:
                     self._fail(e)
 
             def do_PATCH(self):
+                if self._faulted("PATCH"):
+                    return
                 try:
                     api_version, kind, ns, name, _ = server._router.resolve(urlparse(self.path).path)
                     self._send(200, server.backend.patch(api_version, kind, name, self._body(), ns))
@@ -258,6 +293,8 @@ class MiniApiServer:
                     self._fail(e)
 
             def do_DELETE(self):
+                if self._faulted("DELETE"):
+                    return
                 try:
                     api_version, kind, ns, name, _ = server._router.resolve(urlparse(self.path).path)
                     server.backend.delete(api_version, kind, name, ns)

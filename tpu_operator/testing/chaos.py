@@ -37,23 +37,30 @@ class PodChaos:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
+    def kill_one(self) -> Optional[str]:
+        """Delete one randomly chosen pod; None when nothing matches.
+        Victims are drawn from the *sorted* pod list so the choice is a
+        pure function of (seed, cluster state) — the deterministic entry
+        point the fleet simulator drives instead of the cadence thread."""
+        try:
+            pods = self.client.list("v1", "Pod", self.namespace,
+                                    label_selector=self.label_selector)
+        except ApiError:
+            return None  # chaos must tolerate the chaos it causes
+        if not pods:
+            return None
+        victim = self._rng.choice(
+            sorted(p["metadata"]["name"] for p in pods))
+        try:
+            self.client.delete("v1", "Pod", victim, self.namespace)
+        except (NotFoundError, ApiError):
+            return None
+        self.victim_count += 1
+        return victim
+
     def _run(self) -> None:
         while not self._stop.wait(self.interval_s):
-            try:
-                pods = self.client.list("v1", "Pod", self.namespace,
-                                        label_selector=self.label_selector)
-            except ApiError:
-                continue  # chaos must tolerate the chaos it causes
-            if not pods:
-                continue
-            victim = self._rng.choice(pods)
-            try:
-                self.client.delete("v1", "Pod",
-                                   victim["metadata"]["name"],
-                                   self.namespace)
-                self.victim_count += 1
-            except (NotFoundError, ApiError):
-                pass
+            self.kill_one()
 
     def start(self) -> "PodChaos":
         self._thread = threading.Thread(target=self._run, daemon=True,
