@@ -76,6 +76,12 @@ AUTOSCALE_BENCH_SEED ?= 20260805
 autoscale-bench:  ## closed-loop autoscaler episode (seeded diurnal curve + mid-episode preemptible revocation) through the latency-injected simulator; fails unless SLO attainment >= target at strictly fewer node-hours than a static peak-sized fleet, with zero bare deletes and revoked capacity replaced in-window
 	AUTOSCALE_BENCH_SEED=$(AUTOSCALE_BENCH_SEED) JAX_PLATFORMS=cpu $(PYTHON) bench.py --autoscale
 
+FRONTIER_BENCH_SEED ?= 20260807
+
+.PHONY: frontier-bench
+frontier-bench:  ## measured-frontier vs per-slice-constant autoscaling on the same seeded diurnal curve; fails unless the measured predictor serves >= 0.95 SLO attainment (no worse than the constant twin) at STRICTLY fewer node-hours, zero bare/unacked deletes, causality audit clean, and the episode replays bit-for-bit
+	FRONTIER_BENCH_SEED=$(FRONTIER_BENCH_SEED) JAX_PLATFORMS=cpu $(PYTHON) bench.py --frontier
+
 MIGRATE_BENCH_SEED ?= 20260805
 
 .PHONY: migrate-bench

@@ -678,6 +678,251 @@ def bench_autoscale(seed: int = None) -> dict:
         srv.stop()
 
 
+#: seed for `make frontier-bench` (overridable via $FRONTIER_BENCH_SEED):
+#: pins the diurnal curve's noise for both the measured-frontier episode
+#: and its per-slice-constant twin
+FRONTIER_BENCH_SEED = 20260807
+#: the per-slice constant's conversion: what the no-frontier fallback
+#: ASSUMES one chip serves (tokens/s). Deliberately conservative — the
+#: constant must size fleets that have never probed, so it prices the
+#: worst supported batch shape
+FRONTIER_ASSUMED_TOKENS_PER_CHIP = 250.0
+#: what one node MEASURABLY serves inside the p99 SLO — the probe finds
+#: batch depths the constant doesn't credit, so the measured curve tops
+#: out 25% above the assumption (4 chips x 250 t/s -> 1250 t/s)
+FRONTIER_MEASURED_NODE_TOKENS = 1250.0
+
+
+def _frontier_episode(seed: int, measured: bool) -> dict:
+    """One diurnal autoscale episode over a token-denominated workload.
+
+    The service model is identical either way — a node truly serves
+    ``FRONTIER_MEASURED_NODE_TOKENS`` tokens/s — what differs is what the
+    autoscaler *believes*: with ``measured`` the node agents publish
+    their frontier annotations and the traffic feed carries a token-rate
+    forecast, so ``nodes_needed`` divides by the measured at-SLO
+    throughput; without, the reconciler sees only chip-denominated
+    backlog and sizes by the conservative per-slice constant. Same seed,
+    same demand curve, same join latency — the node-hours delta is
+    purely the predictor's."""
+    import math
+    import random as _random
+
+    from tpu_operator import consts
+    from tpu_operator.api.clusterpolicy import new_cluster_policy
+    from tpu_operator.autoscale import AutoscaleReconciler
+    from tpu_operator.capacity import CapacityCollector
+    from tpu_operator.client.batch import WriteBatcher
+    from tpu_operator.client.fenced import FencedClient
+    from tpu_operator.client.resilience import RetryingClient
+    from tpu_operator.client.rest import RestClient
+    from tpu_operator.controllers.runtime import Request
+    from tpu_operator.health import drain as drain_protocol
+    from tpu_operator.provenance import (ActuationObserver, DecisionJournal,
+                                         causality_audit)
+    from tpu_operator.serving import frontier as frontier_schema
+    from tpu_operator.testing import MiniApiServer
+    from tpu_operator.utils import deep_get
+
+    rng = _random.Random(seed)
+    chips = 4
+    pool = "v5-lite-podslice-4x4"
+    target_attainment = 0.95
+
+    srv = MiniApiServer(latency_s=0.002)
+    base = srv.start()
+    feeder = RestClient(base_url=base)
+    feeder.create(new_cluster_policy(spec={
+        "autoscale": {
+            "enabled": True,
+            "targetSloAttainment": target_attainment,
+            "headroomPct": 20.0,
+            "scaleDownDelayS": 150,
+            "cooldownS": 30,
+            "windowS": 300,
+            "minNodes": {"default": 1},
+            "maxNodes": {"default": 12},
+            "preemptiblePools": [pool],
+        },
+        "health": {"drainDeadlineS": 90},
+    }))
+    for i in range(2):
+        feeder.create({
+            "apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": f"tpu-{i}", "labels": {
+                consts.GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice",
+                consts.GKE_TPU_TOPOLOGY_LABEL: "4x4"}},
+            "status": {"capacity": {consts.TPU_RESOURCE_NAME: str(chips)}}})
+
+    clock = [0.0]
+    observer = ActuationObserver(RestClient(base_url=base))
+    audit = _ScaleDownAuditor(observer, srv.backend)
+    op_client = WriteBatcher(RetryingClient(FencedClient(audit)))
+    journal = DecisionJournal(client=op_client, now=lambda: clock[0])
+    capacity = CapacityCollector(
+        op_client, consts.DEFAULT_NAMESPACE,
+        now=lambda: clock[0]) if measured else None
+    reconciler = AutoscaleReconciler(
+        op_client, chips_per_node=chips,
+        horizon_s=AUTOSCALE_JOIN_DELAY_TICKS * AUTOSCALE_TICK_S,
+        now=lambda: clock[0], journal=journal, capacity=capacity)
+
+    def demand_tokens_at(tick: int) -> float:
+        phase = 2.0 * math.pi * tick / AUTOSCALE_PERIOD_TICKS
+        chips_equiv = max(0.0, 4.0 + 28.0 * (0.5 - 0.5 * math.cos(phase))
+                          + rng.uniform(-1.5, 1.5))
+        return chips_equiv * FRONTIER_ASSUMED_TOKENS_PER_CHIP
+
+    def frontier_value() -> str:
+        top = FRONTIER_MEASURED_NODE_TOKENS
+        return frontier_schema.encode_annotation(frontier_schema.Frontier(
+            points=[
+                frontier_schema.FrontierPoint(1, 2.0, 0.3 * top, 32),
+                frontier_schema.FrontierPoint(4, 8.0, 0.7 * top, 32),
+                frontier_schema.FrontierPoint(16, 20.0, top, 32),
+            ],
+            measured_at=clock[0]))
+
+    def resize_in_flight() -> bool:
+        raw = deep_get(
+            srv.backend.get("tpu.ai/v1", "ClusterPolicy", "cluster-policy"),
+            "metadata", "annotations", consts.AUTOSCALE_STATE_ANNOTATION)
+        try:
+            data = json.loads(raw) if raw else {}
+        except ValueError:
+            return False
+        return any((st or {}).get("resize") for st in data.values())
+
+    try:
+        first_seen: dict = {}
+        queue = 0.0
+        attainments = []
+        node_counts = []
+        tick = 0
+        while tick < AUTOSCALE_TICKS or (
+                tick < AUTOSCALE_TICKS + AUTOSCALE_SETTLE_TICKS
+                and resize_in_flight()):
+            measuring = tick < AUTOSCALE_TICKS
+            clock[0] = tick * AUTOSCALE_TICK_S
+            nodes = srv.backend.list("v1", "Node")
+            names = {n["metadata"]["name"] for n in nodes}
+            for name in names:
+                first_seen.setdefault(name, tick)
+            serving = [n for n in names
+                       if first_seen[n] == 0
+                       or tick - first_seen[n] >= AUTOSCALE_JOIN_DELAY_TICKS]
+            if measured:
+                # the node agents: probe + mirror, once per new serving
+                # node — nodes the autoscaler registers get a curve as
+                # they come online, exactly like production
+                by_name = {n["metadata"]["name"]: n for n in nodes}
+                for name in sorted(serving):
+                    if not deep_get(by_name[name], "metadata", "annotations",
+                                    consts.SERVING_FRONTIER_ANNOTATION):
+                        feeder.patch("v1", "Node", name, {
+                            "metadata": {"annotations": {
+                                consts.SERVING_FRONTIER_ANNOTATION:
+                                    frontier_value()}}})
+            capacity_tokens = len(serving) * FRONTIER_MEASURED_NODE_TOKENS
+            demand = demand_tokens_at(tick)
+            outstanding = queue + demand
+            served = min(outstanding, capacity_tokens)
+            attain = served / outstanding if outstanding > 0 else 1.0
+            queue = outstanding - served
+            if measuring:
+                attainments.append(attain)
+                node_counts.append(len(names))
+            snapshot = {
+                "ts": clock[0],
+                "queue_depth": round(
+                    queue / (chips * FRONTIER_ASSUMED_TOKENS_PER_CHIP), 3),
+                "backlog_chips": round(
+                    outstanding / FRONTIER_ASSUMED_TOKENS_PER_CHIP, 3),
+                "attainment": round(attain, 4)}
+            if measured:
+                snapshot["demand_tokens_per_s"] = round(outstanding, 3)
+            feeder.patch("tpu.ai/v1", "ClusterPolicy", "cluster-policy", {
+                "metadata": {"annotations": {
+                    consts.TRAFFIC_SNAPSHOT_ANNOTATION:
+                        json.dumps(snapshot)}}})
+            for n in nodes:
+                plan = drain_protocol.node_plan(n)
+                if plan is None:
+                    continue
+                if drain_protocol.node_acked_plan(n) == plan.fingerprint:
+                    continue
+                feeder.patch("v1", "Node", n["metadata"]["name"], {
+                    "metadata": {"annotations": {
+                        consts.DRAIN_ACK_ANNOTATION: json.dumps(
+                            {"plan": plan.fingerprint, "step": tick})}}})
+            reconciler.reconcile(Request(name="cluster-policy"))
+            tick += 1
+        causality = causality_audit(journal, observer.observed)
+        hours = AUTOSCALE_TICK_S / 3600.0
+        mean_attainment = sum(attainments) / len(attainments)
+        return {
+            "predictor": "measured-frontier" if measured else
+                         "per-slice-constant",
+            "mean_slo_attainment": round(mean_attainment, 4),
+            "min_slo_attainment": round(min(attainments), 4),
+            "node_hours": round(sum(node_counts) * hours, 3),
+            "fleet_min": min(node_counts),
+            "fleet_max": max(node_counts),
+            "scale_ups": sum(1 for _, t in first_seen.items() if t > 0),
+            "scale_downs": audit.node_deletes,
+            "bare_deletes": audit.bare_deletes,
+            "unacked_deletes": audit.unacked_deletes,
+            "settle_ticks": tick - AUTOSCALE_TICKS,
+            "causality_ok": causality["ok"],
+            "frontier_tokens_per_node": (
+                reconciler.debug_state()["autoscale"]
+                .get("frontier_tokens_per_node", 0.0)),
+            # per-tick trace: the double-run determinism digest hashes it
+            "_trace": [round(a, 6) for a in attainments] + node_counts,
+        }
+    finally:
+        op_client.stop()
+        srv.stop()
+
+
+def bench_frontier(seed: int = None) -> dict:
+    """`make frontier-bench` workload: the same seeded diurnal episode
+    under both predictors, plus a replay of the measured episode to pin
+    determinism. The measured run must serve the same SLO on strictly
+    fewer node-hours — the whole point of probing instead of assuming."""
+    import hashlib
+
+    seed = int(os.environ.get("FRONTIER_BENCH_SEED",
+                              FRONTIER_BENCH_SEED)) if seed is None else seed
+
+    def digest(out: dict) -> str:
+        return hashlib.sha256(json.dumps(
+            {k: v for k, v in out.items()},
+            sort_keys=True).encode()).hexdigest()[:16]
+
+    measured = _frontier_episode(seed, measured=True)
+    replay = _frontier_episode(seed, measured=True)
+    constant = _frontier_episode(seed, measured=False)
+    deterministic = digest(measured) == digest(replay)
+    for out in (measured, constant):
+        out.pop("_trace", None)
+    return {
+        "simulated": True,
+        "seed": seed,
+        "ticks": AUTOSCALE_TICKS,
+        "tick_s": AUTOSCALE_TICK_S,
+        "target_slo_attainment": 0.95,
+        "assumed_tokens_per_chip": FRONTIER_ASSUMED_TOKENS_PER_CHIP,
+        "measured_node_tokens": FRONTIER_MEASURED_NODE_TOKENS,
+        "measured": measured,
+        "constant": constant,
+        "node_hours_saved_pct": round(
+            100.0 * (1.0 - measured["node_hours"] / constant["node_hours"]),
+            1) if constant["node_hours"] else 0.0,
+        "double_run_identical": deterministic,
+    }
+
+
 #: seed for `make migrate-bench` (overridable via $MIGRATE_BENCH_SEED):
 #: pins Poisson-free but still content-addressed Event naming and the
 #: simulated episode bit-for-bit
@@ -1753,6 +1998,37 @@ def autoscale_bench_main() -> int:
     return 0 if all(gates.values()) else 1
 
 
+def frontier_bench_main() -> int:
+    """`make frontier-bench`: the measured-frontier vs per-slice-constant
+    predictor pair, one JSON line. Exit 0 iff the measured-frontier
+    episode served the diurnal curve at >= 0.95 SLO attainment and no
+    worse than the constant twin's floor, on STRICTLY fewer node-hours,
+    with every scale-down drained-and-acked (zero bare deletes), the
+    causality audit clean on both episodes, and the measured episode
+    bit-for-bit reproducible on a same-seed replay."""
+    out = bench_frontier()
+    m, c = out["measured"], out["constant"]
+    gates = {
+        "attainment_met": (m["mean_slo_attainment"]
+                           >= out["target_slo_attainment"]),
+        "attainment_ge_baseline": (m["mean_slo_attainment"]
+                                   >= min(c["mean_slo_attainment"],
+                                          out["target_slo_attainment"])),
+        "node_hours_strictly_fewer": m["node_hours"] < c["node_hours"],
+        "frontier_consumed": m["frontier_tokens_per_node"] > 0,
+        "zero_bare_deletes": (m["bare_deletes"] == 0
+                              and c["bare_deletes"] == 0),
+        "all_drains_acked": (m["unacked_deletes"] == 0
+                             and c["unacked_deletes"] == 0),
+        "scaled_both_ways": m["scale_ups"] > 0 and m["scale_downs"] > 0,
+        "causality_audit_ok": m["causality_ok"] and c["causality_ok"],
+        "double_run_deterministic": out["double_run_identical"],
+    }
+    line = {"metric": "frontier_episode", "frontier": out, "gates": gates}
+    print(json.dumps(line))
+    return 0 if all(gates.values()) else 1
+
+
 def migrate_bench_main() -> int:
     """`make migrate-bench`: the end-to-end cross-node migration episode
     pair, one JSON line. Exit 0 iff both episodes completed, the tenant
@@ -1836,6 +2112,8 @@ if __name__ == "__main__":
         sys.exit(scale_bench_main())
     if "--autoscale" in _argv:
         sys.exit(autoscale_bench_main())
+    if "--frontier" in _argv:
+        sys.exit(frontier_bench_main())
     if "--migrate" in _argv:
         sys.exit(migrate_bench_main())
     if "--forensics" in _argv:

@@ -1,0 +1,419 @@
+"""Measured serving frontier: schema, annotation codec, the operator-side
+CapacityCollector, and the autoscaler's measured-vs-constant predictor
+split.
+
+Contracts pinned here:
+
+* version-less frontier payloads load as v1 FOREVER (nodes probed by an
+  older validator keep participating across operator upgrades), unknown
+  newer versions fail closed to None;
+* the annotation codec's truncation drops deep points first and every
+  truncation point re-parses — the autoscaler's shallow at-SLO reading
+  survives any size squeeze;
+* drift is edge-triggered: ONE FrontierDrift Event per drifting episode,
+  not one per sweep, and a closed episode re-announces;
+* ``nodes_needed`` divides by the measured at-SLO throughput only when
+  both the token forecast and a usable curve exist — either missing
+  falls back to the per-slice chip constant.
+"""
+
+import json
+
+import pytest
+
+from tpu_operator import consts
+from tpu_operator.api.clusterpolicy import AutoscaleSpec
+from tpu_operator.autoscale.engine import nodes_needed
+from tpu_operator.capacity import CapacityCollector
+from tpu_operator.capacity.collector import MIN_POOL_QUORUM, REASON_DRIFT
+from tpu_operator.client.fake import FakeClient
+from tpu_operator.serving import frontier as frontier_schema
+from tpu_operator.serving.frontier import (
+    FRONTIER_VERSION,
+    Frontier,
+    FrontierPoint,
+    decode_annotation,
+    encode_annotation,
+    from_dict,
+    p99_bucket,
+)
+
+NS = "tpu-operator"
+
+
+def curve(top=1000.0, template=""):
+    return Frontier(points=[
+        FrontierPoint(1, 2.0, 0.4 * top, 32),
+        FrontierPoint(4, 8.0, 0.8 * top, 32),
+        FrontierPoint(8, 20.0, top, 32),
+    ], measured_at=100.0, template=template)
+
+
+def mk_node(name, frontier=None, template_label=None):
+    labels = {
+        consts.GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice",
+        consts.GKE_TPU_TOPOLOGY_LABEL: "4x4",
+    }
+    if template_label:
+        labels[consts.TEMPLATE_HASH_LABEL] = template_label
+    annotations = {}
+    if frontier is not None:
+        annotations[consts.SERVING_FRONTIER_ANNOTATION] = (
+            frontier if isinstance(frontier, str)
+            else encode_annotation(frontier))
+    return {"apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": name, "labels": labels,
+                         "annotations": annotations},
+            "status": {"capacity": {consts.TPU_RESOURCE_NAME: "4"}}}
+
+
+# -- schema: versioning -------------------------------------------------------
+
+def test_versionless_payload_loads_as_v1_forever():
+    """The compatibility promise: a barrier written before the schema
+    carried a version key parses as v1 — removing this breaks every
+    node probed by an older validator mid-upgrade."""
+    fr = from_dict({"points": [
+        {"batch": 1, "p99_ms": 2.0, "tokens_per_s": 400.0, "samples": 32}]})
+    assert fr is not None
+    assert fr.version == 1
+    assert fr.points[0].tokens_per_s == 400.0
+    # samples is itself optional (pre-min-sample-floor payloads)
+    fr = from_dict({"points": [
+        {"batch": 1, "p99_ms": 2.0, "tokens_per_s": 400.0}]})
+    assert fr.points[0].samples == 0
+
+
+def test_newer_version_fails_closed():
+    payload = curve().to_dict()
+    payload["version"] = FRONTIER_VERSION + 1
+    assert from_dict(payload) is None
+    payload["version"] = 0
+    assert from_dict(payload) is None
+    payload["version"] = "2"
+    assert from_dict(payload) is None
+
+
+def test_from_dict_rejects_garbage():
+    assert from_dict(None) is None
+    assert from_dict("not a dict") is None
+    assert from_dict({}) is None
+    assert from_dict({"points": "nope"}) is None
+    assert from_dict({"points": [{"batch": "x"}]}) is None
+
+
+def test_dict_round_trip():
+    fr = curve(template="abc123")
+    back = from_dict(fr.to_dict())
+    assert back == fr
+
+
+# -- schema: annotation codec -------------------------------------------------
+
+def test_annotation_round_trip():
+    fr = curve(template="tpl-1")
+    back = decode_annotation(encode_annotation(fr))
+    assert back.points == fr.points
+    assert back.template == "tpl-1"
+    assert back.measured_at == fr.measured_at
+    assert back.version == FRONTIER_VERSION
+
+
+def test_annotation_truncation_drops_deep_points_first():
+    fr = Frontier(points=[
+        FrontierPoint(b, float(b), 100.0 * b, 32)
+        for b in (1, 2, 4, 8, 16, 32, 64)], measured_at=5.0)
+    full = encode_annotation(fr)
+    # squeeze until something must go
+    squeezed = encode_annotation(fr, max_bytes=len(full) - 1)
+    back = decode_annotation(squeezed)
+    assert back is not None
+    assert len(back.points) < len(fr.points)
+    # the shallow end survives; the deep end is what got dropped
+    assert back.points[0].batch == 1
+    assert max(p.batch for p in back.points) < 64
+
+
+def test_annotation_truncation_always_reparses():
+    """Every byte budget yields either a parsable (possibly point-less)
+    frontier — truncation can never corrupt the transport."""
+    fr = Frontier(points=[
+        FrontierPoint(b, float(b), 123.456 * b, 32)
+        for b in (1, 2, 4, 8, 16)], measured_at=99.0, template="tpl")
+    for budget in range(0, len(encode_annotation(fr)) + 1, 7):
+        value = encode_annotation(fr, max_bytes=budget)
+        # the head (version/timestamp/template) is never truncated: the
+        # bound applies to points, the codec keeps the envelope whole
+        back = decode_annotation(value)
+        assert back is not None
+        assert back.version == FRONTIER_VERSION
+        assert [p.batch for p in back.points] == sorted(
+            p.batch for p in back.points)
+
+
+def test_decode_annotation_rejects_garbage():
+    assert decode_annotation(None) is None
+    assert decode_annotation("") is None
+    assert decode_annotation("v=2;p=1:2:3:4") is None  # newer than us
+    assert decode_annotation("v=banana") is None
+    assert decode_annotation("v=1;p=1:2:3") is None  # short point tuple
+
+
+def test_best_tokens_and_depth_respect_ceiling():
+    fr = curve(top=1000.0)
+    assert fr.best_tokens_per_s(200.0) == 1000.0
+    assert fr.best_depth(200.0) == 8
+    # tighter ceiling excludes the deep end
+    assert fr.best_tokens_per_s(10.0) == 800.0
+    assert fr.best_depth(10.0) == 4
+    # impossible ceiling: no point qualifies -> 0 (callers fall back)
+    assert fr.best_tokens_per_s(0.1) == 0.0
+    assert fr.best_depth(0.1) == 0
+
+
+def test_p99_bucket_labels():
+    assert p99_bucket(3.0) == "le5"
+    assert p99_bucket(5.0) == "le5"
+    assert p99_bucket(99.0) == "le100"
+    assert p99_bucket(9999.0) == "inf"
+
+
+# -- collector: aggregation ---------------------------------------------------
+
+def mk_collector(client, **kw):
+    return CapacityCollector(client, NS, now=lambda: 1100.0, **kw)
+
+
+def test_collector_aggregates_pool_medians():
+    client = FakeClient()
+    nodes = [mk_node("a", curve(1000.0)), mk_node("b", curve(1200.0)),
+             mk_node("c", curve(800.0)), mk_node("d")]  # d never probed
+    col = mk_collector(client)
+    col.observe(nodes)
+    state = col.debug_state()
+    pool = state["pools"]["v5-lite-podslice-4x4"]
+    assert pool["nodes"] == 4
+    assert pool["reporting"] == 3
+    assert pool["tokens_per_node_at_slo"] == 1000.0  # median of the three
+    # the curve reads each bucket's median at that ceiling
+    assert pool["curve"]["le25"] == 1000.0
+    assert pool["curve"]["le10"] == 800.0  # 0.8*top median
+    assert col.tokens_per_node() == 1000.0
+    assert state["nodes"]["a"]["age_s"] == 1000.0
+
+
+def test_collector_no_curves_returns_zero():
+    col = mk_collector(FakeClient())
+    col.observe([mk_node("a"), mk_node("b")])
+    assert col.tokens_per_node() == 0.0
+    assert col.debug_state()["pools"]["v5-lite-podslice-4x4"][
+        "reporting"] == 0
+
+
+# -- collector: drift ---------------------------------------------------------
+
+def drift_events(client):
+    return [e for e in client.list("v1", "Event", NS)
+            if e.get("reason") == REASON_DRIFT]
+
+
+def drift_count(client):
+    return sum(int(e.get("count") or 1) for e in drift_events(client))
+
+
+def test_drift_fires_one_event_per_episode():
+    """The edge detector: a node drifting for N consecutive sweeps emits
+    exactly one Event; recovery closes the episode and a relapse opens a
+    new one (second Event)."""
+    client = FakeClient()
+    healthy = [mk_node("a", curve(1000.0)), mk_node("b", curve(1000.0))]
+    col = mk_collector(client)
+    col.observe(healthy + [mk_node("c", curve(1000.0))])
+    assert drift_count(client) == 0
+
+    degraded = healthy + [mk_node("c", curve(100.0))]
+    col.observe(degraded)
+    assert drift_count(client) == 1
+    assert col.drifting_nodes() == ["c"]
+    # sweeps repeat while the condition persists: NO further events
+    col.observe(degraded)
+    col.observe(degraded)
+    assert drift_count(client) == 1
+
+    # recovery closes the episode...
+    col.observe(healthy + [mk_node("c", curve(1000.0))])
+    assert col.drifting_nodes() == []
+    # ...and a relapse is a NEW episode
+    col.observe(degraded)
+    assert drift_count(client) == 2
+
+
+def test_drift_episode_closes_when_frontier_vanishes():
+    client = FakeClient()
+    healthy = [mk_node("a", curve(1000.0)), mk_node("b", curve(1000.0))]
+    col = mk_collector(client)
+    col.observe(healthy + [mk_node("c", curve(100.0))])
+    assert drift_count(client) == 1
+    # the curve is cleared (failing barrier) then comes back degraded:
+    # that is a fresh episode, not a suppressed continuation
+    col.observe(healthy + [mk_node("c")])
+    col.observe(healthy + [mk_node("c", curve(100.0))])
+    assert drift_count(client) == 2
+
+
+def test_drift_needs_pool_quorum():
+    """A median over one node is the node itself — no drift verdicts
+    until MIN_POOL_QUORUM curves report."""
+    assert MIN_POOL_QUORUM >= 2
+    client = FakeClient()
+    col = mk_collector(client)
+    col.observe([mk_node("a", curve(100.0)), mk_node("b")])
+    assert drift_count(client) == 0
+    assert col.drifting_nodes() == []
+
+
+def test_drift_metric_counts_episodes():
+    client = FakeClient()
+    col = mk_collector(client)
+    healthy = [mk_node("a", curve(1000.0)), mk_node("b", curve(1000.0))]
+    col.observe(healthy + [mk_node("c", curve(100.0))])
+    col.observe(healthy + [mk_node("c", curve(100.0))])
+    counter = col.metrics.serving_frontier_drift.labels(
+        pool="v5-lite-podslice-4x4")
+    assert counter._value.get() == 1
+
+
+# -- collector: template staleness -------------------------------------------
+
+def test_template_change_requests_reprobe():
+    client = FakeClient()
+    node = mk_node("a", curve(1000.0, template="old"), template_label="new")
+    client.create(node)
+    col = mk_collector(client)
+    col.observe([node])
+    assert col.stale_nodes() == ["a"]
+    fresh = client.get("v1", "Node", "a")
+    assert fresh["metadata"]["annotations"][
+        consts.SERVING_REPROBE_ANNOTATION] == "new"
+    # idempotent: a second sweep converges to zero writes (the request
+    # already carries the invalidating hash)
+    col.observe([fresh])
+    assert client.get("v1", "Node", "a")["metadata"]["annotations"][
+        consts.SERVING_REPROBE_ANNOTATION] == "new"
+
+
+def test_matching_template_is_not_stale():
+    client = FakeClient()
+    node = mk_node("a", curve(1000.0, template="t1"), template_label="t1")
+    client.create(node)
+    col = mk_collector(client)
+    col.observe([node])
+    assert col.stale_nodes() == []
+    ann = client.get("v1", "Node", "a")["metadata"].get("annotations") or {}
+    assert consts.SERVING_REPROBE_ANNOTATION not in ann
+    # a curve with NO template stamp can't be judged stale (pre-upgrade
+    # probes): no reprobe churn on old fleets
+    node2 = mk_node("b", curve(1000.0), template_label="t2")
+    client.create(node2)
+    col.observe([node, node2])
+    assert col.stale_nodes() == []
+
+
+# -- autoscaler: measured path + constant fallback ----------------------------
+
+def spec_of(**kw):
+    return AutoscaleSpec.from_dict(dict({"enabled": True}, **kw))
+
+
+def test_nodes_needed_measured_frontier_path():
+    spec = spec_of(headroomPct=20.0)
+    # 5000 tokens/s * 1.2 / 1250 per node = 4.8 -> 5 nodes
+    assert nodes_needed(spec, 0.0, 4, False, 3,
+                        demand_tokens_per_s=5000.0,
+                        frontier_tokens_per_node=1250.0) == 5
+    # the chips argument is IGNORED on the measured path
+    assert nodes_needed(spec, 999.0, 4, False, 3,
+                        demand_tokens_per_s=5000.0,
+                        frontier_tokens_per_node=1250.0) == 5
+
+
+def test_nodes_needed_falls_back_to_constant_without_frontier():
+    """Either half missing — no curve, or no token feed — reverts to the
+    per-slice constant: a fleet that never probed keeps scaling."""
+    spec = spec_of(headroomPct=20.0)
+    constant = nodes_needed(spec, 10.0, 4, False, 3)
+    assert constant == 3  # 10 * 1.2 / 4
+    assert nodes_needed(spec, 10.0, 4, False, 3,
+                        demand_tokens_per_s=5000.0,
+                        frontier_tokens_per_node=0.0) == constant
+    assert nodes_needed(spec, 10.0, 4, False, 3,
+                        demand_tokens_per_s=0.0,
+                        frontier_tokens_per_node=1250.0) == constant
+
+
+def test_nodes_needed_breach_floor_applies_to_measured_path():
+    spec = spec_of(headroomPct=0.0)
+    # measured path says 1 node, but the SLO is burning: current + 1
+    assert nodes_needed(spec, 0.0, 4, True, 6,
+                        demand_tokens_per_s=1000.0,
+                        frontier_tokens_per_node=1250.0) == 7
+
+
+def test_reconciler_consumes_collector(clock_autoscale_cluster):
+    """Controller-level wiring: with curves on the fleet and a token
+    forecast in the snapshot, debug_state surfaces the measured
+    tokens-per-node; with neither, it reports 0.0 (constant path)."""
+    client, rec, clock = clock_autoscale_cluster
+    from tpu_operator.controllers.runtime import Request
+
+    # no frontier annotations yet: constant path
+    client.patch("tpu.ai/v1", "ClusterPolicy", "cluster-policy",
+                 {"metadata": {"annotations": {
+                     consts.TRAFFIC_SNAPSHOT_ANNOTATION: json.dumps({
+                         "ts": clock(), "queue_depth": 0,
+                         "backlog_chips": 8.0, "attainment": 1.0})}}})
+    rec.reconcile(Request(name="cluster-policy"))
+    assert rec.debug_state()["autoscale"][
+        "frontier_tokens_per_node"] == 0.0
+
+    for name in ("tpu-0", "tpu-1"):
+        client.patch("v1", "Node", name, {"metadata": {"annotations": {
+            consts.SERVING_FRONTIER_ANNOTATION:
+                encode_annotation(curve(1250.0))}}})
+    clock.t += 60.0
+    client.patch("tpu.ai/v1", "ClusterPolicy", "cluster-policy",
+                 {"metadata": {"annotations": {
+                     consts.TRAFFIC_SNAPSHOT_ANNOTATION: json.dumps({
+                         "ts": clock(), "queue_depth": 0,
+                         "backlog_chips": 8.0, "attainment": 1.0,
+                         "demand_tokens_per_s": 2000.0})}}})
+    rec.reconcile(Request(name="cluster-policy"))
+    debug = rec.debug_state()["autoscale"]
+    assert debug["frontier_tokens_per_node"] == 1250.0
+    assert debug["token_demand_level"] > 0
+
+
+@pytest.fixture
+def clock_autoscale_cluster():
+    from tpu_operator.api.clusterpolicy import new_cluster_policy
+    from tpu_operator.autoscale.controller import AutoscaleReconciler
+
+    class Clock:
+        t = 1_000_000.0
+
+        def __call__(self):
+            return self.t
+
+    client = FakeClient()
+    clock = Clock()
+    client.create(new_cluster_policy(spec={
+        "autoscale": {"enabled": True, "scaleDownDelayS": 0, "cooldownS": 0,
+                      "minNodes": {"default": 1},
+                      "maxNodes": {"default": 8}},
+        "health": {"drainDeadlineS": 60}}))
+    for i in range(2):
+        client.create(mk_node(f"tpu-{i}"))
+    capacity = CapacityCollector(client, NS, now=clock)
+    rec = AutoscaleReconciler(client, namespace=NS, now=clock,
+                              capacity=capacity)
+    return client, rec, clock

@@ -84,6 +84,39 @@ def test_percentile_nearest_rank():
     assert _percentile(vals, 1.0) == 100.0
 
 
+def test_probe_enforces_min_sample_floor():
+    """A p99 over 8 timed steps is the max, not a tail: every measured
+    point runs at least MIN_FRONTIER_SAMPLES steps regardless of the
+    requested count, and surfaces the actual count it timed."""
+    from tpu_operator.serving.probe import MIN_FRONTIER_SAMPLES
+
+    report = run_probe(**FAST)
+    assert FAST["steps_per_batch"] < MIN_FRONTIER_SAMPLES
+    for rung in report.batches:
+        assert rung["steps"] == FAST["steps_per_batch"]  # as requested
+        assert rung["samples"] >= MIN_FRONTIER_SAMPLES   # as measured
+    for point in report.frontier["points"]:
+        assert point["samples"] >= MIN_FRONTIER_SAMPLES
+
+
+def test_probe_measures_a_frontier():
+    """The probe's output is a curve, not disconnected rungs: one point
+    per batch depth, each with throughput + tail, parsing under the
+    versioned schema."""
+    from tpu_operator.serving import frontier as frontier_schema
+
+    report = run_probe(**FAST)
+    fr = frontier_schema.from_dict(report.frontier)
+    assert fr is not None
+    assert fr.version == frontier_schema.FRONTIER_VERSION
+    assert [p.batch for p in fr.points] == list(FAST["batch_sizes"])
+    assert all(p.tokens_per_s > 0 for p in fr.points)
+    assert fr.model_dim > 0
+    assert fr.measured_at > 0
+    # a skipped probe carries no frontier — no curve without a measurement
+    assert skipped_report("health-state=failed", {}).frontier is None
+
+
 # -- validator glue: health gate + barrier contract ---------------------------
 
 def test_run_serving_writes_barrier_on_pass(tmp_path, capsys, monkeypatch):
@@ -371,6 +404,103 @@ def test_sync_replaces_stale_numbers_on_corrupt_barrier(fake_client, tmp_path,
     assert node["metadata"]["labels"][consts.SERVING_SLO_LABEL] == "corrupt"
     assert node["metadata"]["annotations"][consts.SERVING_SLO_ANNOTATION] \
         == "skipped=corrupt"
+
+
+def test_run_serving_stamps_template_hash_into_frontier(tmp_path,
+                                                        monkeypatch,
+                                                        capsys):
+    """The curve remembers the template it was measured under
+    (TPU_TEMPLATE_HASH, the DS downward-API stamp) — without it the
+    operator cannot tell a live curve from one predating a template
+    change."""
+    monkeypatch.delenv("TPU_HEALTH_STATE", raising=False)
+    monkeypatch.setenv("TPU_TEMPLATE_HASH", "tmpl-abc123")
+    status = StatusFiles(str(tmp_path))
+    assert run_serving(status, **FAST) == 0
+    fr = status.read("serving")["frontier"]
+    assert fr["template"] == "tmpl-abc123"
+    assert len(fr["points"]) == len(FAST["batch_sizes"])
+
+
+def _fd_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPU_FD_SKIP_JAX", "1")
+    monkeypatch.setenv("STATUS_DIR", str(tmp_path))
+    monkeypatch.setenv("TPU_DEV_GLOBS", str(tmp_path / "dev" / "accel*"))
+
+
+FRONTIER_PAYLOAD = {
+    "version": 1, "model_dim": 256, "measured_at": 1000.0,
+    "template": "t1",
+    "points": [
+        {"batch": 1, "p99_ms": 2.0, "tokens_per_s": 400.0, "samples": 32},
+        {"batch": 8, "p99_ms": 20.0, "tokens_per_s": 1000.0,
+         "samples": 32}]}
+
+
+def test_feature_discovery_mirrors_and_clears_frontier(fake_client,
+                                                       tmp_path,
+                                                       monkeypatch):
+    """Passing barrier with a frontier -> compact annotation on the node;
+    failing barrier -> annotation CLEARED (measured capacity must not
+    outlive its verdict); absent barrier -> untouched (no information)."""
+    from tpu_operator.serving import frontier as frontier_schema
+    from tpu_operator.validator.feature_discovery import sync_node_labels
+
+    _fd_env(tmp_path, monkeypatch)
+    fake_client.create({"apiVersion": "v1", "kind": "Node",
+                        "metadata": {"name": "n1"}, "status": {}})
+    status = StatusFiles(str(tmp_path))
+    status.write("serving", {"passed": True, "decode_p99_ms": 2.5,
+                             "throughput_tokens_per_s": 900.0,
+                             "slo_attainment": 1.0,
+                             "frontier": FRONTIER_PAYLOAD})
+    sync_node_labels(fake_client, "n1")
+    ann = fake_client.get("v1", "Node", "n1")["metadata"]["annotations"]
+    fr = frontier_schema.decode_annotation(
+        ann[consts.SERVING_FRONTIER_ANNOTATION])
+    assert fr.best_tokens_per_s(200.0) == 1000.0
+    assert fr.template == "t1"
+
+    status.write("serving", {"passed": False, "skipped_reason": "x"})
+    sync_node_labels(fake_client, "n1")
+    ann = fake_client.get("v1", "Node", "n1")["metadata"].get(
+        "annotations") or {}
+    assert consts.SERVING_FRONTIER_ANNOTATION not in ann
+
+
+def test_feature_discovery_clears_reprobe_on_current_template_curve(
+        fake_client, tmp_path, monkeypatch):
+    """The re-probe handshake's closing half: a freshly mirrored curve
+    measured under the node's CURRENT template deletes the operator's
+    pending ``tpu.ai/serving-reprobe`` request — and a curve from the
+    OLD template leaves it standing."""
+    from tpu_operator.validator.feature_discovery import sync_node_labels
+
+    _fd_env(tmp_path, monkeypatch)
+    fake_client.create({
+        "apiVersion": "v1", "kind": "Node",
+        "metadata": {"name": "n1",
+                     "labels": {consts.TEMPLATE_HASH_LABEL: "t2"},
+                     "annotations": {
+                         consts.SERVING_REPROBE_ANNOTATION: "t2"}},
+        "status": {}})
+    status = StatusFiles(str(tmp_path))
+    stale = dict(FRONTIER_PAYLOAD)  # measured under t1, node now t2
+    status.write("serving", {"passed": True, "decode_p99_ms": 2.5,
+                             "throughput_tokens_per_s": 900.0,
+                             "slo_attainment": 1.0, "frontier": stale})
+    sync_node_labels(fake_client, "n1")
+    ann = fake_client.get("v1", "Node", "n1")["metadata"]["annotations"]
+    assert ann[consts.SERVING_REPROBE_ANNOTATION] == "t2"  # still pending
+
+    fresh = dict(FRONTIER_PAYLOAD, template="t2")
+    status.write("serving", {"passed": True, "decode_p99_ms": 2.5,
+                             "throughput_tokens_per_s": 900.0,
+                             "slo_attainment": 1.0, "frontier": fresh})
+    sync_node_labels(fake_client, "n1")
+    ann = fake_client.get("v1", "Node", "n1")["metadata"].get(
+        "annotations") or {}
+    assert consts.SERVING_REPROBE_ANNOTATION not in ann
 
 
 # -- operator rollup: gauges, condition, alert feed ---------------------------

@@ -63,7 +63,8 @@ class LintConfig:
     manifest_texts: Optional[Dict[str, str]] = None
     #: directory names that mark a file as part of a reconcile path
     reconcile_dirs: Tuple[str, ...] = ("controllers", "state", "upgrade",
-                                       "autoscale", "migrate", "simulator")
+                                       "autoscale", "migrate", "simulator",
+                                       "capacity")
     #: directory names allowed to touch raw HTTP / RestClient
     client_dirs: Tuple[str, ...] = ("client",)
     #: composition roots additionally allowed to construct RestClient

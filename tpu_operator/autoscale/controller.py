@@ -110,7 +110,8 @@ class AutoscaleReconciler(Reconciler):
                  chips_per_node: int = 4,
                  horizon_s: float = DEFAULT_HORIZON_S,
                  now=time.time,
-                 journal: Optional[DecisionJournal] = None):
+                 journal: Optional[DecisionJournal] = None,
+                 capacity=None):
         self.client = client
         self.namespace = namespace or os.environ.get(
             consts.NAMESPACE_ENV, consts.DEFAULT_NAMESPACE)
@@ -119,21 +120,31 @@ class AutoscaleReconciler(Reconciler):
         self.default_chips_per_node = chips_per_node
         self.horizon_s = horizon_s
         self.now = now
-        #: in-memory predictors (backlog chips, SLO attainment) — the
-        #: window refills from the per-tick snapshot stream after a
-        #: restart; only *decision* state needs crash durability
+        #: the fleet capacity observatory (capacity.CapacityCollector) —
+        #: optional: without it (or before any node reports a frontier)
+        #: every decision takes the per-slice-constant fallback path
+        self.capacity = capacity
+        #: in-memory predictors (backlog chips, token rate, SLO
+        #: attainment) — the window refills from the per-tick snapshot
+        #: stream after a restart; only *decision* state needs crash
+        #: durability
         self._backlog = TrendPredictor()
+        self._token_demand = TrendPredictor()
         self._attainment = TrendPredictor()
         self._last_snapshot_ts: float = 0.0
         self._last_saturated = False
         self._last_decisions: List[PoolDecision] = []
+        self._last_frontier_tokens: float = 0.0
 
     def debug_state(self) -> dict:
         return {
             "autoscale": {
                 "backlog_level": round(self._backlog.level, 3),
                 "backlog_slope": round(self._backlog.slope(), 6),
+                "token_demand_level": round(self._token_demand.level, 3),
                 "attainment_level": round(self._attainment.level, 4),
+                "frontier_tokens_per_node": round(
+                    self._last_frontier_tokens, 1),
                 "decisions": [
                     {"pool": d.pool, "current": d.current,
                      "target": d.target, "action": d.action,
@@ -197,6 +208,7 @@ class AutoscaleReconciler(Reconciler):
     def _ingest_signals(self, spec: AutoscaleSpec,
                         policy: ClusterPolicy, nodes: List[dict]) -> None:
         self._backlog.window_s = float(spec.window_s)
+        self._token_demand.window_s = float(spec.window_s)
         self._attainment.window_s = float(spec.window_s)
         snap = parse_snapshot(deep_get(
             policy.obj, "metadata", "annotations",
@@ -207,6 +219,9 @@ class AutoscaleReconciler(Reconciler):
                 self._last_snapshot_ts = ts
                 self._backlog.observe(ts, float(snap.get("backlog_chips",
                                                          0.0)))
+                if snap.get("demand_tokens_per_s") is not None:
+                    self._token_demand.observe(
+                        ts, float(snap["demand_tokens_per_s"]))
                 if snap.get("attainment") is not None:
                     self._attainment.observe(ts, float(snap["attainment"]))
         elif self._last_snapshot_ts == 0.0:
@@ -539,7 +554,9 @@ class AutoscaleReconciler(Reconciler):
             trigger={"type": "traffic-snapshot", "pool": pool},
             inputs={"backlog_forecast_chips":
                     round(self._backlog.forecast(self.horizon_s), 3),
-                    "attainment": round(self._attainment.level, 4)},
+                    "attainment": round(self._attainment.level, 4),
+                    "frontier_tokens_per_node":
+                    round(self._last_frontier_tokens, 1)},
             decision={"pool": pool, "registered": created},
             alternatives=[{"option": "hold", "reason": "forecast demand "
                            "above capacity headroom for the horizon"}],
@@ -622,8 +639,26 @@ class AutoscaleReconciler(Reconciler):
         chips_per_node = (round(sum(chip_counts) / len(chip_counts))
                           if chip_counts else self.default_chips_per_node)
 
+        # the measured-frontier path: aggregate the fleet's serving
+        # frontiers (the collector also drives staleness/drift detection
+        # off this same pass) and size the fleet by what a node
+        # MEASURABLY serves at the SLO instead of the per-slice constant;
+        # tokens_per_node() == 0.0 (no usable curve) or a missing token
+        # feed falls back to the chip-constant path inside nodes_needed
+        frontier_tokens = 0.0
+        demand_tokens = 0.0
+        if self.capacity is not None:
+            self.capacity.max_p99_ms = float(
+                policy.spec.serving.max_decode_p99_ms)
+            self.capacity.observe(nodes)
+            frontier_tokens = self.capacity.tokens_per_node()
+            demand_tokens = self._token_demand.forecast(self.horizon_s)
+        self._last_frontier_tokens = frontier_tokens
+
         decisions = decide(spec, pool_sizes, demand_chips, chips_per_node,
-                           slo_breach, states, now)
+                           slo_breach, states, now,
+                           demand_tokens_per_s=demand_tokens,
+                           frontier_tokens_per_node=frontier_tokens)
         self._last_decisions = decisions
 
         capacity_chips = sum(chip_counts)

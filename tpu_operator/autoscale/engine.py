@@ -89,15 +89,36 @@ class PoolDecision:
 
 def nodes_needed(spec: AutoscaleSpec, demand_chips: float,
                  chips_per_node: int, slo_breach: bool,
-                 current_total: int) -> int:
-    """Fleet-wide node count the demand forecast asks for: forecast chips
-    inflated by the headroom margin, rounded up to whole nodes. An SLO
-    breach (measured or forecast attainment under target) overrides a
-    low backlog reading: latency is already suffering, so the fleet must
-    grow by at least one node regardless of what the queue says."""
-    chips = max(1, int(chips_per_node))
-    need = math.ceil(demand_chips * (1.0 + spec.headroom_pct / 100.0)
-                     / chips) if demand_chips > 0 else 0
+                 current_total: int,
+                 demand_tokens_per_s: float = 0.0,
+                 frontier_tokens_per_node: float = 0.0) -> int:
+    """Fleet-wide node count the demand forecast asks for.
+
+    **Measured-frontier path** (both a token-rate forecast and a measured
+    at-SLO per-node throughput present): forecast tokens/s inflated by the
+    headroom margin, divided by what one node *measurably* serves while
+    holding p99 under the SLO — the probe already traded batch depth
+    against latency when it picked the curve's at-SLO point, so the
+    division needs no assumed per-chip constant and stops over-provisioning
+    by whatever margin the assumption was conservative.
+
+    **Constant fallback** (no frontier, or no token feed): forecast chips
+    inflated by headroom over the per-slice chip constant — the original
+    assumed-capacity path, retained so a fleet that never probed (or whose
+    curves all went stale/cleared) keeps scaling.
+
+    Either way an SLO breach (measured or forecast attainment under
+    target) overrides a low demand reading: latency is already suffering,
+    so the fleet must grow by at least one node regardless of what the
+    queue says."""
+    if demand_tokens_per_s > 0 and frontier_tokens_per_node > 0:
+        need = math.ceil(
+            demand_tokens_per_s * (1.0 + spec.headroom_pct / 100.0)
+            / frontier_tokens_per_node)
+    else:
+        chips = max(1, int(chips_per_node))
+        need = math.ceil(demand_chips * (1.0 + spec.headroom_pct / 100.0)
+                         / chips) if demand_chips > 0 else 0
     if slo_breach:
         need = max(need, current_total + 1)
     return need
@@ -129,12 +150,16 @@ def spread_targets(spec: AutoscaleSpec, pool_sizes: Dict[str, int],
 
 def decide(spec: AutoscaleSpec, pool_sizes: Dict[str, int],
            demand_chips: float, chips_per_node: int, slo_breach: bool,
-           states: Dict[str, PoolState], now: float) -> List[PoolDecision]:
+           states: Dict[str, PoolState], now: float,
+           demand_tokens_per_s: float = 0.0,
+           frontier_tokens_per_node: float = 0.0) -> List[PoolDecision]:
     """One decision sweep: per-pool targets + the bounded actions that
     move toward them. Mutates ``states`` (below_since bookkeeping,
     targets) — the caller persists it afterward."""
     want = nodes_needed(spec, demand_chips, chips_per_node, slo_breach,
-                        sum(pool_sizes.values()))
+                        sum(pool_sizes.values()),
+                        demand_tokens_per_s=demand_tokens_per_s,
+                        frontier_tokens_per_node=frontier_tokens_per_node)
     targets = spread_targets(spec, pool_sizes, want)
     decisions: List[PoolDecision] = []
     for pool in sorted(pool_sizes):

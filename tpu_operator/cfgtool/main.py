@@ -396,6 +396,26 @@ def _serving_cell(labels: dict, annotations: dict) -> str:
     return verdict
 
 
+def _capacity_cell(annotations: dict) -> str:
+    """CAPACITY column: the node's measured serving frontier — the
+    curve's best point (tokens/s at its batch depth) from the
+    ``tpu.ai/serving-frontier`` annotation, flagged ``reprobe`` while the
+    operator's re-probe request (template changed since the curve was
+    measured) is pending. ``-`` until the node reports a curve."""
+    from .. import consts
+    from ..serving import frontier as frontier_schema
+
+    fr = frontier_schema.decode_annotation(
+        annotations.get(consts.SERVING_FRONTIER_ANNOTATION))
+    if fr is None or not fr.points:
+        return "-"
+    best = max(fr.points, key=lambda p: p.tokens_per_s)
+    cell = f"{best.tokens_per_s:g}t/s@b{best.batch}"
+    if annotations.get(consts.SERVING_REPROBE_ANNOTATION):
+        cell += " reprobe"
+    return cell
+
+
 def _autoscale_cells(policy_obj, tpu_nodes, now=None) -> dict:
     """AUTOSCALE column, keyed by node name: the node's pool posture —
     current/target size against the spec bounds, the in-flight resize
@@ -507,9 +527,9 @@ def _status(client, namespace, out) -> int:
                  if (n.get("metadata", {}).get("labels", {}) or {})
                  .get(consts.TPU_PRESENT_LABEL) == "true"]
     autoscale_cells = _autoscale_cells(autoscale_policy, tpu_nodes)
-    print("\nNODE            CAPACITY  HEALTHY  HEALTH-STATE     "
+    print("\nNODE            CHIPS     HEALTHY  HEALTH-STATE     "
           "UPGRADE-STATE    SLICE-PARTITION   SERVING             "
-          "AUTOSCALE            MIGRATION", file=out)
+          "CAPACITY            AUTOSCALE            MIGRATION", file=out)
     for node in tpu_nodes:
         labels = node.get("metadata", {}).get("labels", {}) or {}
         name = node["metadata"]["name"]
@@ -543,11 +563,12 @@ def _status(client, namespace, out) -> int:
         annotations = (node.get("metadata", {})
                        .get("annotations", {}) or {})
         serving = _serving_cell(labels, annotations)
+        frontier_capacity = _capacity_cell(annotations)
         autoscale = autoscale_cells.get(name, "-")
         migration = _migration_cell(annotations)
         print(f"{name:<15} {capacity:<9} {healthy:<8} {health_state:<16} "
               f"{upgrade:<16} {partition:<17} {serving:<19} "
-              f"{autoscale:<20} {migration}",
+              f"{frontier_capacity:<19} {autoscale:<20} {migration}",
               file=out)
 
     print("\nDAEMONSET                 DESIRED  AVAILABLE  UPDATED", file=out)

@@ -221,6 +221,17 @@ SERVING_SLO_LABEL = "tpu.ai/serving-slo"
 #: "p99_ms=3.1,tokens_per_s=5120,attainment=1.0" — an annotation because
 #: commas/decimals are not label-safe
 SERVING_SLO_ANNOTATION = "tpu.ai/serving-slo-detail"
+#: the node's measured latency-vs-throughput curve (serving/frontier.py
+#: compact codec, e.g. "v=1;at=...;t=<template>;p=1:0.4:2500:32,..."),
+#: mirrored from the serving barrier by feature discovery and aggregated
+#: fleet-wide by the operator's CapacityCollector; bounded by
+#: frontier.MAX_ANNOTATION_BYTES (deep points dropped first)
+SERVING_FRONTIER_ANNOTATION = "tpu.ai/serving-frontier"
+#: operator -> node-agent re-probe request: set by the CapacityCollector
+#: to the template hash that invalidated the node's frontier (template
+#: changed after the curve was measured); feature discovery clears it when
+#: it mirrors a frontier measured under the current template
+SERVING_REPROBE_ANNOTATION = "tpu.ai/serving-reprobe"
 
 # -- testing harness -----------------------------------------------------------
 #: pod label tying a kubelet-sim "DaemonSet" pod to the DS that owns it

@@ -28,7 +28,7 @@ log = logging.getLogger(__name__)
 #: there)
 DEBUG_ROUTES = ("/debug/informers", "/debug/traces", "/debug/join-traces",
                 "/debug/queue", "/debug/state", "/debug/threads",
-                "/debug/timeline")
+                "/debug/timeline", "/debug/capacity")
 
 
 def serve_health_and_metrics(metrics: OperatorMetrics, metrics_port: int,
@@ -158,6 +158,12 @@ def serve_health_and_metrics(metrics: OperatorMetrics, metrics_port: int,
                     "records": records,
                 })
                 return
+            if path == "/debug/capacity" and debug_on:
+                # the fleet capacity observatory: pool capacity curves
+                # aggregated from per-node serving frontiers, staleness
+                # and open drift episodes
+                self._send_json(app.capacity.debug_state())
+                return
             if path == "/debug/threads" and debug_on:
                 # pprof-style goroutine-dump analog for the threaded runtime
                 import sys
@@ -257,10 +263,20 @@ class OperatorApp:
         self.upgrade_controller = self.manager.add(
             setup_upgrade_controller(client, self.upgrade_reconciler))
         from ..autoscale import AutoscaleReconciler, setup_autoscale_controller
+        # fleet capacity observatory: aggregates per-node serving
+        # frontiers into pool capacity curves (staleness/drift detection,
+        # /debug/capacity) and feeds the autoscaler its measured
+        # tokens-per-node-at-SLO divisor
+        from ..capacity import CapacityCollector
 
+        self.capacity = CapacityCollector(
+            client,
+            namespace or os.environ.get(consts.NAMESPACE_ENV,
+                                        consts.DEFAULT_NAMESPACE),
+            metrics=self.metrics)
         self.autoscale_reconciler = AutoscaleReconciler(
             client, namespace=namespace, metrics=self.metrics,
-            journal=self.journal)
+            journal=self.journal, capacity=self.capacity)
         self.autoscale_controller = self.manager.add(
             setup_autoscale_controller(client, self.autoscale_reconciler))
         from ..migrate import MigrationReconciler, setup_migration_controller
@@ -372,6 +388,7 @@ class OperatorApp:
             "flight_recorder": self.recorder.stats(),
             "join_profiler": self.join_profiler.stats(),
             "journal": self.journal.debug_state(),
+            "capacity": self.capacity.debug_state(),
         }
 
     def stop(self) -> None:

@@ -125,6 +125,27 @@ class OperatorMetrics:
             "are saturating at maxNodes or awaiting joins)",
             registry=self.registry)
 
+        # fleet capacity observatory (capacity.CapacityCollector)
+        self.serving_frontier_tokens_per_s = Gauge(
+            "tpu_operator_serving_frontier_tokens_per_s",
+            "Pool capacity curve from aggregated per-node serving "
+            "frontiers: median measured tokens/s a node in the pool "
+            "serves while holding p99 under the bucket's ceiling "
+            "(p99_bucket is le<ms> or inf)",
+            ["pool", "p99_bucket"], registry=self.registry)
+        self.serving_frontier_age = Gauge(
+            "tpu_operator_serving_frontier_age_seconds",
+            "Age of the node's measured serving frontier (now minus the "
+            "curve's measured_at stamp); the TPUFrontierStale alert "
+            "fires when capacity decisions run on an old curve",
+            ["node"], registry=self.registry)
+        self.serving_frontier_drift = Counter(
+            "tpu_operator_serving_frontier_drift",
+            "FrontierDrift episodes: a node's measured curve departed "
+            "its pool's envelope (edge-triggered, one count per episode, "
+            "not per sweep)",
+            ["pool"], registry=self.registry)
+
         # cross-node migration (migrate.MigrationReconciler + agents)
         self.migrations_total = Counter(
             "tpu_operator_migrations_total",

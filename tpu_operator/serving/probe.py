@@ -1,21 +1,32 @@
-"""The serving SLO probe: a jitted autoregressive decode-step loop.
+"""The serving SLO probe: a miniature continuous-batching decode engine.
 
 The workload check (validator/workload.py) proves the stack can *train*
-(one allreduce); this proves it can *serve*: repeated small-batch
-matmul-bound decode steps whose per-step latency and steady-state
-throughput are what a production inference fleet actually sells. The probe
-walks a batch ladder, times each decode step individually (p50/p99, not
-just a mean — tail latency is the serving SLO), and gates on configurable
-thresholds from ``spec.serving``.
+(one allreduce); this proves it can *serve* — and since PR 18 it measures
+what a serving fleet actually sells: the **latency-vs-throughput
+frontier**. One jitted engine step processes a fixed slot array behind an
+active mask (shape never changes, so the step compiles exactly once no
+matter how the batch composition shifts — the continuous-batching
+property), with a paged decode cache (per-slot page indirection through a
+page table; admission grabs a page in O(1), nothing is ever copied or
+grown per token) and mixed prompt/decode admission (each timed step
+retires one sequence and prefills a newcomer into its slot, so every
+measured point includes the prompt-in-the-batch tax a real continuous
+batcher pays).
 
-Compile time is measured AOT (``.lower().compile()``) exactly like the ICI
-sweep, and the persistent XLA compile cache is enabled first, so a node
-whose cache is warm reports the warm number — the 0.61 s -> 0.13 s win the
-bench quantifies is a serving-latency win here.
+For each depth on the batch ladder the probe times ``samples`` engine
+steps (at least ``min_samples`` — a p99 over 8 points is a max, not a
+tail) and emits a ``FrontierPoint``: depth -> (p99_ms, tokens/s,
+samples). The frontier rides the validation barrier, feature discovery
+mirrors it to the ``tpu.ai/serving-frontier`` annotation, and the
+operator's CapacityCollector aggregates it fleet-wide for the autoscaler.
+
+Compile time is measured AOT (``.lower().compile()``) exactly like the
+ICI sweep, and the persistent XLA compile cache is enabled first, so a
+node whose cache is warm reports the warm number.
 
 Runs identically under ``JAX_PLATFORMS=cpu`` (tests, bench) and on real
-TPU chips; the math is a deterministic integer-valued bf16 matmul chain so
-a wrong result is a hard fail, never a tolerance call.
+TPU chips; the math is a deterministic integer-valued bf16 matmul chain
+so a wrong result is a hard fail, never a tolerance call.
 """
 
 from __future__ import annotations
@@ -24,13 +35,28 @@ import dataclasses
 import time
 from typing import List, Optional, Sequence
 
+from .frontier import Frontier, FrontierPoint
+
+#: floor on timed steps per measured point: below this a nearest-rank p99
+#: is dominated by scheduler noise and consumers cannot judge confidence
+MIN_FRONTIER_SAMPLES = 16
+
+#: tokens per cache page; the probe keeps one live row per page (the
+#: accumulator), the page granularity is what a real paged KV cache
+#: allocates in
+PAGE_SIZE = 16
+
 
 @dataclasses.dataclass
 class BatchRungResult:
     """Measured numbers for one rung of the batch ladder."""
 
     batch: int
+    #: requested steps for this rung (spec.serving.stepsPerBatch)
     steps: int
+    #: timed steps actually measured: max(steps, MIN_FRONTIER_SAMPLES) —
+    #: the confidence denominator, surfaced through the barrier
+    samples: int
     p50_ms: float
     p99_ms: float
     mean_ms: float
@@ -59,6 +85,9 @@ class ServingReport:
     batches: List[dict]
     thresholds: dict
     failures: List[str] = dataclasses.field(default_factory=list)
+    #: the measured latency-vs-throughput curve (serving/frontier.py
+    #: schema); None only for skipped reports
+    frontier: Optional[dict] = None
     #: set when the probe never ran (quarantined node fails closed);
     #: carries the reason so consumers can distinguish "too slow" from
     #: "health-gated"
@@ -76,7 +105,7 @@ def skipped_report(reason: str, thresholds: Optional[dict] = None) -> ServingRep
         passed=False, platform="", n_devices=0, compile_s=0.0, elapsed_s=0.0,
         decode_p99_ms=0.0, decode_p50_ms=0.0, throughput_tokens_per_s=0.0,
         slo_attainment=0.0, batches=[], thresholds=dict(thresholds or {}),
-        failures=[f"skipped: {reason}"], skipped_reason=reason)
+        failures=[f"skipped: {reason}"], frontier=None, skipped_reason=reason)
 
 
 def _percentile(sorted_vals: Sequence[float], q: float) -> float:
@@ -92,14 +121,17 @@ def run_probe(batch_sizes: Sequence[int] = (1, 4, 8),
               max_decode_p99_ms: float = 200.0,
               min_throughput_tokens_per_s: float = 0.0,
               min_slo_attainment: float = 0.99,
-              model_dim: int = 256) -> ServingReport:
-    """Walk the batch ladder, measure per-step decode latency, gate on SLOs.
+              model_dim: int = 256,
+              min_samples: int = MIN_FRONTIER_SAMPLES) -> ServingReport:
+    """Run the continuous-batching engine across the batch ladder, measure
+    the frontier, gate on SLOs.
 
-    The decode step is the matmul-bound core of autoregressive inference:
-    one token embedding per sequence multiplied through a square weight, a
-    KV-cache-shaped accumulator update, and an argmax — all inside one
-    jitted function per batch size (shape change = recompile, exactly as a
-    real serving stack pays it, which is why the compile cache matters).
+    The engine step is the matmul-bound core of autoregressive inference:
+    one token embedding per live slot multiplied through a square weight,
+    a paged-cache accumulator update (gather page -> add -> scatter page),
+    and an argmax — all inside ONE jitted function whose shapes are fixed
+    at the deepest rung, so shifting the batch composition costs zero
+    recompiles. Depth is an active mask; admission is a page-table edit.
     """
     import jax
     import jax.numpy as jnp
@@ -115,43 +147,79 @@ def run_probe(batch_sizes: Sequence[int] = (1, 4, 8),
     # exact, so the correctness check below is equality, not tolerance
     w = jnp.eye(model_dim, dtype=jnp.bfloat16)
 
-    @jax.jit
-    def decode_step(tokens, cache):
-        # tokens: (batch, dim) one-hot-ish embeddings; cache: (batch, dim)
-        h = (tokens @ w).astype(jnp.float32)
-        h = h + 0.0 * cache  # cache participates so XLA can't elide it
-        cache = cache + h
-        logits = (h.astype(jnp.bfloat16) @ w).astype(jnp.float32)
-        return jnp.argmax(logits, axis=-1), cache
+    max_batch = max(batch_sizes) if batch_sizes else 1
+    n_pages = max_batch + 1  # one spare so admission always has a free page
 
-    compile_s_total = 0.0
+    def engine_step(tokens, pages, page_table, active, admit):
+        # tokens: (max_batch, dim) one-hot-ish embeddings
+        # pages: (n_pages, PAGE_SIZE, dim) paged cache; page_table maps
+        # slot -> page. The gather/scatter touches one row per live slot:
+        # O(batch), never O(history) — the paged-cache contract.
+        cache = pages[page_table, 0, :]
+        # prefill: an admitted slot starts from a fresh (zeroed) page —
+        # the prompt token is processed in the same batch as the decodes
+        cache = cache * (1.0 - admit)[:, None]
+        h = (tokens @ w).astype(jnp.float32)
+        h = h * active[:, None]
+        cache = cache + h
+        pages = pages.at[page_table, 0, :].set(cache)
+        logits = (h.astype(jnp.bfloat16) @ w).astype(jnp.float32)
+        return jnp.argmax(logits, axis=-1), pages
+
+    tokens = jnp.zeros((max_batch, model_dim), jnp.bfloat16).at[:, 0].set(1)
+    pages = jnp.zeros((n_pages, PAGE_SIZE, model_dim), jnp.float32)
+    page_table0 = jnp.arange(max_batch, dtype=jnp.int32)
+    active0 = jnp.ones((max_batch,), jnp.float32)
+    admit0 = jnp.zeros((max_batch,), jnp.float32)
+
+    compile_start = time.monotonic()
+    compiled = jax.jit(engine_step).lower(
+        tokens, pages, page_table0, active0, admit0).compile()
+    compile_s_total = time.monotonic() - compile_start
+
+    # warm-up step: first execution can still pay dispatch setup
+    out, pages = compiled(tokens, pages, page_table0, active0, admit0)
+    out.block_until_ready()
+
     rungs: List[BatchRungResult] = []
     failures: List[str] = []
+    if int(out[0]) != 0:  # identity weights: argmax must be column 0
+        failures.append(f"decode produced wrong argmax {int(out[0])} "
+                        f"(expected 0)")
+
+    import numpy as np
+
     for batch in batch_sizes:
-        tokens = jnp.zeros((batch, model_dim), jnp.bfloat16).at[:, 0].set(1)
-        cache = jnp.zeros((batch, model_dim), jnp.float32)
-        compile_start = time.monotonic()
-        compiled = decode_step.lower(tokens, cache).compile()
-        compile_s_total += time.monotonic() - compile_start
-        # warm-up step: first execution can still pay dispatch setup
-        out, cache = compiled(tokens, cache)
-        out.block_until_ready()
-        if int(out[0]) != 0:  # identity weights: argmax must be column 0
-            failures.append(f"batch={batch}: decode produced wrong argmax "
-                            f"{int(out[0])} (expected 0)")
+        samples = max(int(steps_per_batch), int(min_samples))
+        active = jnp.asarray(
+            np.arange(max_batch) < batch, jnp.float32)
+        # host-side page bookkeeping: slot -> page, plus one free page so
+        # every admission lands on a DIFFERENT page than the one retired
+        table = list(range(max_batch))
+        free_page = max_batch
         lat_s: List[float] = []
-        for _ in range(steps_per_batch):
+        for step in range(samples):
+            # continuous-batching admission: one sequence retires, a new
+            # one is prefilled into its slot on a freshly-mapped page —
+            # every timed step is a mixed prompt+decode batch
+            slot = step % batch
+            table[slot], free_page = free_page, table[slot]
+            page_table = jnp.asarray(table, jnp.int32)
+            admit = admit0.at[slot].set(1.0)
             t0 = time.monotonic()
-            out, cache = compiled(tokens, cache)
+            out, pages = compiled(tokens, pages, page_table, active, admit)
             out.block_until_ready()
             lat_s.append(time.monotonic() - t0)
+        if int(out[0]) != 0:
+            failures.append(f"batch={batch}: decode produced wrong argmax "
+                            f"{int(out[0])} (expected 0)")
         lat_s.sort()
         p50 = _percentile(lat_s, 0.50) * 1000
         p99 = _percentile(lat_s, 0.99) * 1000
         total = sum(lat_s)
         met = sum(1 for s in lat_s if s * 1000 <= max_decode_p99_ms)
         rungs.append(BatchRungResult(
-            batch=batch, steps=steps_per_batch,
+            batch=batch, steps=int(steps_per_batch), samples=samples,
             p50_ms=round(p50, 4), p99_ms=round(p99, 4),
             mean_ms=round(total / len(lat_s) * 1000, 4),
             tokens_per_s=round(batch * len(lat_s) / total, 1) if total else 0.0,
@@ -173,6 +241,13 @@ def run_probe(batch_sizes: Sequence[int] = (1, 4, 8),
         failures.append(f"slo_attainment={attainment} below required "
                         f"{min_slo_attainment}")
 
+    frontier = Frontier(
+        points=[FrontierPoint(batch=r.batch, p99_ms=r.p99_ms,
+                              tokens_per_s=r.tokens_per_s, samples=r.samples)
+                for r in rungs],
+        model_dim=model_dim,
+        measured_at=round(time.time(), 3))
+
     return ServingReport(
         passed=not failures,
         platform=platform,
@@ -190,4 +265,5 @@ def run_probe(batch_sizes: Sequence[int] = (1, 4, 8),
             "min_slo_attainment": min_slo_attainment,
         },
         failures=failures,
+        frontier=frontier.to_dict(),
     )

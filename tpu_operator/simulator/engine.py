@@ -42,6 +42,7 @@ from ..controllers.runtime import Request
 from ..health import drain as drain_protocol
 from ..provenance import ActuationObserver, DecisionJournal, causality_audit
 from ..serving import traffic
+from ..serving import frontier as frontier_schema
 from ..testing import MiniApiServer, NodeChaos, PodChaos
 from ..testing.kubelet import KubeletSimulator
 from ..testing.trainjob import SimulatedTrainingJob
@@ -365,6 +366,44 @@ class FleetSimulator:
                 victims.append(victim)
             self._sync()
             return {"victims": sorted(victims)}
+        if inj.kind == "frontier_drift":
+            # silent per-node degradation: a fraction of the fleet's
+            # measured serving curves collapse by ``factor`` (thermal
+            # throttling, a bad HBM stick — capacity the chip-count
+            # predictor is blind to). The CapacityCollector must flag the
+            # departure and the autoscaler must re-provision from the
+            # degraded measurement, not the nominal constant.
+            factor = float(params["factor"])
+            carriers = []
+            for n in sorted(self._nodes(),
+                            key=lambda n: n["metadata"]["name"]):
+                if frontier_schema.decode_annotation(deep_get(
+                        n, "metadata", "annotations",
+                        consts.SERVING_FRONTIER_ANNOTATION)) is not None:
+                    carriers.append(n)
+            if not carriers:
+                return {"victims": []}
+            count = max(1, round(float(params["frac"]) * len(carriers)))
+            victims = []
+            for node in self.rng_injections.sample(
+                    carriers, min(count, len(carriers))):
+                fr = frontier_schema.decode_annotation(deep_get(
+                    node, "metadata", "annotations",
+                    consts.SERVING_FRONTIER_ANNOTATION))
+                for p in fr.points:
+                    p.tokens_per_s *= factor
+                name = node["metadata"]["name"]
+                body = {"metadata": {"annotations": {
+                    consts.SERVING_FRONTIER_ANNOTATION:
+                        frontier_schema.encode_annotation(fr),
+                }}}
+                # environment fault, not an operator sweep
+                # opalint: disable=unbatched-sweep-write
+                if self.feed(lambda n=name, b=body: self.feeder.patch(
+                        "v1", "Node", n, b), "frontier-drift"):
+                    victims.append(name)
+            self._sync()
+            return {"victims": sorted(victims)}
         raise AssertionError(f"unhandled injection {inj.kind}")
 
     def _expire_brownout(self, tick: int) -> None:
@@ -577,10 +616,20 @@ class _AutoscaleDriver(_Driver):
     scenario) modulated by the traffic sim's sampled backlog jitter."""
 
     POOL = "v5-lite-podslice-4x4"
+    #: nominal tokens/s one healthy chip serves — the conversion between
+    #: the chip-denominated demand envelope and the token-denominated
+    #: serving loop. A healthy node's synthetic frontier tops out at
+    #: exactly CHIPS_PER_NODE * TOKENS_PER_CHIP, so with no drift the
+    #: measured path and the chip-constant path agree.
+    TOKENS_PER_CHIP = 250.0
+    #: SLO ceiling the serving loop reads curves at (mirrors the
+    #: ClusterPolicy spec.serving.maxDecodeP99Ms default)
+    MAX_P99_MS = 200.0
 
     def setup(self) -> None:
         sim, sc = self.sim, self.sim.scenario
         from ..autoscale import AutoscaleReconciler
+        from ..capacity import CapacityCollector
 
         spec = {
             "autoscale": {
@@ -598,10 +647,13 @@ class _AutoscaleDriver(_Driver):
         if sc.preemptible:
             spec["autoscale"]["preemptiblePools"] = [self.POOL]
         sim.feeder.create(new_cluster_policy(spec=spec))
+        self.capacity = CapacityCollector(
+            sim.op_client, consts.DEFAULT_NAMESPACE, now=sim.vclock.now)
         self.reconciler = AutoscaleReconciler(
             sim.op_client, chips_per_node=CHIPS_PER_NODE,
             horizon_s=JOIN_DELAY_TICKS * sc.tick_s,
-            now=sim.vclock.now, journal=sim.journal)
+            now=sim.vclock.now, journal=sim.journal,
+            capacity=self.capacity)
         # seeded demand: traffic-sim backlog samples modulate a rise-fall
         # envelope spanning the scenario (peak at 1/3, trough at the end)
         tr = traffic.run_scenario(
@@ -626,6 +678,9 @@ class _AutoscaleDriver(_Driver):
         self.attainments: List[float] = []
         self.first_seen: Dict[str, int] = {}
         self.peak_fleet = 0
+        #: node-ticks served from a measured curve — the oracle reports
+        #: which capacity basis actually judged the run
+        self.frontier_node_ticks = 0
 
     def _ack_open_plans(self, tick: int) -> None:
         # the acking workloads: one drain-ack per open plan, mirrored to
@@ -644,19 +699,72 @@ class _AutoscaleDriver(_Driver):
                     consts.DRAIN_ACK_ANNOTATION: json.dumps(
                         {"plan": fp, "step": tick})}}}), "drain-ack")
 
+    def _healthy_frontier_value(self) -> str:
+        """A freshly-joined node's synthetic measured curve: three depths,
+        all inside the SLO, topping out at the node's nominal token rate.
+        Deterministic apart from the virtual-clock timestamp."""
+        cap = CHIPS_PER_NODE * self.TOKENS_PER_CHIP
+        return frontier_schema.encode_annotation(frontier_schema.Frontier(
+            points=[
+                frontier_schema.FrontierPoint(1, 2.0, 0.4 * cap, 32),
+                frontier_schema.FrontierPoint(4, 8.0, 0.8 * cap, 32),
+                frontier_schema.FrontierPoint(8, 20.0, cap, 32),
+            ],
+            measured_at=self.sim.vclock.now()))
+
+    def _stamp_frontiers(self, serving: List[str],
+                         by_name: Dict[str, dict]) -> None:
+        # the node agents' probe + feature-discovery mirror, compressed:
+        # each serving node publishes its measured curve once on becoming
+        # serving (N independent node-side actors, not an operator sweep).
+        # Nodes already carrying a curve — including one degraded by the
+        # frontier_drift injection — are left alone.
+        for name in sorted(serving):
+            node = by_name.get(name)
+            if node is None or deep_get(
+                    node, "metadata", "annotations",
+                    consts.SERVING_FRONTIER_ANNOTATION):
+                continue
+            body = {"metadata": {"annotations": {
+                consts.SERVING_FRONTIER_ANNOTATION:
+                    self._healthy_frontier_value(),
+            }}}
+            # opalint: disable=unbatched-sweep-write
+            self.sim.feed(lambda n=name, b=body: self.sim.feeder.patch(
+                "v1", "Node", n, b), "frontier-probe")
+
+    def _capacity_tokens(self, serving: List[str],
+                         by_name: Dict[str, dict]) -> float:
+        """Fleet token capacity from each serving node's measured curve
+        at the SLO ceiling; nodes without a curve serve the nominal
+        constant (a drifted node really does serve less)."""
+        total = 0.0
+        for name in serving:
+            fr = frontier_schema.decode_annotation(deep_get(
+                by_name.get(name, {}), "metadata", "annotations",
+                consts.SERVING_FRONTIER_ANNOTATION))
+            if fr is not None and fr.points:
+                total += fr.best_tokens_per_s(self.MAX_P99_MS)
+                self.frontier_node_ticks += 1
+            else:
+                total += CHIPS_PER_NODE * self.TOKENS_PER_CHIP
+        return total
+
     def tick(self, tick: int) -> None:
         sim = self.sim
-        names = {n["metadata"]["name"] for n in sim._nodes()}
-        for name in names:
+        nodes = sim._nodes()
+        by_name = {n["metadata"]["name"]: n for n in nodes}
+        for name in by_name:
             self.first_seen.setdefault(name, tick)
-        self.peak_fleet = max(self.peak_fleet, len(names))
-        serving = [n for n in names
+        self.peak_fleet = max(self.peak_fleet, len(by_name))
+        serving = [n for n in by_name
                    if self.first_seen[n] == 0
                    or tick - self.first_seen[n] >= JOIN_DELAY_TICKS]
-        capacity = len(serving) * CHIPS_PER_NODE
-        demand = self.demand_at(tick)
-        outstanding = self.queue + demand
-        served = min(outstanding, capacity)
+        self._stamp_frontiers(serving, by_name)
+        capacity_tokens = self._capacity_tokens(serving, by_name)
+        demand_tokens = self.demand_at(tick) * self.TOKENS_PER_CHIP
+        outstanding = self.queue + demand_tokens
+        served = min(outstanding, capacity_tokens)
         attain = served / outstanding if outstanding > 0 else 1.0
         self.queue = outstanding - served
         if tick < sim.scenario.ticks:
@@ -666,9 +774,14 @@ class _AutoscaleDriver(_Driver):
             {"metadata": {"annotations": {
                 consts.TRAFFIC_SNAPSHOT_ANNOTATION: json.dumps({
                     "ts": sim.vclock.now(),
-                    "queue_depth": round(self.queue / CHIPS_PER_NODE, 3),
-                    "backlog_chips": round(outstanding, 3),
-                    "attainment": round(attain, 4)})}}}), "traffic-snapshot")
+                    "queue_depth": round(
+                        self.queue
+                        / (CHIPS_PER_NODE * self.TOKENS_PER_CHIP), 3),
+                    "backlog_chips": round(
+                        outstanding / self.TOKENS_PER_CHIP, 3),
+                    "attainment": round(attain, 4),
+                    "demand_tokens_per_s": round(outstanding, 3),
+                })}}}), "traffic-snapshot")
         self._ack_open_plans(tick)
         sim._sync()
         sim._reconcile(self.reconciler, Request(name="cluster-policy"))
@@ -693,12 +806,21 @@ class _AutoscaleDriver(_Driver):
     def active(self) -> bool:
         return self._resize_in_flight() or self._open_plans()
 
+    def _capacity_basis(self) -> str:
+        return ("frontier-measured" if self.frontier_node_ticks
+                else "chip-constant")
+
     def oracles(self):
         floor = self.sim.scenario.slo_floor
         mean = (sum(self.attainments) / len(self.attainments)
                 if self.attainments else 1.0)
+        # the attainment series is computed against the fleet's measured
+        # frontier whenever curves are present — a frontier_drift
+        # injection really removes serving capacity, so the floor judges
+        # whether the autoscaler re-provisioned from the measurement
         yield ("slo_floor", mean >= floor,
-               f"mean attainment {mean:.4f} vs floor {floor}")
+               f"mean attainment {mean:.4f} vs floor {floor} "
+               f"({self._capacity_basis()} capacity)")
 
     def report(self) -> dict:
         mean = (sum(self.attainments) / len(self.attainments)
@@ -711,6 +833,7 @@ class _AutoscaleDriver(_Driver):
             "peak_fleet": self.peak_fleet,
             "final_fleet": len(self.sim._nodes()),
             "scale_downs": self.sim.auditor.node_deletes,
+            "capacity_basis": self._capacity_basis(),
         }
 
 

@@ -55,6 +55,9 @@ def sample_scenario(root_seed: int, index: int) -> Scenario:
             params["join"] = rng.choice((4, 8, 16))
         elif kind == "pod_chaos":
             params["kills"] = rng.randint(1, 3)
+        elif kind == "frontier_drift":
+            params["frac"] = rng.choice((0.2, 0.25, 0.34, 0.5))
+            params["factor"] = rng.choice((0.2, 0.25, 0.4))
         if rng.random() < 0.5:
             injections.append(Injection(kind=kind, params=params,
                                         at=rng.randint(1, ticks - 2)))

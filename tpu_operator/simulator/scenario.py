@@ -56,6 +56,7 @@ INJECTION_KINDS: Dict[str, Dict[str, float]] = {
     "apiserver_brownout": {"p": 0.4, "dur": 60.0},
     "thundering_herd": {"join": 10},
     "pod_chaos": {"kills": 2},
+    "frontier_drift": {"frac": 0.25, "factor": 0.25},
 }
 
 CONDITIONS = ("start", "drain_open", "scale_up", "upgrade",

@@ -143,6 +143,34 @@ def serving_slo_verdict():
     return verdict, serving_detail(info)
 
 
+def serving_frontier_value() -> Optional[str]:
+    """The encoded ``tpu.ai/serving-frontier`` annotation value for this
+    node's barrier: the measured curve in the compact codec, ``""`` when
+    the barrier is non-passing/corrupt or carries no frontier (the stale
+    curve must be CLEARED — measured capacity must not outlive its
+    verdict), None when the barrier is absent (no information, annotation
+    untouched). The curve's template hash is stamped at probe time
+    (``TPU_TEMPLATE_HASH`` env), so the operator's CapacityCollector can
+    tell a curve measured under the node's current template from one that
+    predates a template change."""
+    from ..serving import frontier as frontier_schema
+    from .status import StatusFiles
+
+    status_dir = os.environ.get("STATUS_DIR", consts.VALIDATION_STATUS_DIR)
+    status = StatusFiles(status_dir)
+    info = status.read("serving")
+    if info is None:
+        if os.path.exists(status.path("serving")):
+            return ""  # unparsable barrier: clear the curve, fail safe
+        return None
+    if info.get("passed") is not True:
+        return ""
+    fr = frontier_schema.from_dict(info.get("frontier"))
+    if fr is None:
+        return ""
+    return frontier_schema.encode_annotation(fr)
+
+
 def sync_node_labels(client, node_name: str, use_jax: bool = True) -> Dict[str, str]:
     """One discovery pass: compute labels, mirror GKE labels, patch if drifted."""
     node = client.get("v1", "Node", node_name)
@@ -214,6 +242,33 @@ def sync_node_labels(client, node_name: str, use_jax: bool = True) -> Dict[str, 
         if detail != current_detail:
             client.patch("v1", "Node", node_name, {"metadata": {
                 "annotations": {consts.SERVING_SLO_ANNOTATION: detail}}})
+    # the measured frontier rides its own size-bounded annotation (compact
+    # codec, deep points dropped first): published on a passing barrier,
+    # CLEARED (merge-patch delete) when the barrier fails or goes corrupt
+    # so stale measured capacity never outlives its verdict
+    frontier_value = serving_frontier_value()
+    if frontier_value is not None:
+        current_frontier = deep_get(node, "metadata", "annotations",
+                                    consts.SERVING_FRONTIER_ANNOTATION)
+        if (current_frontier or None) != (frontier_value or None):
+            client.patch("v1", "Node", node_name, {"metadata": {
+                "annotations": {consts.SERVING_FRONTIER_ANNOTATION:
+                                frontier_value or None}}})
+            log.info("feature discovery: %s serving frontier %s",
+                     node_name, "updated" if frontier_value else "cleared")
+        # a freshly-mirrored curve measured under the node's CURRENT
+        # template satisfies any pending operator re-probe request
+        if frontier_value:
+            from ..serving import frontier as frontier_schema
+
+            fr = frontier_schema.decode_annotation(frontier_value)
+            reprobe = deep_get(node, "metadata", "annotations",
+                               consts.SERVING_REPROBE_ANNOTATION)
+            live_template = current.get(consts.TEMPLATE_HASH_LABEL, "")
+            if (reprobe and fr is not None and fr.template
+                    and fr.template == live_template):
+                client.patch("v1", "Node", node_name, {"metadata": {
+                    "annotations": {consts.SERVING_REPROBE_ANNOTATION: None}}})
     # mirror the node's span log (operand entrypoints append their join
     # spans there) up to the tpu.ai/trace-spans annotation, size-bounded,
     # so the operator's JoinProfiler can stitch the end-to-end join trace.

@@ -106,8 +106,16 @@ def run_serving(status: StatusFiles,
             # serving verdict, not a crash: fail closed with the reason
             log.exception("serving probe crashed")
             report = skipped_report(f"probe-error: {e}"[:200], thresholds)
-    print(json.dumps(report.to_dict()))
-    status.write("serving", report.to_dict())
+    payload = report.to_dict()
+    # stamp the template hash the probe ran under (DS template stamps
+    # TPU_TEMPLATE_HASH via the downward API analog) into the frontier, so
+    # the operator can tell a curve measured under the node's current
+    # template from one that predates a template change
+    if payload.get("frontier") is not None:
+        payload["frontier"]["template"] = os.environ.get(
+            "TPU_TEMPLATE_HASH", "")
+    print(json.dumps(payload))
+    status.write("serving", payload)
     return 0 if report.passed else 1
 
 
