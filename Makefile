@@ -106,6 +106,27 @@ scenario-fuzz:  ## adversarial fleet simulator CI gate: sample+run $(SCENARIO_FU
 scenario-replay:  ## tier-1 smoke for the committed compound-failure regression cases: replay every tests/cases/scenarios/*.yaml through the simulator, all oracles green
 	SCENARIO_SEED=$(SCENARIO_SEED) $(PYTHON) -m pytest tests/test_simulator.py -q
 
+OPSAN_SEED ?= 20260807
+OPSAN_REPORT_DIR ?= /tmp/tpu-operator-opsan
+RACE_SOAK_FUZZ_BUDGET ?= 10
+
+.PHONY: race-soak
+race-soak:  ## opsan race-sanitizer soak (docs/static-analysis.md § opsan): run the crash-soak matrix, the split-brain suite, the drain-soak flake regression (test_health_soak, reproduced at this exact seed), and a $(RACE_SOAK_FUZZ_BUDGET)-scenario fuzz slice under TPU_OPERATOR_OPSAN=1 with the seeded schedule perturber, then cross-check the observed lock-acquisition graph against opalint's static lock graph. Nonzero exit on any unsuppressed race OR any dynamic-only edge missing from tests/cases/opsan/dynamic_edges.json. Red runs replay bit-for-bit from OPSAN_SEED.
+	rm -rf $(OPSAN_REPORT_DIR) && mkdir -p $(OPSAN_REPORT_DIR)
+	TPU_OPERATOR_OPSAN=1 TPU_OPERATOR_OPSAN_PERTURB=1 \
+	TPU_OPERATOR_OPSAN_REPORT=$(OPSAN_REPORT_DIR) \
+	OPSAN_SEED=$(OPSAN_SEED) CRASH_SOAK_SEED=$(CRASH_SOAK_SEED) \
+	CHAOS_SEED=$(OPSAN_SEED) $(PYTHON) -m pytest \
+		tests/test_crash_soak.py tests/test_fencing.py \
+		tests/test_split_brain.py tests/test_health_soak.py -q
+	TPU_OPERATOR_OPSAN=1 TPU_OPERATOR_OPSAN_PERTURB=1 \
+	TPU_OPERATOR_OPSAN_REPORT=$(OPSAN_REPORT_DIR) \
+	OPSAN_SEED=$(OPSAN_SEED) SCENARIO_SEED=$(SCENARIO_SEED) \
+	$(PYTHON) -m tpu_operator.cmd.sim fuzz \
+		--budget $(RACE_SOAK_FUZZ_BUDGET) --double-run
+	$(PYTHON) -m tpu_operator.cmd.opsan check --reports $(OPSAN_REPORT_DIR) \
+		--fixtures tests/cases/opsan/dynamic_edges.json
+
 .PHONY: generate
 generate:  ## regenerate CRDs into all install channels (reference: make manifests)
 	$(PYTHON) hack/gen-crds.py

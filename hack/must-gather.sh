@@ -109,6 +109,8 @@ for pod in $($K -n "$NS" get pods -l app=tpu-operator -o name 2>/dev/null); do
     $K -n "$NS" get --raw "/api/v1/namespaces/$NS/pods/$name:8081/proxy/debug/threads"
   collect "operator/$name/informers.json" \
     $K -n "$NS" get --raw "/api/v1/namespaces/$NS/pods/$name:8081/proxy/debug/informers"
+  collect "operator/$name/opsan.json" \
+    $K -n "$NS" get --raw "/api/v1/namespaces/$NS/pods/$name:8081/proxy/debug/opsan"
 done
 
 # events/

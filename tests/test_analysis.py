@@ -1279,6 +1279,26 @@ POSITIVE_FIXTURES = {
             PROTOCOL_WRITER.format(record="record"),
     },
     "lock-order-inversion": ("tpu_operator/state/pool.py", INVERTED_LOCKS),
+    # dynamic-sanitizer companion rule: mutable attr reached from two
+    # thread entrypoints, neither lock-guarded nor opsan-registered
+    # (tests/test_sanitizer.py holds the full positive/negative matrix)
+    "untracked-shared-state": ("tpu_operator/controllers/widget.py", """
+        import threading
+
+        class Widget:
+            def __init__(self):
+                self._jobs = {}
+
+            def start(self):
+                threading.Thread(target=self._worker).start()
+                threading.Thread(target=self._drainer).start()
+
+            def _worker(self):
+                self._jobs["k"] = 1
+
+            def _drainer(self):
+                self._jobs.clear()
+    """),
 }
 
 

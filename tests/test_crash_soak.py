@@ -453,7 +453,15 @@ def test_crash_point_matrix_full_episode(tmp_path, monkeypatch):
                               arm=(site, when))
         observed |= summary["all_sites"]
         if not summary["fired"]:
-            failures.append(f"uncovered crash site ({when}): {site}")
+            # Event announcement *variants* are schedule-dependent (the
+            # self-audit below excludes them for the same reason): whether
+            # a Ready re-announcement aggregates into a PUT depends on the
+            # reconcile interleaving, so under the opsan schedule perturber
+            # an armed Event site may simply not recur in the replay
+            # (reproduced with OPSAN_SEED=20260807). STATE sites must
+            # always re-fire — those stay hard failures.
+            if " Event/" not in site:
+                failures.append(f"uncovered crash site ({when}): {site}")
             continue
         try:
             check_invariants(summary, baseline)

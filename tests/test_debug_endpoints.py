@@ -216,9 +216,20 @@ def test_tpudriver_reconcile_produces_cross_referenced_trace(monkeypatch):
         trace_id = ready_events[0]["metadata"]["annotations"][
             tracing.TRACE_ID_ANNOTATION]
 
-        # the Event's trace ID retrieves exactly that reconcile's trace
-        body = rq.get(f"{debug}/debug/traces?trace={trace_id}",
-                      timeout=5).json()
+        # the Event's trace ID retrieves exactly that reconcile's trace.
+        # Poll: the Event is emitted mid-reconcile but the trace only
+        # lands in the flight recorder when the reconcile completes, so
+        # the annotation can be visible before the trace is queryable
+        # (reproduced with OPSAN_SEED=20260807 under the opsan schedule
+        # perturber, same write-ordering class as the drain-soak flake).
+        deadline = time.monotonic() + 10
+        body = {"count": 0}
+        while time.monotonic() < deadline:
+            body = rq.get(f"{debug}/debug/traces?trace={trace_id}",
+                          timeout=5).json()
+            if body["count"]:
+                break
+            time.sleep(0.05)
         assert body["count"] == 1
         root = body["traces"][0]
         assert root["name"] == "reconcile" and root["kind"] == "reconcile"

@@ -1,0 +1,543 @@
+"""opsan (tpu_operator.sanitizer): lockset algorithm positive/negative
+fixtures, happens-before edge unit tests, tracked-lock semantics, the
+seeded schedule perturber's determinism contract, the static<->dynamic
+lock-graph cross-check gate, and the untracked-shared-state opalint rule.
+
+The planted-race fixture is the sanitizer's own acceptance gate: a
+lock-free two-writer race must be detected on EVERY seed (the lockset
+algorithm is schedule-insensitive by design — that is its whole point
+over a pure happens-before detector), while the benign initialization
+and hand-off patterns must stay silent on every seed.
+"""
+
+import ast
+import json
+import queue
+import textwrap
+import threading
+
+import pytest
+
+from tpu_operator.analysis.core import (
+    FileContext,
+    LintConfig,
+    all_checkers,
+    apply_suppressions,
+    suppressions,
+)
+from tpu_operator.analysis import graph as graph_mod
+from tpu_operator.sanitizer import crosscheck as cc
+from tpu_operator.sanitizer import hooks as hooks_mod
+from tpu_operator.sanitizer.core import (
+    OpsanRuntime,
+    reset_runtime,
+    runtime,
+    vc_join,
+    vc_leq,
+)
+from tpu_operator.sanitizer.locks import TrackedLock, TrackedRLock
+from tpu_operator.sanitizer.perturb import (
+    DEFAULT_OPSAN_SEED,
+    Perturber,
+    resolve_opsan_seed,
+)
+from tpu_operator.sanitizer.registry import TrackedDict, register_shared
+from tpu_operator.utils.locks import make_lock, make_rlock
+
+
+@pytest.fixture
+def opsan(monkeypatch):
+    """Enabled sanitizer with HB hooks installed; torn down afterwards."""
+    monkeypatch.setenv("TPU_OPERATOR_OPSAN", "1")
+    hooks_mod.install()
+    rt = reset_runtime()
+    yield rt
+    hooks_mod.uninstall()
+    reset_runtime()
+
+
+def _run_threads(*targets):
+    threads = [threading.Thread(target=t, name=f"t{i}")
+               for i, t in enumerate(targets)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+
+# -- vector-clock primitives --------------------------------------------------
+
+def test_vc_join_and_leq():
+    a = {"x": 2, "y": 1}
+    b = {"x": 1, "z": 3}
+    vc_join(a, b)
+    assert a == {"x": 2, "y": 1, "z": 3}
+    assert vc_leq({"x": 1}, {"x": 2})
+    assert vc_leq({}, {"x": 1})
+    assert not vc_leq({"x": 3}, {"x": 2})
+    assert not vc_leq({"w": 1}, {"x": 2})
+
+
+# -- the planted race: detected on EVERY seed ---------------------------------
+
+def test_planted_race_detected_across_20_seeds(monkeypatch):
+    """The acceptance fixture from the issue: a lock-free two-writer race
+    must be caught on all 20 perturber seeds — lockset state is
+    schedule-insensitive, so detection cannot depend on which
+    interleaving a seed happens to produce."""
+    monkeypatch.setenv("TPU_OPERATOR_OPSAN", "1")
+    hooks_mod.install()
+    try:
+        for seed in range(20):
+            rt = reset_runtime(perturber=Perturber(seed, sleep=lambda s: None))
+            shared = register_shared("planted.racy", {})
+
+            def writer(key):
+                for i in range(20):
+                    shared[key] = i
+
+            _run_threads(lambda: writer("a"), lambda: writer("b"))
+            assert rt.races, f"planted race NOT detected on seed {seed}"
+            assert rt.races[0].var == "planted.racy"
+            assert rt.races[0].held == []
+    finally:
+        hooks_mod.uninstall()
+        reset_runtime()
+
+
+def test_guarded_access_is_silent_across_seeds(monkeypatch):
+    monkeypatch.setenv("TPU_OPERATOR_OPSAN", "1")
+    hooks_mod.install()
+    try:
+        for seed in range(5):
+            rt = reset_runtime(perturber=Perturber(seed, sleep=lambda s: None))
+            lock = TrackedLock("Fixture._lock")
+            shared = register_shared("guarded.map", {})
+
+            def writer(key):
+                for i in range(20):
+                    with lock:
+                        shared[key] = i
+
+            _run_threads(lambda: writer("a"), lambda: writer("b"))
+            assert not rt.races, rt.races[0].describe() if rt.races else ""
+    finally:
+        hooks_mod.uninstall()
+        reset_runtime()
+
+
+# -- happens-before negative fixtures (init / hand-off stay silent) -----------
+
+def test_init_then_publish_is_silent(opsan):
+    shared = register_shared("init.map", {})
+    shared["built"] = 1  # single-threaded init on the parent
+
+    def reader():
+        assert shared.get("built") == 1
+
+    _run_threads(reader)
+    assert not opsan.races
+
+
+def test_join_handoff_is_silent(opsan):
+    shared = register_shared("join.map", {})
+
+    def child():
+        shared["child"] = 1
+
+    t = threading.Thread(target=child)
+    t.start()
+    t.join()
+    shared["parent"] = 2  # ordered by the join edge
+    assert not opsan.races
+
+
+def test_queue_handoff_is_silent(opsan):
+    shared = register_shared("queue.map", {})
+    q = queue.Queue()
+
+    def producer():
+        shared["k"] = 1
+        q.put("token")
+
+    def consumer():
+        q.get()
+        shared["k2"] = 2  # ordered by the put->get edge
+
+    tp = threading.Thread(target=producer)
+    tc = threading.Thread(target=consumer)
+    tp.start()
+    tc.start()
+    tp.join()
+    tc.join()
+    assert not opsan.races
+
+
+def test_lock_release_acquire_handoff_is_silent(opsan):
+    """Ownership handed off through a lock the accesses themselves are
+    NOT under: A builds the object, then releases L; B acquires L and
+    takes over. The release->acquire edge orders the EXCLUSIVE
+    transfer."""
+    lock = TrackedLock("Handoff._lock")
+    shared = register_shared("handoff.map", {})
+    ready = threading.Event()
+
+    def first_owner():
+        shared["a"] = 1
+        with lock:
+            pass  # publish: release carries first_owner's clock
+        ready.set()
+
+    def second_owner():
+        ready.wait()
+        with lock:
+            pass  # absorb: acquire joins the lock's clock
+        shared["b"] = 2
+
+    _run_threads(first_owner, second_owner)
+    assert not opsan.races
+
+
+def test_unordered_two_writers_race_without_locks(opsan):
+    """Control for the hand-off fixtures: the same two-writer shape with
+    no ordering edge at all must race."""
+    shared = register_shared("control.map", {})
+    gate = threading.Barrier(2)
+
+    def writer(key):
+        gate.wait()
+        shared[key] = 1
+
+    _run_threads(lambda: writer("a"), lambda: writer("b"))
+    assert opsan.races
+
+
+# -- suppression and reporting ------------------------------------------------
+
+def test_suppression_requires_rationale_and_silences(opsan):
+    with pytest.raises(ValueError):
+        opsan.suppress("noisy.", "")
+    opsan.suppress("noisy.", "intentionally racy test fixture")
+    shared = register_shared("noisy.map", {})
+    gate = threading.Barrier(2)
+
+    def writer(key):
+        gate.wait()
+        shared[key] = 1
+
+    _run_threads(lambda: writer("a"), lambda: writer("b"))
+    assert not opsan.races
+    assert opsan.report()["suppressions"] == {
+        "noisy.": "intentionally racy test fixture"}
+
+
+def test_report_shape_and_dump(opsan, tmp_path):
+    lock_a = TrackedLock("A._lock")
+    lock_b = TrackedLock("B._lock")
+    shared = register_shared("r.map", {})
+    with lock_a:
+        with lock_b:
+            shared["k"] = 1
+    rep = opsan.report()
+    assert rep["version"] == 1
+    assert rep["accesses_total"] == 1
+    assert "r.map" in rep["tracked_vars"]
+    assert ["A._lock", "B._lock"] == rep["locks"]
+    assert rep["lock_edges"][0][:2] == ["A._lock", "B._lock"]
+    path = opsan.dump(str(tmp_path))
+    with open(path) as fh:
+        assert json.load(fh) == rep
+
+
+# -- tracked lock semantics ---------------------------------------------------
+
+def test_tracked_rlock_reentrancy_counts_once(opsan):
+    rl = TrackedRLock("R._lock")
+    with rl:
+        with rl:
+            assert runtime().held_locks() == ["R._lock"]
+    assert runtime().held_locks() == []
+    with pytest.raises(RuntimeError):
+        rl.release()
+
+
+def test_factory_returns_raw_primitives_when_disabled(monkeypatch):
+    monkeypatch.delenv("TPU_OPERATOR_OPSAN", raising=False)
+    assert isinstance(make_lock("X._lock"), type(threading.Lock()))
+    # RLock's concrete type varies by impl; duck-check instead
+    rl = make_rlock("X._rlock")
+    assert not isinstance(rl, TrackedRLock)
+    assert register_shared is not None  # registry import stays valid
+
+
+def test_factory_returns_tracked_when_enabled(opsan):
+    assert isinstance(make_lock("X._lock"), TrackedLock)
+    assert isinstance(make_rlock("X._rlock"), TrackedRLock)
+
+
+def test_registry_uniquifies_reregistration(opsan):
+    first = register_shared("W._pending", {})
+    second = register_shared("W._pending", {"x": 1})
+    assert isinstance(first, TrackedDict)
+    assert isinstance(second, TrackedDict)
+    assert first._opsan_name == "W._pending"
+    assert second._opsan_name == "W._pending#1"
+    assert dict(second) == {"x": 1}
+
+
+def test_registry_is_identity_when_disabled(monkeypatch):
+    monkeypatch.delenv("TPU_OPERATOR_OPSAN", raising=False)
+    raw = {}
+    assert register_shared("X.raw", raw) is raw
+
+
+def test_wire_opsan_feeds_both_families(opsan):
+    from tpu_operator.controllers.metrics import OperatorMetrics
+
+    metrics = OperatorMetrics()
+    metrics.wire_opsan(opsan)
+    shared = register_shared("wired.map", {})
+    gate = threading.Barrier(2)
+
+    def writer(key):
+        gate.wait()
+        shared[key] = 1
+
+    _run_threads(lambda: writer("a"), lambda: writer("b"))
+    assert len(opsan.races) == 1
+    text = metrics.scrape().decode()
+    assert "tpu_operator_opsan_races_total 1.0" in text
+    assert "tpu_operator_opsan_tracked_accesses_total 2.0" in text
+
+
+# -- perturber ----------------------------------------------------------------
+
+def test_perturber_same_seed_same_trace():
+    sleeps_1, sleeps_2 = [], []
+    p1 = Perturber(1234, sleep=sleeps_1.append)
+    p2 = Perturber(1234, sleep=sleeps_2.append)
+    for _ in range(500):
+        p1.point("acquire")
+        p2.point("acquire")
+    assert p1.trace() == p2.trace()
+    assert sleeps_1 == sleeps_2
+    assert p1.stats()["points_total"] == 500
+
+
+def test_perturber_different_seed_different_trace():
+    p1 = Perturber(1, sleep=lambda s: None)
+    p2 = Perturber(2, sleep=lambda s: None)
+    for _ in range(500):
+        p1.point("access")
+        p2.point("access")
+    assert p1.trace() != p2.trace()
+
+
+def test_perturber_threads_never_share_rng():
+    """A thread consuming extra decision samples must not perturb another
+    thread's sequence — each is keyed by (root seed, thread name)."""
+    p1 = Perturber(42, sleep=lambda s: None)
+    p2 = Perturber(42, sleep=lambda s: None)
+    out = {}
+
+    def worker(p, n, results):
+        for _ in range(n):
+            p.point("access")
+        results[threading.current_thread().name] = p.trace()
+
+    r1, r2 = {}, {}
+    t = threading.Thread(target=worker, args=(p1, 100, r1), name="steady")
+    t.start(); t.join()
+    # second run: a sibling thread consumes a different number of samples
+    ta = threading.Thread(target=worker, args=(p2, 100, r2), name="steady")
+    tb = threading.Thread(target=worker, args=(p2, 37, r2), name="noisy")
+    ta.start(); tb.start(); ta.join(); tb.join()
+    assert r1["steady"] == r2["steady"]
+
+
+def test_resolve_opsan_seed_precedence(monkeypatch):
+    monkeypatch.delenv("OPSAN_SEED", raising=False)
+    monkeypatch.delenv("SCENARIO_SEED", raising=False)
+    assert resolve_opsan_seed() == DEFAULT_OPSAN_SEED
+    monkeypatch.setenv("SCENARIO_SEED", "111")
+    assert resolve_opsan_seed() == 111
+    monkeypatch.setenv("OPSAN_SEED", "222")
+    assert resolve_opsan_seed() == 222
+    assert resolve_opsan_seed(333) == 333
+
+
+# -- static<->dynamic cross-check ---------------------------------------------
+
+def _fixture_file(tmp_path, edges):
+    path = tmp_path / "dynamic_edges.json"
+    path.write_text(json.dumps({"edges": edges}))
+    return str(path)
+
+
+def test_crosscheck_dynamic_only_requires_fixture(tmp_path):
+    static = [("A._lock", "B._lock")]
+    dynamic = [("A._lock", "B._lock"), ("C._lock", "D._lock")]
+    sites = {e: "x.py:1" for e in dynamic}
+    res = cc.crosscheck(static, dynamic, sites, fixtures={})
+    assert res.unfixtured == [("C._lock", "D._lock")]
+    assert not res.ok()
+
+    fixtures = cc.load_fixtures(_fixture_file(tmp_path, [
+        {"src": "C._lock", "dst": "D._lock",
+         "rationale": "acquired through a callback the resolver cannot see"},
+    ]))
+    res2 = cc.crosscheck(static, dynamic, sites, fixtures)
+    assert res2.ok()
+    assert res2.fixtured == [("C._lock", "D._lock")]
+    assert res2.coverage() == 1.0
+
+
+def test_crosscheck_coverage_and_stale_fixtures(tmp_path):
+    static = [("A._lock", "B._lock"), ("B._lock", "C._lock")]
+    dynamic = [("A._lock", "B._lock")]
+    fixtures = cc.load_fixtures(_fixture_file(tmp_path, [
+        {"src": "A._lock", "dst": "B._lock",
+         "rationale": "was dynamic-only before the analyzer learned it"},
+    ]))
+    res = cc.crosscheck(static, dynamic, {}, fixtures)
+    assert res.static_only == [("B._lock", "C._lock")]
+    assert res.coverage() == 0.5
+    # the fixtured edge is IN the static graph now: stale, prune it
+    assert res.stale_fixtures == [("A._lock", "B._lock")]
+    assert res.ok()
+
+
+def test_crosscheck_fixture_without_rationale_rejected(tmp_path):
+    path = _fixture_file(tmp_path, [{"src": "A", "dst": "B"}])
+    with pytest.raises(ValueError):
+        cc.load_fixtures(path)
+
+
+def test_crosscheck_report_merge(tmp_path, opsan):
+    lock_a = TrackedLock("A._lock")
+    lock_b = TrackedLock("B._lock")
+    with lock_a:
+        with lock_b:
+            pass
+    opsan.dump(str(tmp_path))
+    edges, sites, races = cc.load_reports(
+        [str(p) for p in tmp_path.glob("opsan-*.json")])
+    assert ("A._lock", "B._lock") in edges
+    assert races == []
+
+
+# -- the untracked-shared-state opalint rule ----------------------------------
+
+_RULE = "untracked-shared-state"
+
+_WIDGET = """
+    import threading
+
+    class Widget:
+        def __init__(self):
+            self._jobs = {jobs_value}
+            self._lock = threading.Lock()
+
+        def start(self):
+            threading.Thread(target=self._worker).start()
+            threading.Thread(target=self._drainer).start()
+
+        def _worker(self):
+            {worker_access}
+
+        def _drainer(self):
+            self._jobs.clear()
+"""
+
+
+def _lint_project(src, relpath="tpu_operator/controllers/widget.py"):
+    src = textwrap.dedent(src)
+    cfg = LintConfig()
+    project = graph_mod.build_from_sources({relpath: src}, cfg)
+    ctx = FileContext(relpath, src, ast.parse(src), cfg, project=project)
+    found = list(all_checkers()[_RULE]().check(ctx))
+    return apply_suppressions(found, suppressions(src))
+
+
+def test_untracked_shared_state_positive():
+    kept, _ = _lint_project(_WIDGET.format(
+        jobs_value="{}", worker_access='self._jobs["a"] = 1'))
+    assert [f.rule for f in kept] == [_RULE]
+    assert "Widget._jobs" in kept[0].message
+
+
+def test_untracked_shared_state_silent_when_registered():
+    src = ("from tpu_operator.utils import register_shared\n"
+           + textwrap.dedent(_WIDGET.format(
+               jobs_value='register_shared("Widget._jobs", {})',
+               worker_access='self._jobs["a"] = 1')))
+    kept, _ = _lint_project(src)
+    assert kept == []
+
+
+def test_untracked_shared_state_silent_when_guarded():
+    kept, _ = _lint_project(_WIDGET.format(
+        jobs_value="{}",
+        worker_access=('with self._lock:\n'
+                       '                self._jobs["a"] = 1')))
+    # _drainer's clear() is still unguarded -> finding remains
+    assert [f.rule for f in kept] == [_RULE]
+    fully = _WIDGET.format(
+        jobs_value="{}",
+        worker_access=('with self._lock:\n'
+                       '                self._jobs["a"] = 1'))
+    fully = fully.replace("self._jobs.clear()",
+                          "with self._lock:\n"
+                          "                self._jobs.clear()")
+    kept2, _ = _lint_project(fully)
+    assert kept2 == []
+
+
+def test_untracked_shared_state_silent_single_entrypoint():
+    src = _WIDGET.format(jobs_value="{}",
+                         worker_access='self._jobs["a"] = 1')
+    src = src.replace(
+        "            threading.Thread(target=self._drainer).start()\n", "")
+    src = src.replace("        def _drainer(self):\n"
+                      "            self._jobs.clear()\n", "")
+    kept, _ = _lint_project(src)
+    assert kept == []
+
+
+def test_untracked_shared_state_silent_outside_reconcile_dirs():
+    kept, _ = _lint_project(
+        _WIDGET.format(jobs_value="{}",
+                       worker_access='self._jobs["a"] = 1'),
+        relpath="tpu_operator/client/widget.py")
+    assert kept == []
+
+
+def test_untracked_shared_state_inline_suppressible():
+    src = _WIDGET.format(
+        jobs_value="{}  # opalint: disable=untracked-shared-state"
+                   " — replaced wholesale before threads start",
+        worker_access='self._jobs["a"] = 1')
+    kept, dropped = _lint_project(src)
+    assert kept == [] and dropped == 1
+
+
+def test_untracked_shared_state_module_level_positive():
+    src = """
+        import threading
+
+        PENDING = {}
+
+        def _worker():
+            PENDING["a"] = 1
+
+        def _drainer():
+            PENDING.clear()
+
+        def start():
+            threading.Thread(target=_worker).start()
+            threading.Thread(target=_drainer).start()
+    """
+    kept, _ = _lint_project(src,
+                            relpath="tpu_operator/state/pending.py")
+    assert [f.rule for f in kept] == [_RULE]
+    assert "PENDING" in kept[0].message

@@ -27,6 +27,7 @@ elector on its own direct client (leases bypass cache + resilience by
 design) and ``fenced.bind(elector)`` giving the fence the live view.
 """
 
+import os
 import threading
 import time
 
@@ -104,9 +105,18 @@ class Replica:
             limiter=TokenBucket(qps=200.0, burst=400),
             breaker=CircuitBreaker(threshold=5))))
         self.app = OperatorApp(self.client)
+        # The 2 s lease gives a 0.5 s renew deadline (min(0.8*L, L-1.5)).
+        # Under the opsan schedule perturber on a loaded single-core
+        # runner, A's renew loop can be starved past that from scheduling
+        # noise alone and leadership churns during install (reproduced at
+        # OPSAN_SEED=20260807 in the race-soak lane: epoch reached 3,
+        # install writes fenced). Widen the lease under the sanitizer —
+        # the contract under test is partition-induced deposition, not
+        # renew-loop liveness under synthetic starvation.
+        lease = 6.0 if os.environ.get("TPU_OPERATOR_OPSAN") == "1" else 2.0
         self.elector = LeaderElector(
             self.direct, NAMESPACE, identity=ident,
-            lease_duration=2.0, renew_period=0.1, retry_period=0.05)
+            lease_duration=lease, renew_period=0.1, retry_period=0.05)
         self.app.elector = self.elector
         self.fenced.bind(self.elector)
         self.acquired_at = None

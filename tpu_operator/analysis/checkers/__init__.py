@@ -18,4 +18,5 @@ from . import (  # noqa: F401
     state_before_actuation,
     unbatched_sweep_write,
     unfenced_write,
+    untracked_shared_state,
 )

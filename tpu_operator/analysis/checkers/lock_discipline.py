@@ -27,7 +27,10 @@ from typing import Dict, Iterator, List, Optional, Set
 from ..core import Checker, FileContext, Finding, register, self_attr
 
 LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
-                  "BoundedSemaphore"}
+                  "BoundedSemaphore",
+                  # the opsan-instrumentable factory seam
+                  # (tpu_operator.utils.locks)
+                  "make_lock", "make_rlock"}
 LOCKISH_NAMES = ("lock", "cond", "mutex")
 MUTATORS = {"append", "appendleft", "add", "extend", "insert", "remove",
             "discard", "pop", "popleft", "popitem", "clear", "update",

@@ -39,7 +39,10 @@ from .core import LintConfig, dotted_name
 
 #: threading factory callables whose result is a lock-ish object
 LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
-                  "BoundedSemaphore"}
+                  "BoundedSemaphore",
+                  # the opsan-instrumentable factory seam
+                  # (tpu_operator.utils.locks)
+                  "make_lock", "make_rlock"}
 #: attribute-name fragments treated as locks even without a visible factory
 LOCKISH_NAMES = ("lock", "cond", "mutex")
 

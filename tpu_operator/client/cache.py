@@ -37,6 +37,7 @@ from .errors import NotFoundError
 from .fake import match_field_selector, match_label_selector
 from .interface import Client, WatchEvent, WatchHandle
 from .scheme import Scheme, default_scheme
+from ..utils.locks import make_lock, register_shared
 
 log = logging.getLogger(__name__)
 
@@ -93,8 +94,9 @@ class _Informer:
         self.api_version = api_version
         self.kind = kind
         self.namespace = namespace
-        self._store: Dict[Tuple[str, str], dict] = {}
-        self._lock = threading.Lock()
+        self._store: Dict[Tuple[str, str], dict] = register_shared(
+            f"Informer[{kind}]._store", {})
+        self._lock = make_lock("_Informer._lock")
         self.synced = threading.Event()
         #: newest resourceVersion this informer has observed (relist
         #: envelope or watch event) — the high watermark synchronous
@@ -182,7 +184,12 @@ class _Informer:
     def _on_relist(self, items: List[dict], rv: str) -> None:
         with self._lock:
             old = self._store
-            self._store = {self._key(o): o for o in items}
+            # wholesale swap: the replacement is a NEW shared
+            # structure — re-register so two generations (old map
+            # draining, new map filling) are tracked independently
+            self._store = register_shared(
+                f"Informer[{self.kind}]._store",
+                {self._key(o): o for o in items})
             vanished = [obj for key, obj in old.items()
                         if key not in self._store]
             try:
@@ -254,8 +261,9 @@ class CachedClient(Client):
     def __init__(self, inner: Client, scheme: Optional[Scheme] = None):
         self.inner = inner
         self.scheme = scheme or getattr(inner, "scheme", None) or default_scheme()
-        self._informers: Dict[Tuple[str, str, Optional[str]], _Informer] = {}
-        self._lock = threading.Lock()
+        self._informers: Dict[Tuple[str, str, Optional[str]], _Informer] = (
+            register_shared("CachedClient._informers", {}))
+        self._lock = make_lock("CachedClient._lock")
 
     # -- informer plumbing ---------------------------------------------------
     def _scope(self, api_version: str, kind: str, namespace: Optional[str],

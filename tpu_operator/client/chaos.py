@@ -40,6 +40,7 @@ import requests
 
 from .errors import ApiError, TooManyRequestsError
 from .interface import Client, WatchHandle
+from ..utils.locks import make_lock
 
 
 @dataclasses.dataclass
@@ -67,7 +68,7 @@ class ChaosPolicy:
 
     def __post_init__(self):
         self.rng = random.Random(self.seed)
-        self._lock = threading.Lock()
+        self._lock = make_lock("ChaosPolicy._lock")
         #: injected-fault accounting, by kind — tests assert the chaos
         #: actually happened (a 0% effective rate proves nothing)
         self.injected: Dict[str, int] = {}
@@ -307,7 +308,7 @@ class CrashPointClient(Client):
         self._seen: set = set()
         self.fired = False
         self.dead = False
-        self._lock = threading.Lock()
+        self._lock = make_lock("CrashPointClient._lock")
 
     # -- the gate --------------------------------------------------------------
     def _alive(self) -> None:

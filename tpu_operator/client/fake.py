@@ -26,6 +26,7 @@ from .errors import (
 )
 from .interface import Client, WatchEvent, WatchHandle
 from .scheme import Scheme, default_scheme
+from ..utils.locks import make_rlock
 
 Key = Tuple[str, str, str, str]
 
@@ -107,7 +108,7 @@ class FakeClient(Client):
     def __init__(self, scheme: Optional[Scheme] = None, objects: Optional[List[dict]] = None,
                  crd_validation: bool = True):
         self.scheme = scheme or default_scheme()
-        self._lock = threading.RLock()
+        self._lock = make_rlock("FakeClient._lock")
         self._store: Dict[Key, dict] = {}
         self._rv = 0
         # last rv at which an event was emitted, per (apiVersion, kind,

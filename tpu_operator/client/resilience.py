@@ -55,6 +55,7 @@ from .errors import (
     is_transient,
 )
 from .interface import Client, WatchHandle
+from ..utils.locks import make_lock
 
 log = logging.getLogger(__name__)
 
@@ -95,7 +96,7 @@ class TokenBucket:
         self.burst = max(1, burst)
         self._clock = clock
         self._sleep = sleep
-        self._lock = threading.Lock()
+        self._lock = make_lock("TokenBucket._lock")
         self._tokens = float(self.burst)
         self._last = clock()
 
@@ -136,7 +137,7 @@ class CircuitBreaker:
         self.threshold = max(1, threshold)
         self.cooldown_s = cooldown_s
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = make_lock("CircuitBreaker._lock")
         self._state = CLOSED
         self._consecutive_failures = 0
         self._open_until = 0.0

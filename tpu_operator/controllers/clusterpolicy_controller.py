@@ -55,7 +55,7 @@ from ..state.manager import (
 )
 from ..state.nodepool import NodePool, get_node_pools, shard_by_pools
 from ..state.operands import cluster_policy_states
-from ..utils import deep_get
+from ..utils import deep_get, register_shared
 from .metrics import OperatorMetrics
 from .predicates import filtered_node_mapper
 from .runtime import Controller, Reconciler, Request, Result
@@ -100,9 +100,11 @@ class ClusterPolicyReconciler(Reconciler):
         #: last-seen tpu.ai/slice.config.state per node, for counting
         #: transitions INTO "retiled" (the counter must tick once per
         #: re-tile event, not once per sweep that observes the state)
-        self._last_slice_state: dict = {}
+        self._last_slice_state: dict = register_shared(
+            "ClusterPolicyController._last_slice_state", {})
         #: last sweep's health rollup, surfaced on /debug/queue
-        self._last_health_counts: dict = {}
+        self._last_health_counts: dict = register_shared(
+            "ClusterPolicyController._last_health_counts", {})
         #: nodes failing the serving SLO on the last sweep (debug surface)
         self._last_serving_failing: list = []
 

@@ -28,7 +28,7 @@ log = logging.getLogger(__name__)
 #: there)
 DEBUG_ROUTES = ("/debug/informers", "/debug/traces", "/debug/join-traces",
                 "/debug/queue", "/debug/state", "/debug/threads",
-                "/debug/timeline", "/debug/capacity")
+                "/debug/timeline", "/debug/capacity", "/debug/opsan")
 
 
 def serve_health_and_metrics(metrics: OperatorMetrics, metrics_port: int,
@@ -163,6 +163,19 @@ def serve_health_and_metrics(metrics: OperatorMetrics, metrics_port: int,
                 # aggregated from per-node serving frontiers, staleness
                 # and open drift episodes
                 self._send_json(app.capacity.debug_state())
+                return
+            if path == "/debug/opsan" and debug_on:
+                # the race sanitizer's live report: tracked vars, dynamic
+                # lock edges, races, suppressions; {"enabled": false} when
+                # the process runs without TPU_OPERATOR_OPSAN=1
+                from ..sanitizer.core import opsan_enabled, runtime
+
+                if not opsan_enabled():
+                    self._send_json({"enabled": False})
+                else:
+                    payload = runtime().report()
+                    payload["enabled"] = True
+                    self._send_json(payload)
                 return
             if path == "/debug/threads" and debug_on:
                 # pprof-style goroutine-dump analog for the threaded runtime
@@ -315,6 +328,15 @@ class OperatorApp:
         if self.batcher is not None:
             self.batcher.bind_read_client(client)
             self.metrics.wire_batching(self.batcher)
+        # opsan (race sanitizer): when the process runs under
+        # TPU_OPERATOR_OPSAN=1, export its race/access counters and
+        # surface the live report behind /debug/opsan
+        from ..sanitizer.core import opsan_enabled
+
+        if opsan_enabled():
+            from ..sanitizer.core import runtime as opsan_runtime
+
+            self.metrics.wire_opsan(opsan_runtime())
         self._metrics_port = metrics_port
         self._health_port = health_port
         self._servers: list = []

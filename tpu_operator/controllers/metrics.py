@@ -297,6 +297,22 @@ class OperatorMetrics:
             "coalescing win)", registry=self.registry,
             buckets=(1, 2, 3, 5, 8, 13, 21, 34))
 
+        # opsan (dynamic race sanitizer) — only nonzero when the process
+        # runs with TPU_OPERATOR_OPSAN=1 (the race-soak CI lane, or a
+        # live repro of a suspected race; docs/operations.md runbook)
+        self.opsan_races = Counter(
+            "tpu_operator_opsan_races_total",
+            "Unsuppressed data races reported by the opsan lockset "
+            "sanitizer (candidate lockset emptied on a shared-modified "
+            "access) — any nonzero value fails the race-soak lane",
+            registry=self.registry)
+        self.opsan_tracked_accesses = Counter(
+            "tpu_operator_opsan_tracked_accesses_total",
+            "Reads/writes of register_shared()-tracked structures observed "
+            "by opsan (the evidence base: a zero here under "
+            "TPU_OPERATOR_OPSAN=1 means the sanitizer saw nothing)",
+            registry=self.registry)
+
     def wire_tracing(self) -> None:
         """Mirror the tracing module's dropped-span counter into the
         ``tpu_operator_trace_dropped_total`` gauge (pull, not push: the
@@ -354,6 +370,13 @@ class OperatorMetrics:
         replaced — the request-count savings, measured)."""
         batcher.on_batched = self.batched_writes.inc
         batcher.on_flush = self.write_batch_size.observe
+
+    def wire_opsan(self, rt) -> None:
+        """Attach the opsan runtime's hooks: tracked-access volume and the
+        unsuppressed-race counter. No-op wiring cost when opsan is off —
+        the hooks only fire from tracked proxies, which don't exist then."""
+        rt.on_access = self.opsan_tracked_accesses.inc
+        rt.on_race = lambda report: self.opsan_races.inc()
 
     def scrape(self) -> bytes:
         return generate_latest(self.registry)

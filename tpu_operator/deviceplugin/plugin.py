@@ -42,6 +42,7 @@ from ..validator.driver import discover_devices
 from ..validator.status import StatusFiles
 from . import grpc_api
 from .proto import deviceplugin_pb2 as pb
+from ..utils.locks import make_lock
 
 log = logging.getLogger(__name__)
 
@@ -158,7 +159,7 @@ class TPUDevicePlugin:
         #: real host ICI grid from the partitioner handoff (None = guess)
         self._grid: Optional[tuple] = None
         self._watchers: List["queue.Queue[List[Unit]]"] = []
-        self._lock = threading.Lock()
+        self._lock = make_lock("TPUDevicePlugin._lock")
         self._server: Optional[grpc.Server] = None
         self._stop = threading.Event()
 

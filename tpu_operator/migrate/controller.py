@@ -41,7 +41,7 @@ from ..controllers.predicates import filtered_node_mapper
 from ..controllers.runtime import Controller, Reconciler, Request, Result
 from ..health import drain as drain_protocol
 from ..provenance import DecisionJournal, episode_id
-from ..utils import deep_get
+from ..utils import deep_get, register_shared
 from .checkpoint import dumps_compact
 
 log = logging.getLogger(__name__)
@@ -128,7 +128,8 @@ class MigrationReconciler(Reconciler):
         #: process-local census of in-flight episodes (src -> phase) for
         #: the migrations_in_progress gauge; rebuilt from annotations as
         #: requests arrive, so a restart under-counts for at most one sweep
-        self._active: Dict[str, str] = {}
+        self._active: Dict[str, str] = register_shared(
+            "MigrationController._active", {})
 
     def debug_state(self) -> dict:
         return {"migrate": {"active": dict(sorted(self._active.items()))}}

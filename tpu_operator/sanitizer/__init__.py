@@ -1,0 +1,57 @@
+"""opsan — dynamic lockset race sanitizer for the operator control plane.
+
+opalint's static lock graph (PR 15) proves what the *source* promises
+about locking; opsan proves what real *executions* deliver. When
+``TPU_OPERATOR_OPSAN=1`` the :mod:`tpu_operator.utils.locks` factory
+substitutes :class:`TrackedLock`/:class:`TrackedRLock` for
+``threading.Lock/RLock`` across the operator, every reconciler registers
+its mutable shared structures with :func:`register_shared`, and the
+runtime runs the classic Eraser lockset algorithm refined with
+happens-before edges (thread start/join, ``queue.Queue`` put/get, lock
+release→acquire) so benign initialization and hand-off patterns stay
+silent. A seeded schedule perturber (:mod:`.perturb`) widens the
+interleavings the soaks explore, and :mod:`.crosscheck` diffs the
+dynamically observed lock-acquisition graph against opalint's static one.
+
+Environment contract (all read once, at install time):
+
+* ``TPU_OPERATOR_OPSAN=1``       — enable tracking (master switch)
+* ``TPU_OPERATOR_OPSAN_PERTURB=1`` — enable the schedule perturber
+* ``OPSAN_SEED``                 — perturber root seed (falls back to
+  ``SCENARIO_SEED`` then the pinned default, PR 17 semantics)
+* ``TPU_OPERATOR_OPSAN_REPORT``  — directory to dump the JSON report
+  into at process exit (one file per process)
+
+See docs/static-analysis.md, "opsan (dynamic)".
+"""
+
+from .core import (
+    OpsanRuntime,
+    RaceReport,
+    opsan_enabled,
+    opsan_perturb_enabled,
+    reset_runtime,
+    runtime,
+)
+from .hooks import ensure_installed, install, uninstall
+from .locks import TrackedLock, TrackedRLock
+from .perturb import Perturber, resolve_opsan_seed
+from .registry import register_shared, registered_names
+
+__all__ = [
+    "OpsanRuntime",
+    "Perturber",
+    "RaceReport",
+    "TrackedLock",
+    "TrackedRLock",
+    "ensure_installed",
+    "install",
+    "opsan_enabled",
+    "opsan_perturb_enabled",
+    "register_shared",
+    "registered_names",
+    "reset_runtime",
+    "resolve_opsan_seed",
+    "runtime",
+    "uninstall",
+]

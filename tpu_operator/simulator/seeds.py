@@ -27,33 +27,17 @@ name                consumer
 
 from __future__ import annotations
 
-import hashlib
-import os
 from typing import Optional
 
-SCENARIO_SEED_ENV = "SCENARIO_SEED"
-#: the CI-pinned default (tests/tpu-ci.yaml `scenario-fuzz` job)
-DEFAULT_SCENARIO_SEED = 20260806
-
-
-def resolve_seed(explicit: Optional[int] = None) -> int:
-    """Root-seed precedence: explicit flag > $SCENARIO_SEED > pinned
-    default."""
-    if explicit is not None:
-        return int(explicit)
-    raw = os.environ.get(SCENARIO_SEED_ENV)
-    if raw:
-        return int(raw)
-    return DEFAULT_SCENARIO_SEED
-
-
-def seed_for(root: int, name: str) -> int:
-    """Derive the per-consumer seed for ``name`` from the root seed.
-
-    sha256-based (not ``hash()``: that is salted per-process) and truncated
-    to 32 bits so it fits every consumer's ``random.Random(seed)``."""
-    digest = hashlib.sha256(f"{int(root)}:{name}".encode()).digest()
-    return int.from_bytes(digest[:4], "big")
+# the mechanics live in utils.seeds (dependency-free) so the opsan
+# perturber can derive seeds without importing the simulator package;
+# this module remains the documented home of the derived-name contract
+from ..utils.seeds import (  # noqa: F401  (re-exported contract)
+    DEFAULT_SCENARIO_SEED,
+    SCENARIO_SEED_ENV,
+    resolve_seed,
+    seed_for,
+)
 
 
 def repro_command(seed: int, budget: Optional[int] = None,

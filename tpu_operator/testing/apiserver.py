@@ -21,6 +21,7 @@ from urllib.parse import parse_qs, urlparse
 from ..client.errors import ApiError
 from ..client.fake import FakeClient
 from ..client.scheme import Scheme, default_scheme
+from ..utils.locks import make_lock
 
 
 def _parse_selector(raw: str) -> dict:
@@ -91,7 +92,7 @@ class MiniApiServer:
         #: total HTTP requests served — read-amplification accounting for
         #: tests and the control-plane bench
         self.request_count = 0
-        self._count_lock = threading.Lock()
+        self._count_lock = make_lock("MiniApiServer._count_lock")
         self._router = _Router(self.scheme)
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None

@@ -37,6 +37,7 @@ from collections import deque
 from typing import Dict, Iterator, List, Optional
 
 from . import consts
+from .utils.locks import make_lock, register_shared
 
 #: Event annotation carrying the reconcile trace that emitted it
 #: (key registered in consts.py; re-exported here for span-machinery users)
@@ -61,7 +62,7 @@ _remote_sink: contextvars.ContextVar[Optional[tuple]] = contextvars.ContextVar(
 #: thread (watch/informer threads, un-traced operand entrypoints) —
 #: read via :func:`dropped_spans_total`, exported as
 #: ``tpu_operator_trace_dropped_total`` and surfaced in /debug/traces
-_dropped_lock = threading.Lock()
+_dropped_lock = make_lock("tracing._dropped_lock")
 _dropped_spans = 0
 
 
@@ -381,9 +382,11 @@ class FlightRecorder:
         self.size = max(1, int(size))
         self.error_size = max(1, int(error_size if error_size is not None
                                     else self.size // 4 or 1))
-        self._lock = threading.Lock()
-        self._traces: deque = deque(maxlen=self.size)
-        self._errors: deque = deque(maxlen=self.error_size)
+        self._lock = make_lock("FlightRecorder._lock")
+        self._traces: deque = register_shared(
+            "FlightRecorder._traces", deque(maxlen=self.size))
+        self._errors: deque = register_shared(
+            "FlightRecorder._errors", deque(maxlen=self.error_size))
         self.recorded_total = 0
         self.error_total = 0
 

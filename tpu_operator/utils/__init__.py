@@ -1,4 +1,5 @@
 from .hash import fnv32a, object_hash
+from .locks import make_lock, make_rlock, register_shared
 from .objects import (
     deep_get,
     deep_merge,
@@ -18,9 +19,12 @@ __all__ = [
     "deep_merge",
     "ensure_list",
     "json_merge_patch",
+    "make_lock",
+    "make_rlock",
     "obj_key",
     "parse_quantity",
     "pod_requests_resource",
+    "register_shared",
     "rfc3339_now",
     "same_object",
 ]

@@ -1,0 +1,67 @@
+"""Lock factory: the single seam where opsan instruments the operator.
+
+Every long-lived lock in the operator is constructed through
+:func:`make_lock`/:func:`make_rlock` with its static lock-graph label
+(``ClassName._attr`` — the exact string
+:meth:`tpu_operator.analysis.graph.LockNode.label` produces, so the
+dynamic acquisition graph lines up with opalint's static one in the
+cross-check). With ``TPU_OPERATOR_OPSAN`` unset this returns the raw
+``threading`` primitive — no wrapper, no import of the sanitizer
+package, zero production overhead. With ``TPU_OPERATOR_OPSAN=1`` it
+returns a TrackedLock/TrackedRLock and installs the happens-before
+hooks on first use.
+
+opalint knows these names: ``make_lock``/``make_rlock`` are in the
+static analyzer's ``LOCK_FACTORIES``, so ``self._lock = make_lock(...)``
+is a lock attribute to the lock graph and lock-discipline rules exactly
+as ``threading.Lock()`` is.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+_OPSAN_ENV = "TPU_OPERATOR_OPSAN"
+
+
+def _opsan_on() -> bool:
+    return os.environ.get(_OPSAN_ENV) == "1"
+
+
+def make_lock(name: str):
+    """A ``threading.Lock`` — tracked when opsan is enabled.
+
+    ``name`` must be the static lock-graph label, ``ClassName._attr``."""
+    if _opsan_on():
+        # lazy import: production processes never load the sanitizer
+        from ..sanitizer import TrackedLock, ensure_installed
+        ensure_installed()
+        return TrackedLock(name)
+    return threading.Lock()
+
+
+def make_rlock(name: str):
+    """A ``threading.RLock`` — tracked when opsan is enabled."""
+    if _opsan_on():
+        from ..sanitizer import TrackedRLock, ensure_installed
+        ensure_installed()
+        return TrackedRLock(name)
+    return threading.RLock()
+
+
+def register_shared(name: str, obj):
+    """Register a mutable shared structure with the opsan sanitizer.
+
+    Opsan off: identity — returns ``obj`` untouched, sanitizer never
+    imported. Opsan on: delegates to
+    :func:`tpu_operator.sanitizer.registry.register_shared`, which
+    returns a tracked proxy reporting every access to the lockset
+    algorithm. Call it again with the replacement when a structure is
+    swapped wholesale (informer relist, batcher flush)."""
+    if _opsan_on():
+        from ..sanitizer import ensure_installed
+        from ..sanitizer.registry import register_shared as _register
+        ensure_installed()
+        return _register(name, obj)
+    return obj
